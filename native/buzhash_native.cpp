@@ -1,0 +1,71 @@
+// Native CPU buzhash CDC scan — the fast single-core reference chunker.
+//
+// Implements pbs_plus_tpu/chunker/spec.py: 32-bit buzhash over a sliding
+// 64-byte window of the raw stream (no reset at cut points).  With W=64 and
+// 32-bit rotations, rotl(x, 64 mod 32) == x, so the rolling recurrence is
+//     h = rotl1(h) ^ T[b[i-64]] ^ T[b[i]]
+// Candidate at i iff (h & mask) == magic.  Cut selection (min/max greedy)
+// stays in Python (shared spec.select_cuts) so all backends share it.
+//
+// Reference role: the external Go buzhash library used at
+// /root/reference/internal/pxarmount/commit_orchestrate.go:144 — this is
+// our CPU-baseline equivalent, and the thing the TPU kernels must beat.
+
+#include <cstdint>
+#include <cstring>
+
+static inline uint32_t rotl1(uint32_t x) { return (x << 1) | (x >> 31); }
+
+extern "C" {
+
+// Scan `data[0..n)` for candidate end offsets.  `prefix` holds up to 63
+// bytes of preceding stream context; `global_offset` is the stream offset
+// of data[0].  Writes absolute end offsets; returns count written (stops
+// at out_cap — caller sizes generously and retries on overflow).
+int64_t pbs_buzhash_candidates(
+    const uint8_t* data, int64_t n,
+    const uint8_t* prefix, int64_t prefix_len,
+    const uint32_t* table, uint32_t mask, uint32_t magic,
+    int64_t global_offset,
+    int64_t* out_ends, int64_t out_cap) {
+  const int64_t W = 64;
+  if (prefix_len > W - 1) {
+    prefix += prefix_len - (W - 1);
+    prefix_len = W - 1;
+  }
+  // Assemble the warm-up window: last <=63 context bytes + data.
+  // Positions are valid once 64 bytes of stream history exist.
+  uint8_t win[64];  // ring of the last 64 bytes
+  int64_t count = 0;
+  uint32_t h = 0;
+  int64_t hist = global_offset;  // bytes of stream before data[0]
+  if (hist < prefix_len) prefix_len = hist;  // cannot have more context than stream
+  // While the window is not yet full (first 64 rolls) nothing leaves it,
+  // so the T[out] term must be suppressed — a zero-initialized ring would
+  // otherwise inject T[0] terms that never cancel.
+  std::memset(win, 0, sizeof win);
+  int64_t rolled = 0;  // total bytes rolled through (context + data)
+  for (int64_t j = 0; j < prefix_len; ++j) {
+    uint8_t in = prefix[j];
+    uint32_t out_term = rolled >= W ? table[win[rolled & 63]] : 0u;
+    h = rotl1(h) ^ out_term ^ table[in];
+    win[rolled & 63] = in;
+    ++rolled;
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    uint8_t in = data[i];
+    uint32_t out_term = rolled >= W ? table[win[rolled & 63]] : 0u;
+    h = rotl1(h) ^ out_term ^ table[in];
+    win[rolled & 63] = in;
+    ++rolled;
+    // full-window validity: needs 64 bytes of real stream history ending
+    // at this position, and all of them rolled through this scan.
+    if (global_offset + i >= W - 1 && rolled >= W && (h & mask) == magic) {
+      if (count >= out_cap) return -1;
+      out_ends[count++] = global_offset + i + 1;
+    }
+  }
+  return count;
+}
+
+}  // extern "C"

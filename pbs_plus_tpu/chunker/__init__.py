@@ -1,0 +1,27 @@
+"""Content-defined chunking (CDC).
+
+Reference: the external Go library ``github.com/pbs-plus/pxar``'s ``buzhash``
+sub-package, consumed as ``buzhash.NewConfig(4<<20)`` (4 MiB target) at
+/root/reference/internal/pxarmount/commit_orchestrate.go:144 and
+/root/reference/internal/tapeio/converter.go:248.
+
+The chunker is pluggable from day one (SURVEY §7 step 1): the ``Chunker``
+interface has a CPU backend (numpy-vectorized + optional C++ native) and a
+TPU backend (``pbs_plus_tpu.ops``), selected by ``conf.Env.chunker``.
+Cut-point bit-parity between backends is a correctness gate (BASELINE.md
+config #2).
+"""
+
+from .spec import (
+    ChunkerParams,
+    DEFAULT_PARAMS,
+    TEST_PARAMS,
+    buzhash_table,
+    select_cuts,
+)
+from .cpu import CpuChunker, chunk_bounds, candidates
+
+__all__ = [
+    "ChunkerParams", "DEFAULT_PARAMS", "TEST_PARAMS", "buzhash_table",
+    "select_cuts", "CpuChunker", "chunk_bounds", "candidates",
+]

@@ -1,0 +1,139 @@
+"""CPU buzhash CDC backends: numpy-vectorized batch + streaming chunker.
+
+Implements chunker/spec.py exactly.  The numpy path computes per-position
+hashes with the same log2(W) doubling passes the TPU kernel uses; the
+optional C++ native path (chunker/native.py) uses the classic rolling
+recurrence — with W=64 on 32-bit rotations it degenerates to
+``h = rotl1(h) ^ T[out] ^ T[in]``.  All paths must produce identical
+candidate sets; tests/test_chunker.py enforces it.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from .spec import WINDOW, ChunkerParams, select_cuts
+
+
+def _rotl32(x: np.ndarray, r: int) -> np.ndarray:
+    r &= 31
+    if r == 0:
+        return x.copy()
+    return ((x << np.uint32(r)) | (x >> np.uint32(32 - r))).astype(np.uint32)
+
+
+def position_hashes(data: bytes | np.ndarray, params: ChunkerParams,
+                    prefix: bytes | np.ndarray = b"") -> np.ndarray:
+    """Buzhash h(i) for every position of ``data`` (uint32 array, same
+    length).  Positions whose 64-byte window extends before the start of
+    ``prefix+data`` hold partial-window values; ``candidates`` masks them
+    out via its validity check."""
+    buf = np.frombuffer(bytes(prefix), dtype=np.uint8) if not isinstance(prefix, np.ndarray) else prefix
+    arr = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    full = np.concatenate([buf, arr]) if len(buf) else arr
+    t = params.table[full]
+    h = t.astype(np.uint32, copy=True)
+    m = 1
+    while m < WINDOW:
+        # H_{2m}(i) = H_m(i) ^ rotl_{m mod 32}(H_m(i-m))
+        h[m:] ^= _rotl32(h[:-m], m)
+        m *= 2
+    return h[len(buf):]
+
+
+def candidates(data: bytes | np.ndarray, params: ChunkerParams, *,
+               prefix: bytes | np.ndarray = b"",
+               global_offset: int = 0, force_numpy: bool = False) -> np.ndarray:
+    """Sorted absolute candidate END offsets inside ``data``.
+
+    ``prefix`` supplies up to W-1 bytes of preceding stream context;
+    ``global_offset`` is the stream offset of ``data[0]``.  Positions whose
+    window is not fully inside the stream (fewer than W bytes of history)
+    are excluded.
+
+    Dispatches to the C++ native scanner when available (same spec,
+    bit-identical — tests/test_chunker.py::test_native_matches_numpy);
+    the numpy path is the always-available reference implementation.
+    """
+    if not force_numpy and len(data) >= 1 << 16:
+        from . import native
+        if native.available():
+            return native.candidates(
+                bytes(data) if isinstance(data, np.ndarray) else data, params,
+                prefix=bytes(prefix), global_offset=global_offset)
+    plen = len(prefix)
+    if plen >= WINDOW:
+        prefix = prefix[-(WINDOW - 1):]
+        plen = WINDOW - 1
+    h = position_hashes(data, params, prefix)
+    hit = (h & np.uint32(params.mask)) == np.uint32(params.magic)
+    # window of position i (local, within data) spans [i - 63 .. i] in the
+    # combined buffer: needs plen + i >= WINDOW - 1 and the stream itself
+    # must have WINDOW bytes of history: global_offset + i >= WINDOW - 1.
+    n = len(h)
+    local_i = np.arange(n, dtype=np.int64)
+    valid = (plen + local_i >= WINDOW - 1) & (global_offset + local_i >= WINDOW - 1)
+    ends = np.nonzero(hit & valid)[0] + 1 + global_offset
+    return ends.astype(np.int64)
+
+
+def chunk_bounds(data: bytes, params: ChunkerParams) -> list[tuple[int, int]]:
+    """One-shot chunking: list of (start, end) covering ``data``."""
+    if len(data) == 0:
+        return []
+    ends = candidates(data, params)
+    cuts = select_cuts(ends, len(data), params)
+    out = []
+    s = 0
+    for e in cuts:
+        out.append((s, e))
+        s = e
+    return out
+
+
+class CpuChunker:
+    """Streaming chunker: ``feed()`` returns finalized absolute cut offsets,
+    ``finalize()`` flushes the tail chunk.  Mirrors the reference's streaming
+    buzhash consumption inside RemoteDedupWriter (SURVEY §3.4)."""
+
+    def __init__(self, params: ChunkerParams):
+        self.params = params
+        self._tail = b""            # last W-1 bytes of stream seen so far
+        self._seen = 0              # total bytes fed
+        self._chunk_start = 0
+        self._cand: deque[int] = deque()
+        self._finalized = False
+
+    def feed(self, data: bytes) -> list[int]:
+        if self._finalized:
+            raise RuntimeError("chunker already finalized")
+        if not data:
+            return []
+        ends = candidates(data, self.params, prefix=self._tail,
+                          global_offset=self._seen)
+        self._cand.extend(ends.tolist())
+        self._seen += len(data)
+        joined = self._tail + (data if len(data) < WINDOW else data[-(WINDOW - 1):])
+        self._tail = joined[-(WINDOW - 1):]
+        return self._drain(final=False)
+
+    def finalize(self) -> list[int]:
+        if self._finalized:
+            return []
+        self._finalized = True
+        return self._drain(final=True)
+
+    def _drain(self, final: bool) -> list[int]:
+        # delegate to the single shared greedy pass (spec.select_cuts) so the
+        # streaming and batch paths cannot fork the chunk format
+        cuts = select_cuts(
+            np.fromiter(self._cand, dtype=np.int64, count=len(self._cand)),
+            self._seen, self.params, start=self._chunk_start, final=final,
+        )
+        if cuts:
+            self._chunk_start = cuts[-1]
+            while self._cand and self._cand[0] <= self._chunk_start:
+                self._cand.popleft()
+        return cuts

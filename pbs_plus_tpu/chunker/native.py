@@ -1,0 +1,107 @@
+"""ctypes binding for the C++ native chunker (native/buzhash_native.cpp).
+
+Built on demand with g++ into ``<repo>/build/libbuzhash_native.so``.  Falls
+back cleanly when the toolchain is unavailable (``available()`` → False);
+the numpy backend is always present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from .spec import ChunkerParams
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "buzhash_native.cpp")
+_SO = os.path.join(_REPO_ROOT, "build", "libbuzhash_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _build() -> bool:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    tmp = f"{_SO}.tmp.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", tmp, _SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, _SO)  # atomic: interrupted builds never corrupt _SO
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_SO) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        ):
+            if not os.path.exists(_SRC) and not os.path.exists(_SO):
+                _load_failed = True
+                return None
+            if os.path.exists(_SRC) and not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _load_failed = True
+            return None
+        fn = lib.pbs_buzhash_candidates
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,   # data, n
+            ctypes.c_void_p, ctypes.c_int64,   # prefix, prefix_len
+            ctypes.c_void_p,                   # table
+            ctypes.c_uint32, ctypes.c_uint32,  # mask, magic
+            ctypes.c_int64,                    # global_offset
+            ctypes.c_void_p, ctypes.c_int64,   # out_ends, out_cap
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def candidates(data: bytes | np.ndarray, params: ChunkerParams, *,
+               prefix: bytes = b"", global_offset: int = 0) -> np.ndarray:
+    """Native equivalent of chunker.cpu.candidates (bit-identical)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native chunker unavailable")
+    arr = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else np.ascontiguousarray(data, dtype=np.uint8)
+    pfx = np.frombuffer(prefix, dtype=np.uint8)
+    table = np.ascontiguousarray(params.table, dtype=np.uint32)
+    # expected candidate density ~ n/avg; size output with 8x headroom + slack
+    cap = max(1024, 8 * (len(arr) // params.avg_size + 1) + 64)
+    while True:
+        out = np.empty(cap, dtype=np.int64)
+        n = lib.pbs_buzhash_candidates(
+            arr.ctypes.data, len(arr),
+            pfx.ctypes.data if len(pfx) else None, len(pfx),
+            table.ctypes.data,
+            ctypes.c_uint32(params.mask), ctypes.c_uint32(params.magic),
+            global_offset,
+            out.ctypes.data, cap,
+        )
+        if n >= 0:
+            return out[:n].copy()
+        cap *= 4

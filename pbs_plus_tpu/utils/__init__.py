@@ -1,0 +1,2 @@
+"""L0 foundation utilities (reference: internal/conf, internal/log,
+internal/crypto, internal/safemap, internal/validate, internal/calendar)."""

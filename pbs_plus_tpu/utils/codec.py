@@ -1,0 +1,32 @@
+"""Wire codec for RPC envelopes and DTOs.
+
+Reference: the aRPC wire format is CBOR (fxamacker/cbor,
+internal/arpc/call.go:11-37).  CBOR and msgpack are isomorphic for the
+envelope shapes the reference uses (maps of str → scalar/bytes); we use
+msgpack (C-accelerated, baked into this image) as the envelope codec.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import msgpack
+
+
+def encode(obj: Any) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def decode(data: bytes) -> Any:
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)
+
+
+class DecodeError(ValueError):
+    pass
+
+
+def decode_map(data: bytes) -> dict:
+    obj = decode(data)
+    if not isinstance(obj, dict):
+        raise DecodeError(f"expected map, got {type(obj).__name__}")
+    return obj

@@ -1,0 +1,98 @@
+"""Configuration singleton + compile-time constants.
+
+Reference: internal/conf/config.go:5-38 (env singleton),
+internal/conf/constants.go:5-55 (ports, paths, limits),
+internal/conf/buffer.go:9-43 (RAM-derived sizing).
+
+The reference loads an env singleton once and derives buffer/concurrency
+sizes from system RAM.  We keep the same shape: a frozen ``Env`` read from
+the process environment on first access, plus derived sizing helpers.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from functools import lru_cache
+
+# --- network constants (reference: internal/conf/constants.go:7-12) ------
+# Five-port topology: proxied PBS UI, REST API, agent HTTP, aRPC data/control.
+PBS_UI_PORT = 8007
+API_PORT = 8017
+AGENT_HTTP_PORT = 8018
+ARPC_PORT = 8008          # TCP mTLS + mux data plane (and control plane here;
+                          # the reference splits control onto QUIC/UDP 8008)
+
+# --- framing / buffers (reference: internal/arpc/binary_stream.go:12-16,
+#     internal/conf/buffer.go:9) -------------------------------------------
+MAX_FRAME_SIZE = 1 << 30          # 1 GiB raw-frame cap
+STREAM_BUFFER_SIZE = 4 << 20      # 4 MiB per-stream buffer
+
+# --- chunker defaults (reference: buzhash.NewConfig(4<<20) at
+#     internal/pxarmount/commit_orchestrate.go:144) ------------------------
+DEFAULT_CHUNK_AVG = 4 << 20       # 4 MiB target chunk
+TEST_CHUNK_AVG = 4 << 10          # 4 KiB test-scale chunk
+                                  # (internal/pxarmount/commit_walk_test.go:25)
+
+# --- identity / state dirs (reference: internal/conf/constants.go:17-45) --
+DEFAULT_STATE_DIR = "/var/lib/pbs-plus-tpu"
+DEFAULT_CERT_DIR = "/etc/pbs-plus-tpu/certs"
+DEFAULT_DB_NAME = "pbs-plus-tpu.db"
+CERT_RENEW_BEFORE_DAYS = 30
+CA_ROTATION_GRACE_DAYS = 7
+
+# --- rate limiting (reference: internal/arpc/agents_manager.go:225-268) ---
+CLIENT_RATE_LIMIT_PER_SEC = 10.0
+CLIENT_RATE_LIMIT_BURST = 20
+
+
+@dataclass(frozen=True)
+class Env:
+    """Process environment, loaded once (reference: conf.Env)."""
+
+    debug: bool = False
+    hostname: str = ""
+    server_url: str = ""
+    state_dir: str = DEFAULT_STATE_DIR
+    cert_dir: str = DEFAULT_CERT_DIR
+    chunker: str = "cpu"            # "cpu" | "tpu"  — the one-line config
+                                    # change from BASELINE.json's north star
+    log_dedup_window_s: float = 5.0
+    extra: dict = field(default_factory=dict)
+
+
+def _float_env(e, name: str, default: str) -> float:
+    try:
+        return float(e.get(name, default))
+    except ValueError:
+        return float(default)
+
+
+@lru_cache(maxsize=1)
+def env() -> Env:
+    e = os.environ
+    return Env(
+        debug=e.get("PBS_PLUS_DEBUG", "").lower() in ("1", "true", "yes"),
+        hostname=e.get("PBS_PLUS_HOSTNAME", os.uname().nodename),
+        server_url=e.get("PBS_PLUS_SERVER_URL", ""),
+        state_dir=e.get("PBS_PLUS_STATE_DIR", DEFAULT_STATE_DIR),
+        cert_dir=e.get("PBS_PLUS_CERT_DIR", DEFAULT_CERT_DIR),
+        chunker=e.get("PBS_PLUS_CHUNKER", "cpu"),
+        log_dedup_window_s=_float_env(e, "LOG_DEDUP_WINDOW", "5"),
+    )
+
+
+def _system_ram_gib() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return max(1, int(line.split()[1]) // (1 << 20))
+    except OSError:
+        pass
+    return 4
+
+
+def max_concurrent_clients() -> int:
+    """RAM-GiB clamped to [16, 512] (reference: internal/conf/buffer.go:33-38)."""
+    return min(512, max(16, _system_ram_gib()))

@@ -1,0 +1,43 @@
+"""Input validation helpers (reference: internal/validate)."""
+
+from __future__ import annotations
+
+import re
+
+_NAME_RE = re.compile(r"^[A-Za-z0-9_][A-Za-z0-9._\-]*$")
+_HOSTNAME_RE = re.compile(
+    r"^(?=.{1,253}$)([a-zA-Z0-9](?:[a-zA-Z0-9\-]{0,61}[a-zA-Z0-9])?\.)*"
+    r"[a-zA-Z0-9](?:[a-zA-Z0-9\-]{0,61}[a-zA-Z0-9])?$"
+)
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def job_id(value: str) -> str:
+    if not value or len(value) > 256 or not _NAME_RE.match(value):
+        raise ValidationError(f"invalid job id {value!r}")
+    return value
+
+
+def hostname(value: str) -> str:
+    if not value or not _HOSTNAME_RE.match(value):
+        raise ValidationError(f"invalid hostname {value!r}")
+    return value
+
+
+def datastore_name(value: str) -> str:
+    if not value or len(value) > 128 or not _NAME_RE.match(value):
+        raise ValidationError(f"invalid datastore name {value!r}")
+    return value
+
+
+def safe_rel_path(value: str) -> str:
+    """Reject traversal / absolute components in archive-relative paths."""
+    if value.startswith("/") or "\x00" in value:
+        raise ValidationError(f"unsafe path {value!r}")
+    parts = value.split("/")
+    if any(p in ("", ".", "..") for p in parts):
+        raise ValidationError(f"unsafe path {value!r}")
+    return value

@@ -1,0 +1,137 @@
+"""Chunker spec conformance: numpy vs native parity, streaming vs one-shot,
+min/max invariants, shift-invariance of content-defined cuts.
+
+Reference test analog: the pxar library's buzhash tests are exercised
+indirectly through commit_walk_test.go (4 KiB test-scale config,
+/root/reference/internal/pxarmount/commit_walk_test.go:25)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from pbs_plus_tpu.chunker import (
+    ChunkerParams, CpuChunker, candidates, chunk_bounds, select_cuts,
+)
+from pbs_plus_tpu.chunker import native
+from pbs_plus_tpu.chunker.spec import buzhash_table
+
+P = ChunkerParams(avg_size=4 << 10)  # test scale: 4 KiB avg, 1 KiB min, 16 KiB max
+
+_TABLE_GOLDEN = {0: 2600206059, 1: 927838666, 128: 1044634582, 255: 2351172489}
+
+
+def _data(n: int, seed: int = 7) -> bytes:
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+def test_table_deterministic():
+    t1 = buzhash_table()
+    t2 = buzhash_table()
+    assert t1.dtype == np.uint32
+    assert np.array_equal(t1, t2)
+    assert len(np.unique(t1)) > 250
+    assert not t1.flags.writeable  # shared table must be immutable
+    # golden spot values: the table is part of the on-disk dedup format —
+    # any change here orphans every stored chunk
+    golden = {0: int(t1[0]), 1: int(t1[1]), 128: int(t1[128]), 255: int(t1[255])}
+    assert golden == _TABLE_GOLDEN, f"buzhash table drifted: {golden}"
+
+
+def test_params_validation():
+    with pytest.raises(ValueError):
+        ChunkerParams(avg_size=3000)           # not a power of two
+    with pytest.raises(ValueError):
+        ChunkerParams(avg_size=4096, min_size=16)  # min < WINDOW
+    p = ChunkerParams(avg_size=1 << 20)
+    assert p.min_size == 1 << 18 and p.max_size == 1 << 22
+    assert p.mask == (1 << 20) - 1
+
+
+def test_chunk_bounds_cover_stream():
+    data = _data(300_000)
+    bounds = chunk_bounds(data, P)
+    assert bounds[0][0] == 0
+    assert bounds[-1][1] == len(data)
+    for (s0, e0), (s1, e1) in zip(bounds, bounds[1:]):
+        assert e0 == s1
+    sizes = [e - s for s, e in bounds]
+    # all but the final chunk respect min/max
+    assert all(P.min_size <= sz <= P.max_size for sz in sizes[:-1])
+    assert sizes[-1] <= P.max_size
+    # average size in a sane band around target
+    assert P.avg_size / 4 < np.mean(sizes) < P.avg_size * 4
+    # reassembly is lossless
+    assert b"".join(data[s:e] for s, e in bounds) == data
+
+
+def test_shift_invariance_of_cuts():
+    """Content-defined property: cuts inside identical content converge
+    after one chunk even when the stream is prefixed (the dedup property)."""
+    body = _data(200_000, seed=1)
+    a = chunk_bounds(body, P)
+    prefix = _data(10_000, seed=2)
+    b = chunk_bounds(prefix + body, P)
+    # chunk hashes of the shared suffix mostly coincide
+    ha = {hashlib.sha256(body[s:e]).hexdigest() for s, e in a}
+    hb = {hashlib.sha256((prefix + body)[s:e]).hexdigest() for s, e in b}
+    assert len(ha & hb) >= len(ha) - 3
+
+
+def test_forced_cut_on_incompressible_run():
+    # constant data has (at most) one candidate hash value everywhere;
+    # with random table it's overwhelmingly non-matching → forced max cuts
+    data = b"\x00" * (P.max_size * 3 + 123)
+    bounds = chunk_bounds(data, P)
+    sizes = [e - s for s, e in bounds]
+    assert sizes[:3] == [P.max_size] * 3 or all(s <= P.max_size for s in sizes)
+    assert sum(sizes) == len(data)
+
+
+def test_streaming_matches_oneshot():
+    data = _data(500_000, seed=3)
+    want = [e for _, e in chunk_bounds(data, P)]
+    for feed_size in (1 << 12, 1 << 14, 99_991):
+        ch = CpuChunker(P)
+        got = []
+        for off in range(0, len(data), feed_size):
+            got.extend(ch.feed(data[off:off + feed_size]))
+        got.extend(ch.finalize())
+        assert got == want, f"feed_size={feed_size}"
+
+
+def test_candidates_prefix_context():
+    data = _data(100_000, seed=4)
+    split = 50_017
+    whole = candidates(data, P)
+    left = candidates(data[:split], P)
+    right = candidates(data[split:], P, prefix=data[:split], global_offset=split)
+    merged = np.concatenate([left, right])
+    assert np.array_equal(whole, merged)
+
+
+@pytest.mark.skipif(not native.available(), reason="native chunker unavailable")
+def test_native_matches_numpy():
+    data = _data(1_000_000, seed=5)
+    a = candidates(data, P, force_numpy=True)
+    b = native.candidates(data, P)
+    assert np.array_equal(a, b)
+    # with prefix context and offset
+    split = 123_457
+    b2 = native.candidates(data[split:], P, prefix=data[:split][-63:],
+                           global_offset=split)
+    a2 = candidates(data[split:], P, prefix=data[:split][-63:],
+                    global_offset=split, force_numpy=True)
+    assert np.array_equal(a2, b2)
+    whole_tail = a[a > split]
+    assert np.array_equal(b2, whole_tail)
+
+
+def test_select_cuts_streaming_equivalence():
+    # select_cuts on the full candidate list == CpuChunker incremental drain
+    data = _data(250_000, seed=6)
+    ends = candidates(data, P)
+    cuts = select_cuts(ends, len(data), P)
+    ch = CpuChunker(P)
+    inc = ch.feed(data) + ch.finalize()
+    assert inc == cuts

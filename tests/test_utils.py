@@ -1,0 +1,102 @@
+"""Foundation-layer tests (reference test analogs: mtls 396 LoC, crypto 336,
+calendar 182 — SURVEY §4)."""
+
+import datetime as dt
+import threading
+
+import pytest
+
+from pbs_plus_tpu.utils import calendar, crypto, safemap, validate
+
+
+# --- calendar ------------------------------------------------------------
+
+def test_calendar_keywords():
+    t = dt.datetime(2026, 7, 28, 13, 45, 12)
+    assert calendar.compute_next_event("hourly", t) == dt.datetime(2026, 7, 28, 14, 0, 0)
+    assert calendar.compute_next_event("daily", t) == dt.datetime(2026, 7, 29, 0, 0, 0)
+    assert calendar.compute_next_event("weekly", t) == dt.datetime(2026, 8, 3, 0, 0, 0)  # monday
+    assert calendar.compute_next_event("monthly", t) == dt.datetime(2026, 8, 1, 0, 0, 0)
+
+
+def test_calendar_time_expressions():
+    t = dt.datetime(2026, 7, 28, 13, 45, 12)
+    assert calendar.compute_next_event("21:00", t) == dt.datetime(2026, 7, 28, 21, 0, 0)
+    assert calendar.compute_next_event("06:30", t) == dt.datetime(2026, 7, 29, 6, 30, 0)
+    # every 15 minutes
+    assert calendar.compute_next_event("*:0/15", t) == dt.datetime(2026, 7, 28, 14, 0, 0)
+    nxt = calendar.compute_next_event("*:0/15", dt.datetime(2026, 7, 28, 13, 10, 0))
+    assert nxt == dt.datetime(2026, 7, 28, 13, 15, 0)
+
+
+def test_calendar_weekday():
+    t = dt.datetime(2026, 7, 28, 13, 45, 12)  # tuesday
+    assert calendar.compute_next_event("sat 03:00", t) == dt.datetime(2026, 8, 1, 3, 0, 0)
+    assert calendar.compute_next_event("mon..fri 02:00", t) == dt.datetime(2026, 7, 29, 2, 0, 0)
+    # same-day later time
+    assert calendar.compute_next_event("tue 18:00", t) == dt.datetime(2026, 7, 28, 18, 0, 0)
+
+
+def test_calendar_date_expressions():
+    t = dt.datetime(2026, 7, 28, 13, 45, 12)
+    assert calendar.compute_next_event("*-*-01 00:00:00", t) == dt.datetime(2026, 8, 1, 0, 0, 0)
+    assert calendar.compute_next_event("*-12-25 08:00", t) == dt.datetime(2026, 12, 25, 8, 0, 0)
+
+
+def test_calendar_matches_and_errors():
+    ev = calendar.parse("mon..fri 02:30")
+    assert ev.matches(dt.datetime(2026, 7, 29, 2, 30, 0))
+    assert not ev.matches(dt.datetime(2026, 8, 1, 2, 30, 0))  # saturday
+    for bad in ["", "99:99", "frob", "25:00", "*:*:*/0"]:
+        with pytest.raises(calendar.CalendarError):
+            calendar.parse(bad)
+
+
+# --- crypto --------------------------------------------------------------
+
+def test_seal_roundtrip(tmp_path):
+    key = crypto.load_or_create_key(str(tmp_path / "k"))
+    key2 = crypto.load_or_create_key(str(tmp_path / "k"))
+    assert key == key2
+    blob = crypto.seal(key, b"secret", aad=b"ctx")
+    assert crypto.unseal(key, blob, aad=b"ctx") == b"secret"
+    with pytest.raises(Exception):
+        crypto.unseal(key, blob, aad=b"wrong")
+    with pytest.raises(Exception):
+        crypto.unseal(crypto.generate_key(), blob, aad=b"ctx")
+
+
+# --- safemap -------------------------------------------------------------
+
+def test_safemap_compound_ops():
+    m = safemap.SafeMap()
+    v, loaded = m.get_or_set("a", lambda: 1)
+    assert (v, loaded) == (1, False)
+    v, loaded = m.get_or_set("a", lambda: 2)
+    assert (v, loaded) == (1, True)
+    m.compute("a", lambda old: (old or 0) + 10)
+    assert m.get("a") == 11
+    m.compute("a", lambda old: None)
+    assert "a" not in m
+
+    # concurrent increments stay consistent
+    m.set("n", 0)
+    def bump():
+        for _ in range(1000):
+            m.compute("n", lambda old: old + 1)
+    ts = [threading.Thread(target=bump) for _ in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert m.get("n") == 4000
+
+
+# --- validate ------------------------------------------------------------
+
+def test_validate_paths():
+    assert validate.safe_rel_path("a/b/c.txt") == "a/b/c.txt"
+    for bad in ["/abs", "a/../b", "a//b", ".", "a/./b", "nul\x00"]:
+        with pytest.raises(validate.ValidationError):
+            validate.safe_rel_path(bad)
+    assert validate.hostname("node-1.example.com")
+    with pytest.raises(validate.ValidationError):
+        validate.hostname("-bad-")
