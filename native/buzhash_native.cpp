@@ -39,7 +39,12 @@ int64_t pbs_buzhash_candidates(
   int64_t count = 0;
   uint32_t h = 0;
   int64_t hist = global_offset;  // bytes of stream before data[0]
-  if (hist < prefix_len) prefix_len = hist;  // cannot have more context than stream
+  if (hist < prefix_len) {
+    // more context than stream history: keep the LAST hist bytes (the ones
+    // immediately preceding data[0]) — matches the numpy backend
+    prefix += prefix_len - hist;
+    prefix_len = hist;
+  }
   // While the window is not yet full (first 64 rolls) nothing leaves it,
   // so the T[out] term must be suppressed — a zero-initialized ring would
   // otherwise inject T[0] terms that never cancel.

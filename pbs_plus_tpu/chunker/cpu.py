@@ -57,12 +57,17 @@ def candidates(data: bytes | np.ndarray, params: ChunkerParams, *,
     bit-identical — tests/test_chunker.py::test_native_matches_numpy);
     the numpy path is the always-available reference implementation.
     """
+    if len(prefix) > global_offset:
+        # context cannot exceed real stream history; keep the bytes
+        # immediately preceding data[0]
+        prefix = prefix[-global_offset:] if global_offset else prefix[:0]
     if not force_numpy and len(data) >= 1 << 16:
         from . import native
         if native.available():
             return native.candidates(
-                bytes(data) if isinstance(data, np.ndarray) else data, params,
-                prefix=bytes(prefix), global_offset=global_offset)
+                data, params,  # ndarray passes through zero-copy
+                prefix=bytes(prefix[-(WINDOW - 1):]),
+                global_offset=global_offset)
     plen = len(prefix)
     if plen >= WINDOW:
         prefix = prefix[-(WINDOW - 1):]

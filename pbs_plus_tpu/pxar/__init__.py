@@ -1,0 +1,32 @@
+"""L2 archive & dedup data plane — the tpxar format.
+
+Re-provides the capability surface of the external Go library
+``github.com/pbs-plus/pxar`` as consumed by the reference (SURVEY §2.2):
+entry model + metadata builders (``format``), content-addressed chunk store
+with dynamic indexes (``datastore``), split-archive readers/writers with
+dedup (``transfer``), and the PBS-less ``LocalStore`` session backend that
+unblocks all testing (``backupproxy``; reference test pattern at
+/root/reference/internal/pxarmount/commit_walk_test.go:21-120).
+
+The on-disk format is our own ("tpxar v1"): split archives — a metadata
+stream of msgpack-framed entries plus a payload stream of file contents —
+each CDC-chunked into a content-addressed store and described by a dynamic
+index (DIDX) of (end_offset, sha256) records.  Same architecture as PBS
+split pxar (.mpxar.didx/.ppxar.didx), clean-room layout.
+"""
+
+from .format import (
+    Entry, KIND_FILE, KIND_DIR, KIND_SYMLINK, KIND_HARDLINK, KIND_FIFO,
+    KIND_SOCKET, KIND_DEVICE, entry_from_stat,
+)
+from .datastore import ChunkStore, DynamicIndex, Datastore, SnapshotRef
+from .transfer import SessionWriter, SplitReader, DedupWriter
+from .backupproxy import LocalStore, BackupSession, PreviousBackupRef
+
+__all__ = [
+    "Entry", "KIND_FILE", "KIND_DIR", "KIND_SYMLINK", "KIND_HARDLINK",
+    "KIND_FIFO", "KIND_SOCKET", "KIND_DEVICE", "entry_from_stat",
+    "ChunkStore", "DynamicIndex", "Datastore", "SnapshotRef",
+    "SessionWriter", "SplitReader", "DedupWriter",
+    "LocalStore", "BackupSession", "PreviousBackupRef",
+]

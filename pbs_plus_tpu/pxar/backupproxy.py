@@ -1,0 +1,124 @@
+"""Backup session backends: LocalStore (PBS-less) and the session protocol.
+
+Reference capability: pxar ``backupproxy`` — ``NewPBSStore(...)`` /
+``NewLocalStore(dir, buzhashCfg, bool)`` → ``StartSession(BackupConfig)`` →
+``BackupSession.Finish``; ``PreviousBackupRef`` links incremental dedup
+(consumed at /root/reference/internal/pxarmount/commit_orchestrate.go:127-163
+and the key test fake at
+/root/reference/internal/pxarmount/commit_walk_test.go:25-37).
+
+LocalStore is the test/dev backend: a datastore directory on local disk.
+Snapshots publish atomically — writers build into a ``.tmp`` dir that is
+renamed into place at ``finish()``, so a crashed upload never leaves a
+half-snapshot visible (crash-safety rule from SURVEY §5.3).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from dataclasses import dataclass
+
+from ..chunker import ChunkerParams
+from .datastore import Datastore, SnapshotRef, format_backup_time, parse_backup_type
+from .transfer import (
+    ChunkerFactory, DedupWriter, SplitReader, _default_chunker_factory,
+    write_manifest,
+)
+
+
+@dataclass(frozen=True)
+class PreviousBackupRef:
+    ref: SnapshotRef
+
+
+class BackupSession:
+    """One backup run: exposes a DedupWriter, publishes on finish."""
+
+    def __init__(self, store: "LocalStore", ref: SnapshotRef,
+                 previous: SnapshotRef | None,
+                 chunker_factory: ChunkerFactory):
+        self.store = store
+        self.ref = ref
+        self.previous_ref = previous
+        self._prev_reader: SplitReader | None = None
+        if previous is not None:
+            self._prev_reader = SplitReader.open_snapshot(store.datastore, previous)
+        self.writer = DedupWriter(
+            store.datastore.chunks,
+            previous=self._prev_reader,
+            payload_params=store.params,
+            chunker_factory=chunker_factory,
+        )
+        self._final_dir = store.datastore.snapshot_dir(ref)
+        self._tmp_dir = self._final_dir + ".tmp"
+        if os.path.exists(self._tmp_dir):
+            shutil.rmtree(self._tmp_dir)
+        os.makedirs(self._tmp_dir)
+        self._done = False
+
+    @property
+    def previous_reader(self) -> SplitReader | None:
+        return self._prev_reader
+
+    def finish(self, extra_manifest: dict | None = None) -> dict:
+        """Flush writers, write indexes + manifest, publish atomically."""
+        if self._done:
+            raise RuntimeError("session already finished")
+        self._done = True
+        midx, pidx, stats = self.writer.finish()
+        ds = self.store.datastore
+        midx.write(os.path.join(self._tmp_dir, ds.META_IDX))
+        pidx.write(os.path.join(self._tmp_dir, ds.PAYLOAD_IDX))
+        manifest = write_manifest(
+            os.path.join(self._tmp_dir, ds.MANIFEST),
+            ref=self.ref, midx=midx, pidx=pidx, stats=stats,
+            payload_params=self.store.params,
+            entry_count=self.writer.entry_count,
+            previous=str(self.previous_ref) if self.previous_ref else None,
+            extra=extra_manifest,
+        )
+        os.makedirs(os.path.dirname(self._final_dir), exist_ok=True)
+        os.replace(self._tmp_dir, self._final_dir)
+        return manifest
+
+    def abort(self) -> None:
+        if not self._done:
+            self._done = True
+            shutil.rmtree(self._tmp_dir, ignore_errors=True)
+
+
+class LocalStore:
+    """PBS-less datastore-backed session source (reference:
+    backupproxy.NewLocalStore)."""
+
+    def __init__(self, base_dir: str, params: ChunkerParams, *,
+                 chunker_factory: ChunkerFactory = _default_chunker_factory):
+        self.datastore = Datastore(base_dir)
+        self.params = params
+        self._chunker_factory = chunker_factory
+
+    def start_session(self, *, backup_type: str, backup_id: str,
+                      backup_time: float | None = None,
+                      previous: SnapshotRef | PreviousBackupRef | None = None,
+                      auto_previous: bool = True) -> BackupSession:
+        """Open a session.  ``previous`` enables ref-dedup against that
+        snapshot; by default the latest snapshot of the same group is used.
+        Same-second collisions bump the timestamp +1 s (reference behavior,
+        /root/reference/internal/pxarmount/commit_orchestrate.go: same-second
+        commits bump timestamp)."""
+        parse_backup_type(backup_type)
+        if isinstance(previous, PreviousBackupRef):
+            previous = previous.ref
+        if previous is None and auto_previous:
+            previous = self.datastore.last_snapshot(backup_type, backup_id)
+        t = backup_time if backup_time is not None else time.time()
+        ref = SnapshotRef(backup_type, backup_id, format_backup_time(t))
+        while os.path.exists(self.datastore.snapshot_dir(ref)):
+            t += 1.0
+            ref = SnapshotRef(backup_type, backup_id, format_backup_time(t))
+        return BackupSession(self, ref, previous, self._chunker_factory)
+
+    def open_snapshot(self, ref: SnapshotRef, **kw) -> SplitReader:
+        return SplitReader.open_snapshot(self.datastore, ref, **kw)

@@ -1,0 +1,305 @@
+"""Content-addressed chunk store + dynamic indexes + snapshot layout.
+
+Reference capability: pxar ``datastore`` sub-package — ``NewChunkStore``,
+``ParseDynamicIndex`` (DIDX), ``ParseBackupType`` (consumed at
+/root/reference/internal/pxar/format.go:101-106 and
+/root/reference/internal/pxarmount/commit_orchestrate.go:122,218-222).
+
+Layout (PBS-compatible in spirit, clean-room):
+
+    <store>/.chunks/<hex[:4]>/<hex>       zstd-compressed chunks
+    <store>/<type>/<id>/<rfc3339-time>/   snapshot dir:
+        root.midx                         metadata-stream dynamic index
+        root.pidx                         payload-stream dynamic index
+        manifest.json                     snapshot manifest + stats
+
+DIDX binary format (``TPXD``): magic(4) ver(u16) reserved(2) uuid(16)
+ctime_ns(u64) count(u64), then count records of end_offset(u64)+sha256(32).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import hashlib
+import json
+import os
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Iterator
+
+import numpy as np
+import zstandard
+
+DIDX_MAGIC = b"TPXD"
+DIDX_VERSION = 1
+_HDR = struct.Struct("<4sHH16sQQ")
+_REC_DTYPE = np.dtype([("end", "<u8"), ("digest", "V32")])
+
+BACKUP_TYPES = ("host", "vm", "ct")
+
+
+def parse_backup_type(s: str) -> str:
+    if s not in BACKUP_TYPES:
+        raise ValueError(f"invalid backup type {s!r} (want one of {BACKUP_TYPES})")
+    return s
+
+
+def format_backup_time(t: float | _dt.datetime) -> str:
+    if isinstance(t, (int, float)):
+        t = _dt.datetime.fromtimestamp(t, _dt.timezone.utc)
+    return t.astimezone(_dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+class ChunkStore:
+    """sha256-addressed chunk files, zstd-compressed, atomic insert.
+
+    Reference: datastore.NewChunkStore(path).  GC is mark-and-sweep via
+    atime touch (PBS model): ``touch`` on reuse, ``sweep(before)`` removes
+    chunks untouched since a mark time.
+    """
+
+    def __init__(self, base: str, *, compression_level: int = 3):
+        self.base = os.path.join(base, ".chunks")
+        os.makedirs(self.base, exist_ok=True)
+        self._cctx = zstandard.ZstdCompressor(level=compression_level)
+        self._dctx = zstandard.ZstdDecompressor()
+        self._lock = threading.Lock()
+
+    def _path(self, digest: bytes) -> str:
+        h = digest.hex()
+        return os.path.join(self.base, h[:4], h)
+
+    def has(self, digest: bytes) -> bool:
+        return os.path.exists(self._path(digest))
+
+    def insert(self, digest: bytes, data: bytes) -> bool:
+        """Store a chunk; returns True if it was new.  Verifies the digest
+        (corrupt-write containment)."""
+        p = self._path(digest)
+        if os.path.exists(p):
+            self.touch(digest)
+            return False
+        if hashlib.sha256(data).digest() != digest:
+            raise ValueError("chunk digest mismatch on insert")
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = f"{p}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(self._cctx.compress(data))
+        os.replace(tmp, p)
+        return True
+
+    def get(self, digest: bytes) -> bytes:
+        with open(self._path(digest), "rb") as f:
+            data = self._dctx.decompress(f.read(), max_output_size=1 << 30)
+        if hashlib.sha256(data).digest() != digest:
+            raise IOError(f"chunk {digest.hex()} corrupt on disk")
+        return data
+
+    def touch(self, digest: bytes) -> None:
+        try:
+            os.utime(self._path(digest))
+        except OSError:
+            pass
+
+    def chunk_size(self, digest: bytes) -> int:
+        return os.path.getsize(self._path(digest))
+
+    def iter_digests(self) -> Iterator[bytes]:
+        for sub in sorted(os.listdir(self.base)):
+            d = os.path.join(self.base, sub)
+            if not os.path.isdir(d):
+                continue
+            for name in sorted(os.listdir(d)):
+                if len(name) == 64:
+                    yield bytes.fromhex(name)
+
+    def sweep(self, before: float) -> int:
+        """Remove chunks with atime/mtime older than ``before``; returns
+        count removed.  Caller is responsible for having touched all live
+        chunks after the mark (GC phase 1)."""
+        removed = 0
+        for sub in os.listdir(self.base):
+            d = os.path.join(self.base, sub)
+            if not os.path.isdir(d):
+                continue
+            for name in os.listdir(d):
+                p = os.path.join(d, name)
+                try:
+                    st = os.stat(p)
+                    if max(st.st_atime, st.st_mtime) < before:
+                        os.unlink(p)
+                        removed += 1
+                except OSError:
+                    continue
+        return removed
+
+
+class DynamicIndex:
+    """Dynamic index: sorted (end_offset, digest) records over a stream.
+
+    Reference: datastore.ParseDynamicIndex (DIDX).
+    """
+
+    def __init__(self, ends: np.ndarray, digests: np.ndarray,
+                 uuid: bytes = b"\0" * 16, ctime_ns: int = 0):
+        assert ends.dtype == np.uint64 and len(ends) == len(digests)
+        self.ends = ends                  # cumulative end offsets, ascending
+        self.digests = digests            # (n, 32) uint8
+        self.uuid = uuid
+        self.ctime_ns = ctime_ns
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def from_records(cls, records: list[tuple[int, bytes]],
+                     uuid: bytes = b"", ctime_ns: int = 0) -> "DynamicIndex":
+        ends = np.array([r[0] for r in records], dtype=np.uint64)
+        digs = np.frombuffer(b"".join(r[1] for r in records),
+                             dtype=np.uint8).reshape(-1, 32) if records else \
+            np.empty((0, 32), dtype=np.uint8)
+        if len(ends) and not np.all(np.diff(ends.astype(np.int64)) > 0):
+            raise ValueError("index end offsets must be strictly increasing")
+        return cls(ends, digs, uuid or os.urandom(16), ctime_ns)
+
+    # -- properties -------------------------------------------------------
+    @property
+    def total_size(self) -> int:
+        return int(self.ends[-1]) if len(self.ends) else 0
+
+    def __len__(self) -> int:
+        return len(self.ends)
+
+    def chunk_bounds(self, i: int) -> tuple[int, int]:
+        start = int(self.ends[i - 1]) if i > 0 else 0
+        return start, int(self.ends[i])
+
+    def digest(self, i: int) -> bytes:
+        return self.digests[i].tobytes()
+
+    def chunk_for_offset(self, offset: int) -> int:
+        """Index of the chunk containing stream offset (0 <= off < total)."""
+        if offset < 0 or offset >= self.total_size:
+            raise IndexError(f"offset {offset} outside stream")
+        return int(np.searchsorted(self.ends, offset, side="right"))
+
+    def chunks_overlapping(self, start: int, end: int) -> Iterator[int]:
+        if start >= end:
+            return
+        i = self.chunk_for_offset(start)
+        while i < len(self.ends) and (int(self.ends[i - 1]) if i else 0) < end:
+            yield i
+            i += 1
+
+    def records(self) -> Iterator[tuple[int, int, bytes]]:
+        """Yields (start, end, digest) per chunk."""
+        prev = 0
+        for i in range(len(self.ends)):
+            e = int(self.ends[i])
+            yield prev, e, self.digests[i].tobytes()
+            prev = e
+
+    # -- io ---------------------------------------------------------------
+    def write(self, path: str) -> None:
+        arr = np.empty(len(self.ends), dtype=_REC_DTYPE)
+        arr["end"] = self.ends
+        arr["digest"] = np.ascontiguousarray(self.digests).view(
+            np.dtype("V32")).reshape(-1)
+        hdr = _HDR.pack(DIDX_MAGIC, DIDX_VERSION, 0, self.uuid,
+                        self.ctime_ns, len(self.ends))
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(hdr)
+            f.write(arr.tobytes())
+        os.replace(tmp, path)
+
+    @classmethod
+    def parse(cls, path: str) -> "DynamicIndex":
+        with open(path, "rb") as f:
+            hdr = f.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                raise ValueError(f"{path}: truncated index header")
+            magic, ver, _, uuid, ctime_ns, count = _HDR.unpack(hdr)
+            if magic != DIDX_MAGIC:
+                raise ValueError(f"{path}: bad index magic {magic!r}")
+            if ver != DIDX_VERSION:
+                raise ValueError(f"{path}: unsupported index version {ver}")
+            raw = f.read(count * _REC_DTYPE.itemsize)
+        if len(raw) < count * _REC_DTYPE.itemsize:
+            raise ValueError(f"{path}: truncated index records")
+        arr = np.frombuffer(raw, dtype=_REC_DTYPE)
+        ends = arr["end"].astype(np.uint64)
+        digs = np.frombuffer(arr["digest"].tobytes(), dtype=np.uint8).reshape(-1, 32)
+        if len(ends) and not np.all(np.diff(ends.astype(np.int64)) > 0):
+            raise ValueError(f"{path}: non-monotonic index")
+        return cls(ends, digs, uuid, ctime_ns)
+
+
+@dataclass(frozen=True)
+class SnapshotRef:
+    backup_type: str
+    backup_id: str
+    backup_time: str           # rfc3339 UTC
+
+    @property
+    def rel_dir(self) -> str:
+        return f"{self.backup_type}/{self.backup_id}/{self.backup_time}"
+
+    def __str__(self) -> str:
+        return self.rel_dir
+
+
+class Datastore:
+    """Snapshot directory layout + listing over a ChunkStore.
+
+    Reference: the PBS datastore dir structure the pxar lib reads/writes
+    (snapshot dirs with didx files + manifest).
+    """
+
+    META_IDX = "root.midx"
+    PAYLOAD_IDX = "root.pidx"
+    MANIFEST = "manifest.json"
+
+    def __init__(self, base: str):
+        self.base = base
+        os.makedirs(base, exist_ok=True)
+        self.chunks = ChunkStore(base)
+
+    def snapshot_dir(self, ref: SnapshotRef) -> str:
+        return os.path.join(self.base, ref.rel_dir)
+
+    def list_snapshots(self, backup_type: str | None = None,
+                       backup_id: str | None = None) -> list[SnapshotRef]:
+        out: list[SnapshotRef] = []
+        types = [backup_type] if backup_type else [
+            t for t in BACKUP_TYPES if os.path.isdir(os.path.join(self.base, t))]
+        for t in types:
+            tdir = os.path.join(self.base, t)
+            if not os.path.isdir(tdir):
+                continue
+            ids = [backup_id] if backup_id else sorted(os.listdir(tdir))
+            for bid in ids:
+                iddir = os.path.join(tdir, bid)
+                if not os.path.isdir(iddir):
+                    continue
+                for ts in sorted(os.listdir(iddir)):
+                    snap = os.path.join(iddir, ts)
+                    if os.path.exists(os.path.join(snap, self.MANIFEST)):
+                        out.append(SnapshotRef(t, bid, ts))
+        return out
+
+    def last_snapshot(self, backup_type: str, backup_id: str) -> SnapshotRef | None:
+        snaps = self.list_snapshots(backup_type, backup_id)
+        return snaps[-1] if snaps else None
+
+    def load_manifest(self, ref: SnapshotRef) -> dict:
+        with open(os.path.join(self.snapshot_dir(ref), self.MANIFEST)) as f:
+            return json.load(f)
+
+    def load_indexes(self, ref: SnapshotRef) -> tuple[DynamicIndex, DynamicIndex]:
+        d = self.snapshot_dir(ref)
+        return (DynamicIndex.parse(os.path.join(d, self.META_IDX)),
+                DynamicIndex.parse(os.path.join(d, self.PAYLOAD_IDX)))
+
+    def remove_snapshot(self, ref: SnapshotRef) -> None:
+        import shutil
+        shutil.rmtree(self.snapshot_dir(ref), ignore_errors=True)

@@ -1,0 +1,508 @@
+"""Split-archive writers and readers with dedup.
+
+Reference capability: pxar ``transfer`` sub-package —
+``NewSplitReader(metaBytes, payloadBytes, chunkSource)`` with per-reader
+chunk caches, ``NewSessionWriter``, ``NewRemoteDedupWriter`` with
+``Begin/WriteEntry/WriteEntryRef/WriteEntryReader/BeginDirectory/
+EndDirectory/Finish`` (consumed at
+/root/reference/internal/pxar/format.go:108-126 and
+/root/reference/internal/pxarmount/commit_walk.go:221,296-302,449-479).
+
+Design notes:
+
+- The payload DIDX is just (end_offset, digest) records — chunk boundaries
+  are wherever the writer says.  CDC boundaries matter only for dedup
+  quality of *new* data, so the writer freely interleaves CDC-chunked
+  streams with whole reused chunks from a previous snapshot (forcing a cut
+  at each switch).  This is the clean-room equivalent of the reference's
+  WriteEntryRef reuse path, including its payload-offset-monotonicity rule:
+  consecutive in-order refs coalesce into runs whose interior chunks are
+  reused without IO, while out-of-order or unaligned refs degrade to
+  re-encoding the boundary bytes (the reference's re-encode fallback,
+  /root/reference/internal/pxarmount/commit_walk.go:449-463).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Iterator
+
+from ..chunker import ChunkerParams, CpuChunker
+from .datastore import ChunkStore, Datastore, DynamicIndex, SnapshotRef
+from .format import Entry, KIND_DIR, KIND_FILE, decode_entries
+
+ChunkerFactory = Callable[[ChunkerParams], object]
+
+
+def _default_chunker_factory(params: ChunkerParams):
+    return CpuChunker(params)
+
+
+@dataclass
+class WriterStats:
+    new_chunks: int = 0
+    known_chunks: int = 0          # CDC-produced but already in store
+    ref_chunks: int = 0            # reused by reference without IO
+    bytes_streamed: int = 0        # bytes that went through the chunker
+    bytes_reffed: int = 0          # bytes covered by reused chunks
+    bytes_reencoded: int = 0       # ref boundary bytes that were re-read
+
+    def merge(self, other: "WriterStats") -> None:
+        for f in self.__dataclass_fields__:
+            setattr(self, f, getattr(self, f) + getattr(other, f))
+
+
+class _ChunkedStream:
+    """CDC-chunked stream writer over a ChunkStore: ``write`` feeds the
+    chunker, ``append_ref`` splices an existing chunk, ``finish`` returns
+    the DynamicIndex records."""
+
+    def __init__(self, store: ChunkStore, params: ChunkerParams,
+                 chunker_factory: ChunkerFactory = _default_chunker_factory):
+        self.store = store
+        self.params = params
+        self._factory = chunker_factory
+        self._chunker = chunker_factory(params)
+        self._buf = bytearray()
+        self._buf_base = 0          # stream offset of _buf[0]
+        self._run_base = 0          # stream offset where current chunker run began
+        self.offset = 0             # total stream bytes accepted
+        self.records: list[tuple[int, bytes]] = []   # (end_offset, digest)
+        self.stats = WriterStats()
+
+    def write(self, data: bytes) -> None:
+        if not data:
+            return
+        self._buf += data
+        self.offset += len(data)
+        self.stats.bytes_streamed += len(data)
+        cuts = self._chunker.feed(data)
+        self._emit(cuts)
+
+    def _emit(self, run_relative_cuts: list[int]) -> None:
+        for rc in run_relative_cuts:
+            end = self._run_base + rc
+            self._emit_chunk(end)
+
+    def _emit_chunk(self, end: int) -> None:
+        start = self._buf_base
+        n = end - start
+        chunk = bytes(self._buf[:n])
+        del self._buf[:n]
+        self._buf_base = end
+        digest = hashlib.sha256(chunk).digest()
+        if self.store.insert(digest, chunk):
+            self.stats.new_chunks += 1
+        else:
+            self.stats.known_chunks += 1
+        self.records.append((end, digest))
+
+    def flush_chunker(self) -> None:
+        """Force a cut at the current offset and restart the chunker."""
+        cuts = self._chunker.finalize()
+        self._emit(cuts)
+        assert self._buf_base == self.offset and not self._buf
+        self._chunker = self._factory(self.params)
+        self._run_base = self.offset
+
+    def append_ref(self, digest: bytes, size: int) -> None:
+        """Splice an existing store chunk at the current offset (no IO)."""
+        if self._buf:
+            self.flush_chunker()
+        self.offset += size
+        self._buf_base = self.offset
+        # restart the chunker after the spliced region — its window never
+        # spans a splice seam, keeping cuts deterministic per segment run
+        self._chunker = self._factory(self.params)
+        self._run_base = self.offset
+        self.records.append((self.offset, digest))
+        self.stats.ref_chunks += 1
+        self.stats.bytes_reffed += size
+        self.store.touch(digest)
+
+    def finish(self) -> list[tuple[int, bytes]]:
+        if self._buf:
+            self.flush_chunker()
+        return self.records
+
+
+class SessionWriter:
+    """Builds a tpxar split archive: entries in sorted-path order, file
+    contents streamed into the payload stream.  The test/golden-archive
+    writer (reference: transfer.NewSessionWriter,
+    /root/reference/internal/pxarmount/commit_walk_test.go:21-120)."""
+
+    def __init__(self, store: ChunkStore, *,
+                 payload_params: ChunkerParams,
+                 meta_params: ChunkerParams | None = None,
+                 chunker_factory: ChunkerFactory = _default_chunker_factory):
+        self.store = store
+        self.payload_params = payload_params
+        self.meta_params = meta_params or ChunkerParams(
+            avg_size=max(1024, min(payload_params.avg_size, 128 << 10)))
+        self.meta = _ChunkedStream(store, self.meta_params, chunker_factory)
+        self.payload = _ChunkedStream(store, payload_params, chunker_factory)
+        self._last_path: str | None = None
+        self._entries = 0
+        self._finished = False
+
+    # -- entry emission ---------------------------------------------------
+    @staticmethod
+    def _path_key(path: str) -> tuple[str, ...]:
+        # DFS order: compare path *components*, so a directory's subtree is
+        # contiguous ("foo/bar" sorts before sibling file "foo.txt")
+        return tuple(path.split("/")) if path else ()
+
+    def _check_order(self, entry: Entry) -> None:
+        if self._last_path is not None and \
+                self._path_key(entry.path) <= self._path_key(self._last_path):
+            raise ValueError(
+                f"entries must be in strict DFS path order: "
+                f"{entry.path!r} after {self._last_path!r}")
+        self._last_path = entry.path
+
+    def write_entry(self, entry: Entry) -> None:
+        """Metadata-only entry (dir, symlink, empty file, special)."""
+        self._check_order(entry)
+        if entry.kind == KIND_FILE and entry.size:
+            raise ValueError("file with content must use write_entry_reader")
+        self.meta.write(entry.encode())
+        self._entries += 1
+
+    def write_entry_reader(self, entry: Entry, reader: io.RawIOBase | io.BufferedIOBase,
+                           *, bufsize: int = 4 << 20) -> bytes:
+        """File entry with content streamed from ``reader``.  Returns the
+        whole-file sha256 (also stored in the entry for verification)."""
+        self._check_order(entry)
+        entry.payload_offset = self.payload.offset
+        h = hashlib.sha256()
+        total = 0
+        while True:
+            block = reader.read(bufsize)
+            if not block:
+                break
+            h.update(block)
+            self.payload.write(block)
+            total += len(block)
+        entry.size = total
+        entry.digest = h.digest()
+        self.meta.write(entry.encode())
+        self._entries += 1
+        return entry.digest
+
+    def write_entry_bytes(self, entry: Entry, data: bytes) -> bytes:
+        return self.write_entry_reader(entry, io.BytesIO(data))
+
+    # dir markers for reference-API parity; flat sorted entries carry full
+    # paths so these only validate nesting
+    def begin_directory(self, entry: Entry) -> None:
+        if entry.kind != KIND_DIR:
+            raise ValueError("begin_directory needs a dir entry")
+        self.write_entry(entry)
+
+    def end_directory(self) -> None:
+        pass
+
+    # -- finish -----------------------------------------------------------
+    def finish(self) -> tuple[DynamicIndex, DynamicIndex, WriterStats]:
+        if self._finished:
+            raise RuntimeError("writer already finished")
+        self._finished = True
+        now_ns = time.time_ns()
+        midx = DynamicIndex.from_records(self.meta.finish(), ctime_ns=now_ns)
+        pidx = DynamicIndex.from_records(self.payload.finish(), ctime_ns=now_ns)
+        stats = WriterStats()
+        stats.merge(self.meta.stats)
+        stats.merge(self.payload.stats)
+        return midx, pidx, stats
+
+    @property
+    def entry_count(self) -> int:
+        return self._entries
+
+
+class DedupWriter(SessionWriter):
+    """SessionWriter + incremental reuse against a previous snapshot
+    (reference: transfer.NewRemoteDedupWriter with PreviousBackupRef,
+    /root/reference/internal/pxarmount/commit_orchestrate.go:177-200)."""
+
+    def __init__(self, store: ChunkStore, *, previous: "SplitReader | None",
+                 payload_params: ChunkerParams,
+                 meta_params: ChunkerParams | None = None,
+                 chunker_factory: ChunkerFactory = _default_chunker_factory):
+        super().__init__(store, payload_params=payload_params,
+                         meta_params=meta_params, chunker_factory=chunker_factory)
+        self.previous = previous
+        # pending coalesced old-payload range [A, B) and the new-stream
+        # offset N0 where it will land
+        self._pend_a = self._pend_b = -1
+        self._pend_entries: list[tuple[Entry, int]] = []  # (entry, old offset)
+
+    def write_entry_ref(self, entry: Entry, old_payload_offset: int,
+                        size: int) -> None:
+        """Reference an unchanged file's content range in the previous
+        archive's payload stream.  In-order contiguous refs coalesce; any
+        other pattern flushes and re-encodes only boundary bytes."""
+        if self.previous is None:
+            raise RuntimeError("write_entry_ref without previous snapshot")
+        self._check_order(entry)
+        a, b = old_payload_offset, old_payload_offset + size
+        if b > self.previous.payload_index.total_size or a < 0:
+            raise ValueError("ref range outside previous payload stream")
+        if self._pend_b == a and self._pend_a >= 0:
+            self._pend_b = b                      # coalesce contiguous run
+        else:
+            self._flush_refs()
+            self._pend_a, self._pend_b = a, b
+        entry.size = size
+        self._pend_entries.append((entry, a))
+        self._entries += 1
+
+    def write_entry(self, entry: Entry) -> None:
+        self._flush_refs()
+        super().write_entry(entry)
+
+    def write_entry_reader(self, entry: Entry, reader, *, bufsize: int = 4 << 20) -> bytes:
+        self._flush_refs()
+        return super().write_entry_reader(entry, reader, bufsize=bufsize)
+
+    def _flush_refs(self) -> None:
+        if self._pend_a < 0:
+            return
+        a, b = self._pend_a, self._pend_b
+        prev = self.previous
+        assert prev is not None
+        pidx = prev.payload_index
+        # force a chunk boundary before splicing
+        if self.payload._buf:
+            self.payload.flush_chunker()
+        n0 = self.payload.offset
+        pos = a
+        for ci in pidx.chunks_overlapping(a, b):
+            cs, ce = pidx.chunk_bounds(ci)
+            if cs >= a and ce <= b:
+                # whole chunk inside the range → splice without IO
+                if pos < cs:
+                    raise AssertionError("gap in ref coverage")
+                self.payload.append_ref(pidx.digest(ci), ce - cs)
+                pos = ce
+            else:
+                # boundary chunk → re-encode just the overlapping bytes
+                lo, hi = max(cs, a), min(ce, b)
+                data = prev.read_payload(lo, hi - lo)
+                self.payload.write(data)
+                self.payload.stats.bytes_reencoded += hi - lo
+                pos = hi
+        if pos != b:
+            raise AssertionError("ref flush did not cover range")
+        # emit the pending entries with their new payload offsets
+        for entry, old_a in self._pend_entries:
+            entry.payload_offset = n0 + (old_a - a)
+            self.meta.write(entry.encode())
+        self._pend_entries.clear()
+        self._pend_a = self._pend_b = -1
+
+    def finish(self):
+        self._flush_refs()
+        return super().finish()
+
+
+class _LRUCache:
+    """Byte-budgeted LRU of decompressed chunks (reference: per-reader chunk
+    caches, vfs.NewLocalFS(reader).SetMaxCache)."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._d: OrderedDict[bytes, bytes] = OrderedDict()
+        self._size = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: bytes) -> bytes | None:
+        v = self._d.get(key)
+        if v is not None:
+            self._d.move_to_end(key)
+            self.hits += 1
+        else:
+            self.misses += 1
+        return v
+
+    def put(self, key: bytes, value: bytes) -> None:
+        if key in self._d:
+            return
+        self._d[key] = value
+        self._size += len(value)
+        while self._size > self.max_bytes and self._d:
+            _, old = self._d.popitem(last=False)
+            self._size -= len(old)
+
+
+class SplitReader:
+    """Random-access reader over a (meta_didx, payload_didx, chunk store)
+    triple (reference: transfer.NewSplitReader,
+    /root/reference/internal/pxar/format.go:108-126)."""
+
+    def __init__(self, meta_index: DynamicIndex, payload_index: DynamicIndex,
+                 store: ChunkStore, *, max_cache_bytes: int = 256 << 20):
+        self.meta_index = meta_index
+        self.payload_index = payload_index
+        self.store = store
+        self._cache = _LRUCache(max_cache_bytes)
+        self._tree: dict[str, Entry] | None = None
+        self._children: dict[str, list[str]] | None = None
+
+    # -- low-level stream reads ------------------------------------------
+    def _read_stream(self, index: DynamicIndex, offset: int, size: int) -> bytes:
+        if size <= 0:
+            return b""
+        end = min(offset + size, index.total_size)
+        if offset >= end:
+            return b""
+        parts: list[bytes] = []
+        for ci in index.chunks_overlapping(offset, end):
+            cs, ce = index.chunk_bounds(ci)
+            digest = index.digest(ci)
+            data = self._cache.get(digest)
+            if data is None:
+                data = self.store.get(digest)
+                self._cache.put(digest, data)
+            lo, hi = max(cs, offset), min(ce, end)
+            parts.append(data[lo - cs:hi - cs])
+        return b"".join(parts)
+
+    def read_payload(self, offset: int, size: int) -> bytes:
+        return self._read_stream(self.payload_index, offset, size)
+
+    def read_meta(self, offset: int, size: int) -> bytes:
+        return self._read_stream(self.meta_index, offset, size)
+
+    # -- entries ----------------------------------------------------------
+    def entries(self) -> Iterator[Entry]:
+        """Stream all entries in archive (sorted-path) order."""
+        stream = _StreamIO(self, self.meta_index)
+        yield from decode_entries(stream)
+
+    def _load_tree(self) -> None:
+        if self._tree is not None:
+            return
+        tree: dict[str, Entry] = {}
+        children: dict[str, list[str]] = {}
+        for e in self.entries():
+            tree[e.path] = e
+            if e.path:
+                parent = e.path.rsplit("/", 1)[0] if "/" in e.path else ""
+                children.setdefault(parent, []).append(e.path)
+            children.setdefault(e.path, []) if e.is_dir else None
+        self._tree = tree
+        self._children = children
+
+    def lookup(self, path: str) -> Entry | None:
+        self._load_tree()
+        assert self._tree is not None
+        return self._tree.get(path.strip("/"))
+
+    def read_dir(self, path: str) -> list[Entry]:
+        self._load_tree()
+        assert self._tree is not None and self._children is not None
+        key = path.strip("/")
+        if key and key not in self._tree:
+            raise FileNotFoundError(path)
+        return [self._tree[p] for p in sorted(self._children.get(key, []))]
+
+    def read_file(self, entry: Entry, offset: int = 0, size: int = -1) -> bytes:
+        if not entry.is_file:
+            raise IsADirectoryError(entry.path)
+        if entry.size == 0 or entry.payload_offset < 0:
+            return b""
+        if size < 0:
+            size = entry.size - offset
+        size = max(0, min(size, entry.size - offset))
+        return self.read_payload(entry.payload_offset + offset, size)
+
+    @property
+    def cache_stats(self) -> tuple[int, int]:
+        return self._cache.hits, self._cache.misses
+
+    # -- construction helpers --------------------------------------------
+    @classmethod
+    def open_snapshot(cls, ds: Datastore, ref: SnapshotRef,
+                      *, max_cache_bytes: int = 256 << 20) -> "SplitReader":
+        midx, pidx = ds.load_indexes(ref)
+        return cls(midx, pidx, ds.chunks, max_cache_bytes=max_cache_bytes)
+
+
+class _StreamIO(io.RawIOBase):
+    """Sequential file-like view of an indexed stream (for decode_entries)."""
+
+    def __init__(self, reader: SplitReader, index: DynamicIndex,
+                 bufsize: int = 4 << 20):
+        self._r = reader
+        self._idx = index
+        self._pos = 0
+        self._buf = b""
+        self._buf_off = 0
+        self._bufsize = bufsize
+
+    def read(self, n: int = -1) -> bytes:
+        total = self._idx.total_size
+        if n < 0:
+            n = total - self._pos
+        out = bytearray()
+        while n > 0 and self._pos < total:
+            rel = self._pos - self._buf_off
+            if 0 <= rel < len(self._buf):
+                take = min(n, len(self._buf) - rel)
+                out += self._buf[rel:rel + take]
+                self._pos += take
+                n -= take
+                continue
+            self._buf_off = self._pos
+            self._buf = self._r._read_stream(
+                self._idx, self._pos, max(self._bufsize, n))
+        return bytes(out)
+
+
+def write_manifest(path: str, *, ref: SnapshotRef, midx: DynamicIndex,
+                   pidx: DynamicIndex, stats: WriterStats,
+                   payload_params: ChunkerParams, entry_count: int,
+                   previous: str | None = None, extra: dict | None = None) -> dict:
+    manifest = {
+        "format": "tpxar-v1",
+        "backup_type": ref.backup_type,
+        "backup_id": ref.backup_id,
+        "backup_time": ref.backup_time,
+        "previous": previous,
+        "entries": entry_count,
+        "meta_size": midx.total_size,
+        "payload_size": pidx.total_size,
+        "meta_chunks": len(midx),
+        "payload_chunks": len(pidx),
+        "chunker": {
+            "avg": payload_params.avg_size,
+            "min": payload_params.min_size,
+            "max": payload_params.max_size,
+            "seed": payload_params.seed,
+        },
+        "stats": {
+            "new_chunks": stats.new_chunks,
+            "known_chunks": stats.known_chunks,
+            "ref_chunks": stats.ref_chunks,
+            "bytes_streamed": stats.bytes_streamed,
+            "bytes_reffed": stats.bytes_reffed,
+            "bytes_reencoded": stats.bytes_reencoded,
+        },
+        "created_unix": int(time.time()),
+    }
+    if extra:
+        manifest.update(extra)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return manifest

@@ -1,0 +1,109 @@
+"""Filesystem → archive walker: DFS in archive order with content readers.
+
+Reference capability: the scan/walk phase of the commit pipeline and the
+proxmox-backup-client's own tree walker (our build owns the archive writer —
+SURVEY §2.9: no exec of the PBS client).  Used by the local backup path and
+by tests to build golden archives from real trees.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterator
+
+from .format import Entry, KIND_HARDLINK, entry_from_stat
+
+ExcludeFn = Callable[[str], bool]
+
+
+def iter_tree(root: str, *, exclude: ExcludeFn | None = None,
+              one_file_system: bool = False,
+              on_error: Callable[[str, OSError], None] | None = None,
+              ) -> Iterator[tuple[Entry, str | None]]:
+    """Yield (entry, abs_source_path|None) in strict DFS archive order.
+
+    - entries carry archive-relative paths ("" for the root dir)
+    - hardlinks (same dev/inode seen twice) become KIND_HARDLINK entries
+      pointing at the first-seen path (reference: internal/pxar/hardlink.go)
+    - ``exclude`` receives the archive-relative path; True skips (dirs are
+      pruned whole)
+    - unreadable entries are reported via ``on_error`` and skipped
+    """
+    root = os.path.abspath(root)
+    st_root = os.stat(root)
+    root_dev = st_root.st_dev
+    seen_inodes: dict[tuple[int, int], str] = {}
+
+    yield entry_from_stat("", st_root), None
+
+    def walk(dir_abs: str, dir_rel: str) -> Iterator[tuple[Entry, str | None]]:
+        try:
+            names = sorted(os.listdir(dir_abs))
+        except OSError as e:
+            if on_error:
+                on_error(dir_rel, e)
+            return
+        for name in names:
+            abs_p = os.path.join(dir_abs, name)
+            rel_p = f"{dir_rel}/{name}" if dir_rel else name
+            if exclude and exclude(rel_p):
+                continue
+            try:
+                st = os.lstat(abs_p)
+            except OSError as e:
+                if on_error:
+                    on_error(rel_p, e)
+                continue
+            if one_file_system and st.st_dev != root_dev:
+                continue
+            import stat as statmod
+            if statmod.S_ISLNK(st.st_mode):
+                try:
+                    target = os.readlink(abs_p)
+                except OSError as e:
+                    if on_error:
+                        on_error(rel_p, e)
+                    continue
+                yield entry_from_stat(rel_p, st, link_target=target), None
+            elif statmod.S_ISDIR(st.st_mode):
+                yield entry_from_stat(rel_p, st), None
+                yield from walk(abs_p, rel_p)
+            elif statmod.S_ISREG(st.st_mode):
+                key = (st.st_dev, st.st_ino)
+                if st.st_nlink > 1 and key in seen_inodes:
+                    e = entry_from_stat(rel_p, st)
+                    e.kind = KIND_HARDLINK
+                    e.link_target = seen_inodes[key]
+                    e.size = 0
+                    yield e, None
+                else:
+                    if st.st_nlink > 1:
+                        seen_inodes[key] = rel_p
+                    yield entry_from_stat(rel_p, st), abs_p
+            else:
+                # fifo / socket / device — metadata only
+                yield entry_from_stat(rel_p, st), None
+
+    yield from walk(root, "")
+
+
+def backup_tree(session, root: str, *, exclude: ExcludeFn | None = None,
+                on_error=None) -> int:
+    """Stream a directory tree into a BackupSession's writer.  Returns the
+    number of entries written.  (The minimal end-to-end slice's local-target
+    path; the agent path streams the same entries over aRPC.)"""
+    w = session.writer
+    n = 0
+    for entry, src in iter_tree(root, exclude=exclude, on_error=on_error):
+        if src is not None:
+            try:
+                with open(src, "rb") as f:
+                    w.write_entry_reader(entry, f)
+            except OSError as e:
+                if on_error:
+                    on_error(entry.path, e)
+                continue
+        else:
+            w.write_entry(entry)
+        n += 1
+    return n

@@ -42,8 +42,10 @@ def _parse_field(spec: str, lo: int, hi: int, name: str) -> frozenset[int] | Non
     allowed: set[int] = set()
     for part in spec.split(","):
         step = 1
+        has_step = False
         if "/" in part:
             part, step_s = part.split("/", 1)
+            has_step = True
             try:
                 step = int(step_s)
             except ValueError:
@@ -63,8 +65,8 @@ def _parse_field(spec: str, lo: int, hi: int, name: str) -> frozenset[int] | Non
                 a = int(part)
             except ValueError:
                 raise CalendarError(f"bad value in {name}: {part!r}")
-            # systemd: "a/N" == from a to field max, step N
-            b = hi if step != 1 else a
+            # systemd: "a/N" == from a to field max, step N (even N=1)
+            b = hi if has_step else a
         if not (lo <= a <= hi and lo <= b <= hi and a <= b):
             raise CalendarError(f"{name} out of range [{lo},{hi}]: {part!r}")
         allowed.update(range(a, b + 1, step))

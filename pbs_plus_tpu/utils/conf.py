@@ -28,11 +28,9 @@ ARPC_PORT = 8008          # TCP mTLS + mux data plane (and control plane here;
 MAX_FRAME_SIZE = 1 << 30          # 1 GiB raw-frame cap
 STREAM_BUFFER_SIZE = 4 << 20      # 4 MiB per-stream buffer
 
-# --- chunker defaults (reference: buzhash.NewConfig(4<<20) at
-#     internal/pxarmount/commit_orchestrate.go:144) ------------------------
-DEFAULT_CHUNK_AVG = 4 << 20       # 4 MiB target chunk
-TEST_CHUNK_AVG = 4 << 10          # 4 KiB test-scale chunk
-                                  # (internal/pxarmount/commit_walk_test.go:25)
+# chunker size constants live with the format spec:
+# pbs_plus_tpu/chunker/spec.py DEFAULT_PARAMS (4 MiB) / TEST_PARAMS (4 KiB)
+# (reference: buzhash.NewConfig(4<<20), internal/pxarmount/commit_orchestrate.go:144)
 
 # --- identity / state dirs (reference: internal/conf/constants.go:17-45) --
 DEFAULT_STATE_DIR = "/var/lib/pbs-plus-tpu"

@@ -172,7 +172,15 @@ class CertManager:
                 cert = x509.load_pem_x509_certificate(f.read())
             attrs = cert.subject.get_attributes_for_oid(NameOID.COMMON_NAME)
             cn_matches = bool(attrs) and str(attrs[0].value) == common_name
-            if cn_matches and cert.not_valid_after_utc > _now() + 30 * _ONE_DAY:
+            chains = False
+            if self.ca_cert is not None:
+                try:
+                    cert.verify_directly_issued_by(self.ca_cert)
+                    chains = True
+                except Exception:
+                    chains = False  # CA rotated → reissue below
+            if cn_matches and chains and \
+                    cert.not_valid_after_utc > _now() + 30 * _ONE_DAY:
                 return
         cert_bytes, key_bytes = self.issue(common_name, server_auth=True)
         with open(self.server_cert_path, "wb") as f:

@@ -127,6 +127,18 @@ def test_native_matches_numpy():
     assert np.array_equal(b2, whole_tail)
 
 
+@pytest.mark.skipif(not native.available(), reason="native chunker unavailable")
+def test_oversized_prefix_clamped_consistently():
+    # prefix longer than real stream history: both backends keep the bytes
+    # immediately preceding data[0]
+    data = _data(200_000, seed=11)
+    pfx = b"Z" * 40 + data[:30]
+    a = candidates(data[30:], P, prefix=pfx, global_offset=30, force_numpy=True)
+    b = native.candidates(data[30:], P, prefix=pfx, global_offset=30)
+    c = candidates(data[30:], P, prefix=pfx, global_offset=30)
+    assert np.array_equal(a, b) and np.array_equal(a, c)
+
+
 def test_select_cuts_streaming_equivalence():
     # select_cuts on the full candidate list == CpuChunker incremental drain
     data = _data(250_000, seed=6)

@@ -43,6 +43,12 @@ def test_calendar_date_expressions():
     assert calendar.compute_next_event("*-12-25 08:00", t) == dt.datetime(2026, 12, 25, 8, 0, 0)
 
 
+def test_calendar_step_from_value():
+    # systemd: "a/N" == from a to field max step N — including N=1
+    assert sorted(calendar.parse("8/1:00").hours) == list(range(8, 24))
+    assert sorted(calendar.parse("8/2:00").hours) == list(range(8, 24, 2))
+
+
 def test_calendar_matches_and_errors():
     ev = calendar.parse("mon..fri 02:30")
     assert ev.matches(dt.datetime(2026, 7, 29, 2, 30, 0))
