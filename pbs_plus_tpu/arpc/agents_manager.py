@@ -1,0 +1,161 @@
+"""Session registry: admission, routing, rate limiting, eviction.
+
+Reference: internal/arpc/agents_manager.go:22-268 —
+- clientID = cert CN, with job suffixes ``CN|BackupID`` /
+  ``CN|RestoreID|restore`` / ``CN|VerifyID|verify`` taken from connection
+  headers (the reference's X-PBS-Plus-* headers)
+- expected-list gate (server-side DB of bootstrapped hosts) + optional
+  custom cert check
+- per-client token bucket (10/s, burst 20)
+- duplicate-session eviction on reconnect (newest wins)
+- WaitStreamPipe: a job (backup/restore) waits for the agent child's data
+  session to appear
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional
+
+from ..utils import conf
+from ..utils.log import L
+from .mux import MuxConnection
+
+HDR_BACKUP_ID = "X-PBS-Plus-BackupID"
+HDR_RESTORE_ID = "X-PBS-Plus-RestoreID"
+HDR_VERIFY_ID = "X-PBS-Plus-VerifyID"
+
+
+def client_id_from(cn: str, headers: dict[str, str]) -> str:
+    """Reference: getClientId (agents_manager.go:75-99)."""
+    if HDR_BACKUP_ID in headers:
+        return f"{cn}|{headers[HDR_BACKUP_ID]}"
+    if HDR_RESTORE_ID in headers:
+        return f"{cn}|{headers[HDR_RESTORE_ID]}|restore"
+    if HDR_VERIFY_ID in headers:
+        return f"{cn}|{headers[HDR_VERIFY_ID]}|verify"
+    return cn
+
+
+@dataclass
+class ClientSession:
+    client_id: str
+    cn: str
+    conn: MuxConnection
+    headers: dict[str, str] = field(default_factory=dict)
+    connected_at: float = field(default_factory=time.time)
+
+
+class _TokenBucket:
+    def __init__(self, rate: float, burst: int):
+        self.rate = rate
+        self.burst = burst
+        self.tokens = float(burst)
+        self.last = time.monotonic()
+
+    def allow(self) -> bool:
+        now = time.monotonic()
+        self.tokens = min(self.burst, self.tokens + (now - self.last) * self.rate)
+        self.last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+ExpectFn = Callable[[str, bytes], Awaitable[bool]]
+
+
+class AgentsManager:
+    """Connected-session registry with admission control."""
+
+    def __init__(self, *, is_expected: ExpectFn | None = None,
+                 rate: float = conf.CLIENT_RATE_LIMIT_PER_SEC,
+                 burst: int = conf.CLIENT_RATE_LIMIT_BURST):
+        self._sessions: dict[str, ClientSession] = {}
+        self._expected_ids: set[str] = set()         # Expect() one-shots
+        self._waiters: dict[str, list[asyncio.Future]] = {}
+        self._buckets: dict[str, _TokenBucket] = {}
+        self._rate, self._burst = rate, burst
+        self._is_expected = is_expected
+        self._lock = asyncio.Lock()
+
+    # -- admission (plugged into transport.serve's admit) ------------------
+    async def admit(self, peer_info: dict, headers: dict) -> tuple[int, str] | None:
+        cn = peer_info.get("cn", "")
+        if not cn:
+            return (403, "client certificate has no CN")
+        cid = client_id_from(cn, headers)
+        bucket = self._buckets.setdefault(
+            cn, _TokenBucket(self._rate, self._burst))
+        if not bucket.allow():
+            return (429, "rate limited")
+        # job sessions must have been announced via expect(); primary
+        # sessions go through the expected-host check (cert in DB)
+        if cid != cn:
+            if cid not in self._expected_ids:
+                return (403, f"unexpected job session {cid!r}")
+        elif self._is_expected is not None:
+            ok = await self._is_expected(cn, peer_info.get("cert_der", b""))
+            if not ok:
+                return (403, "host not expected")
+        return None
+
+    def expect(self, client_id: str) -> None:
+        """Announce an upcoming job session (reference: Expect(streamID),
+        rpc/mount.go:112)."""
+        self._expected_ids.add(client_id)
+
+    def unexpect(self, client_id: str) -> None:
+        self._expected_ids.discard(client_id)
+
+    # -- registry ----------------------------------------------------------
+    async def register(self, peer_info: dict, headers: dict,
+                       conn: MuxConnection) -> ClientSession:
+        cn = peer_info.get("cn", "")
+        cid = client_id_from(cn, headers)
+        sess = ClientSession(cid, cn, conn, dict(headers))
+        async with self._lock:
+            old = self._sessions.get(cid)
+            self._sessions[cid] = sess
+            waiters = self._waiters.pop(cid, [])
+        if old is not None and not old.conn.closed:
+            L.info("evicting duplicate session", )
+            await old.conn.close()       # duplicate eviction: newest wins
+        for f in waiters:
+            if not f.done():
+                f.set_result(sess)
+        return sess
+
+    async def unregister(self, sess: ClientSession) -> None:
+        async with self._lock:
+            cur = self._sessions.get(sess.client_id)
+            if cur is sess:
+                del self._sessions[sess.client_id]
+
+    def get(self, client_id: str) -> Optional[ClientSession]:
+        s = self._sessions.get(client_id)
+        if s is not None and s.conn.closed:
+            return None
+        return s
+
+    def sessions(self) -> list[ClientSession]:
+        return [s for s in self._sessions.values() if not s.conn.closed]
+
+    async def wait_session(self, client_id: str,
+                           timeout: float = 60.0) -> ClientSession:
+        """Wait for a (job) session to register (reference: WaitStreamPipe,
+        agents_manager.go:197-215)."""
+        s = self.get(client_id)
+        if s is not None:
+            return s
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._waiters.setdefault(client_id, []).append(fut)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            ws = self._waiters.get(client_id)
+            if ws and fut in ws:
+                ws.remove(fut)

@@ -1,0 +1,78 @@
+"""Length-prefixed raw binary transfer over a mux stream.
+
+Reference: internal/arpc/binary_stream.go:12-124 — 14-byte header
+``magic(4) + version(2) + length(8)``, 1 GiB frame cap, drain-on-short-
+buffer so a short consumer never desyncs the stream.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable
+
+from ..utils import conf
+from .mux import MuxError, MuxStream
+
+MAGIC = b"TPBS"
+VERSION = 1
+_HDR = struct.Struct("<4sHQ")
+MAX_FRAME = conf.MAX_FRAME_SIZE            # 1 GiB
+_IO_CHUNK = 1 << 20
+
+
+async def send_data_from_reader(stream: MuxStream, reader,
+                                total_len: int) -> int:
+    """Send exactly ``total_len`` bytes read from ``reader`` (object with
+    .read(n) → bytes, or bytes-like)."""
+    if total_len < 0 or total_len > MAX_FRAME:
+        raise MuxError(f"frame length {total_len} exceeds cap")
+    await stream.write(_HDR.pack(MAGIC, VERSION, total_len))
+    if isinstance(reader, (bytes, bytearray, memoryview)):
+        data = memoryview(reader)[:total_len]
+        if len(data) < total_len:
+            raise MuxError("reader shorter than declared length")
+        sent = 0
+        while sent < total_len:
+            n = min(_IO_CHUNK, total_len - sent)
+            await stream.write(bytes(data[sent:sent + n]))
+            sent += n
+        return sent
+    sent = 0
+    while sent < total_len:
+        block = reader.read(min(_IO_CHUNK, total_len - sent))
+        if not block:
+            raise MuxError(f"reader EOF at {sent}/{total_len}")
+        await stream.write(block)
+        sent += len(block)
+    return sent
+
+
+async def receive_data_into(stream: MuxStream,
+                            sink: Callable[[bytes], object] | bytearray,
+                            *, max_len: int | None = None) -> int:
+    """Receive one framed transfer.  ``sink`` is a bytearray (appended) or
+    a callable per block.  If the frame exceeds ``max_len``, the excess is
+    drained and discarded (reference's drain-on-short-buffer) and the
+    consumed length is still returned."""
+    hdr = await stream.readexactly(_HDR.size)
+    magic, ver, length = _HDR.unpack(hdr)
+    if magic != MAGIC:
+        raise MuxError(f"bad binary frame magic {magic!r}")
+    if ver != VERSION:
+        raise MuxError(f"unsupported binary frame version {ver}")
+    if length > MAX_FRAME:
+        raise MuxError(f"frame length {length} exceeds cap")
+    keep = length if max_len is None else min(length, max_len)
+    got = 0
+    while got < length:
+        block = await stream.read(min(_IO_CHUNK, length - got))
+        if not block:
+            raise MuxError(f"stream EOF at {got}/{length}")
+        take = max(0, min(len(block), keep - got))
+        if take:
+            if isinstance(sink, bytearray):
+                sink += block[:take]
+            else:
+                sink(block[:take])
+        got += len(block)
+    return min(got, keep)

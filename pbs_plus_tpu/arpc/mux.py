@@ -1,0 +1,296 @@
+"""Stream multiplexer over one byte-stream connection (the smux analog).
+
+Reference: xtaci/smux as used by the reference's TCP data plane
+(/root/reference/internal/arpc/pipe.go:183-188 — "smux streams over one TCP
+conn, one stream per RPC").
+
+Frame: type(u8) | stream_id(u32) | length(u32), little-endian, then payload.
+Credit-based flow control per stream (initial credit = conf.
+STREAM_BUFFER_SIZE, granted back as the consumer drains), ping/pong
+keepalive, id-parity allocation (client odd / server even) so both sides
+can open streams without coordination.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import time
+from typing import Optional
+
+from ..utils import conf
+from ..utils.log import L
+
+_HDR = struct.Struct("<BII")
+
+SYN, DATA, FIN, RST, PING, PONG, WINDOW = range(1, 8)
+
+MAX_DATA_FRAME = 256 << 10
+INITIAL_CREDIT = conf.STREAM_BUFFER_SIZE
+
+
+class MuxError(ConnectionError):
+    pass
+
+
+class MuxStream:
+    def __init__(self, conn: "MuxConnection", sid: int):
+        self.conn = conn
+        self.sid = sid
+        self._rx = bytearray()
+        self._rx_event = asyncio.Event()
+        self._rx_eof = False
+        self._rx_reset = False
+        self._tx_credit = INITIAL_CREDIT
+        self._tx_event = asyncio.Event()
+        self._tx_event.set()
+        self._closed = False
+        self._consumed_since_grant = 0
+
+    # -- read -------------------------------------------------------------
+    async def read(self, n: int = -1) -> bytes:
+        """Read up to n bytes (all buffered if n<0); b"" at EOF."""
+        while not self._rx and not self._rx_eof and not self._rx_reset:
+            self._rx_event.clear()
+            await self._rx_event.wait()
+        if self._rx_reset:
+            raise MuxError(f"stream {self.sid} reset by peer")
+        if not self._rx:
+            return b""
+        if n < 0 or n >= len(self._rx):
+            out = bytes(self._rx)
+            self._rx.clear()
+        else:
+            out = bytes(self._rx[:n])
+            del self._rx[:n]
+        await self._grant(len(out))
+        return out
+
+    async def readexactly(self, n: int) -> bytes:
+        out = bytearray()
+        while len(out) < n:
+            part = await self.read(n - len(out))
+            if not part:
+                raise MuxError(f"stream {self.sid}: EOF after {len(out)}/{n}")
+            out += part
+        return bytes(out)
+
+    async def _grant(self, n: int) -> None:
+        self._consumed_since_grant += n
+        if self._consumed_since_grant >= INITIAL_CREDIT // 4:
+            grant = self._consumed_since_grant
+            self._consumed_since_grant = 0
+            await self.conn._send_frame(WINDOW, self.sid,
+                                        struct.pack("<I", grant))
+
+    # -- write ------------------------------------------------------------
+    async def write(self, data: bytes) -> None:
+        if self._closed:
+            raise MuxError(f"stream {self.sid} closed")
+        view = memoryview(data)
+        while view:
+            while self._tx_credit <= 0:
+                self._tx_event.clear()
+                await self._tx_event.wait()
+                if self._closed:
+                    raise MuxError(f"stream {self.sid} closed")
+            n = min(len(view), MAX_DATA_FRAME, self._tx_credit)
+            self._tx_credit -= n
+            await self.conn._send_frame(DATA, self.sid, bytes(view[:n]))
+            view = view[n:]
+
+    # -- lifecycle --------------------------------------------------------
+    async def close(self) -> None:
+        """Half-close (FIN); reads continue until peer FIN."""
+        if not self._closed:
+            self._closed = True
+            if not self.conn.closed:
+                try:
+                    await self.conn._send_frame(FIN, self.sid, b"")
+                except ConnectionError:
+                    pass
+
+    async def reset(self) -> None:
+        self._closed = True
+        if not self.conn.closed:
+            try:
+                await self.conn._send_frame(RST, self.sid, b"")
+            except ConnectionError:
+                pass
+        self.conn._drop_stream(self.sid)
+
+    async def __aenter__(self) -> "MuxStream":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    # -- conn callbacks ---------------------------------------------------
+    def _on_data(self, payload: bytes) -> None:
+        self._rx += payload
+        self._rx_event.set()
+
+    def _on_fin(self) -> None:
+        self._rx_eof = True
+        self._rx_event.set()
+
+    def _on_rst(self) -> None:
+        self._rx_reset = True
+        self._rx_event.set()
+        self._tx_event.set()
+
+    def _on_window(self, grant: int) -> None:
+        self._tx_credit += grant
+        self._tx_event.set()
+
+
+class MuxConnection:
+    """Multiplexed connection over asyncio (reader, writer)."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, *, is_client: bool,
+                 keepalive_s: float = 30.0):
+        self.reader = reader
+        self.writer = writer
+        self.is_client = is_client
+        self._next_sid = 1 if is_client else 2
+        self._streams: dict[int, MuxStream] = {}
+        self._accept_q: asyncio.Queue[MuxStream | None] = asyncio.Queue()
+        self._wlock = asyncio.Lock()
+        self.closed = False
+        self.close_reason = ""
+        self._keepalive_s = keepalive_s
+        self._last_rx = time.monotonic()
+        self._tasks: list[asyncio.Task] = []
+
+    def start(self) -> None:
+        self._tasks.append(asyncio.create_task(self._read_loop()))
+        if self._keepalive_s > 0:
+            self._tasks.append(asyncio.create_task(self._keepalive_loop()))
+
+    # -- frame io ---------------------------------------------------------
+    async def _send_frame(self, ftype: int, sid: int, payload: bytes) -> None:
+        if self.closed:
+            raise MuxError("connection closed")
+        async with self._wlock:
+            try:
+                self.writer.write(_HDR.pack(ftype, sid, len(payload)))
+                if payload:
+                    self.writer.write(payload)
+                await self.writer.drain()
+            except (ConnectionError, OSError) as e:
+                await self._shutdown(f"write failed: {e}")
+                raise MuxError(f"connection write failed: {e}") from e
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                hdr = await self.reader.readexactly(_HDR.size)
+                ftype, sid, ln = _HDR.unpack(hdr)
+                payload = await self.reader.readexactly(ln) if ln else b""
+                self._last_rx = time.monotonic()
+                await self._dispatch(ftype, sid, payload)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+            await self._shutdown(f"read loop ended: {e}")
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            L.exception("mux read loop crashed")
+            await self._shutdown("read loop crashed")
+
+    async def _dispatch(self, ftype: int, sid: int, payload: bytes) -> None:
+        if ftype == SYN:
+            if sid in self._streams:
+                return
+            st = MuxStream(self, sid)
+            self._streams[sid] = st
+            await self._accept_q.put(st)
+        elif ftype == DATA:
+            st = self._streams.get(sid)
+            if st is not None:
+                st._on_data(payload)
+            else:
+                await self._send_frame(RST, sid, b"")
+        elif ftype == FIN:
+            st = self._streams.get(sid)
+            if st is not None:
+                st._on_fin()
+        elif ftype == RST:
+            st = self._streams.get(sid)
+            if st is not None:
+                st._on_rst()
+            self._streams.pop(sid, None)
+        elif ftype == PING:
+            await self._send_frame(PONG, 0, b"")
+        elif ftype == PONG:
+            pass
+        elif ftype == WINDOW:
+            st = self._streams.get(sid)
+            if st is not None and len(payload) == 4:
+                st._on_window(struct.unpack("<I", payload)[0])
+
+    async def _keepalive_loop(self) -> None:
+        try:
+            while not self.closed:
+                await asyncio.sleep(self._keepalive_s)
+                if time.monotonic() - self._last_rx > 4 * self._keepalive_s:
+                    await self._shutdown("keepalive timeout")
+                    return
+                try:
+                    await self._send_frame(PING, 0, b"")
+                except ConnectionError:
+                    return
+        except asyncio.CancelledError:
+            pass
+
+    # -- streams ----------------------------------------------------------
+    async def open_stream(self) -> MuxStream:
+        if self.closed:
+            raise MuxError("connection closed")
+        sid = self._next_sid
+        self._next_sid += 2
+        st = MuxStream(self, sid)
+        self._streams[sid] = st
+        await self._send_frame(SYN, sid, b"")
+        return st
+
+    async def accept_stream(self) -> Optional[MuxStream]:
+        """None when the connection is closed."""
+        if self.closed and self._accept_q.empty():
+            return None
+        st = await self._accept_q.get()
+        return st
+
+    def _drop_stream(self, sid: int) -> None:
+        self._streams.pop(sid, None)
+
+    # -- lifecycle --------------------------------------------------------
+    async def _shutdown(self, reason: str) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self.close_reason = reason
+        for st in list(self._streams.values()):
+            st._on_rst()
+        self._streams.clear()
+        await self._accept_q.put(None)
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+    async def close(self) -> None:
+        await self._shutdown("closed locally")
+        for t in self._tasks:
+            if t is not asyncio.current_task():
+                t.cancel()
+        for t in self._tasks:
+            if t is not asyncio.current_task():
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
+        try:
+            await self.writer.wait_closed()
+        except Exception:
+            pass

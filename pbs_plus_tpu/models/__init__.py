@@ -1,0 +1,19 @@
+"""Flagship jittable pipelines — the "model families" of this framework.
+
+The reference is a backup fabric, not an ML stack (SURVEY §2.10): its
+"models" are data-plane pipelines.  Each pipeline here is a composition of
+ops/ kernels with a thin host orchestration layer:
+
+- DedupPipeline   — chunk + fingerprint + index-probe (the north star)
+- VerifyPipeline  — batched re-hash for spot-check verification
+- SimilarityModel — cross-snapshot near-dup detection (simhash/minhash)
+"""
+
+from .dedup import DedupPipeline, DedupConfig, StreamResult, ChunkRecord
+from .verify import VerifyPipeline
+from .similarity import SimilarityModel
+
+__all__ = [
+    "DedupPipeline", "DedupConfig", "StreamResult", "ChunkRecord",
+    "VerifyPipeline", "SimilarityModel",
+]

@@ -1,0 +1,211 @@
+"""DedupPipeline — batched chunk + fingerprint + index probe on TPU.
+
+The TPU-native equivalent of the reference's commit/backup hot loop
+(SURVEY §3.4: "the walk's per-entry decode and the library's chunk+hash of
+new payload — exactly what moves to TPU"; BASELINE.json north star).
+
+Dataflow per step (B agent streams at once — the batch axis IS the agent
+fan-in, SURVEY §2.10):
+
+    host pages → device stream buffer uint8[B, S]
+      ├─ rolling-hash kernel → candidate mask bool[B, S]      (device)
+      ├─ greedy min/max cut selection over sparse candidates  (host, O(B·S/avg))
+      ├─ block-gather + SHA-256 scan → digests uint8[N, 32]   (device)
+      ├─ cuckoo probe → maybe-present bool[N]                 (device)
+      └─ authoritative confirm + index insert                 (host)
+
+Only the two dense passes touch every byte, and both stay on device; host
+work is proportional to the number of chunks, not bytes.
+
+Streams are processed in fixed-shape segments with 63-byte history halos so
+jit caches stay small and results are bit-identical to the streaming CPU
+chunker (same spec, same shared greedy pass).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..chunker.spec import WINDOW, ChunkerParams, buzhash_table, select_cuts
+from ..ops.cuckoo import CuckooIndex
+from ..ops.rolling_hash import candidate_mask
+from ..ops.sha256 import sha256_stream_chunks
+
+
+@dataclass(frozen=True)
+class DedupConfig:
+    params: ChunkerParams = field(default_factory=lambda: ChunkerParams(avg_size=4 << 20))
+    segment_bytes: int = 64 << 20        # device segment per stream per step
+    index_buckets: int = 1 << 20         # initial cuckoo table (4M slots)
+
+
+@dataclass
+class ChunkRecord:
+    offset: int          # absolute offset in the stream
+    length: int
+    digest: bytes
+    is_new: bool         # not in the chunk index before this step
+
+
+@dataclass
+class StreamResult:
+    chunks: list[ChunkRecord] = field(default_factory=list)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(c.length for c in self.chunks)
+
+    @property
+    def new_bytes(self) -> int:
+        return sum(c.length for c in self.chunks if c.is_new)
+
+    @property
+    def dedup_ratio(self) -> float:
+        t = self.total_bytes
+        return 1.0 - (self.new_bytes / t) if t else 0.0
+
+
+class DedupPipeline:
+    """Batched multi-stream dedup.  Feed segments for many streams, collect
+    per-stream ChunkRecords.  Digests/cuts are bit-identical to the CPU
+    path (tests/test_models.py::test_pipeline_matches_cpu_backend)."""
+
+    def __init__(self, config: DedupConfig | None = None, *,
+                 index: CuckooIndex | None = None):
+        self.config = config or DedupConfig()
+        self.params = self.config.params
+        self.index = index if index is not None else CuckooIndex(
+            n_buckets=self.config.index_buckets)
+        self._table = jnp.asarray(buzhash_table(self.params.seed))
+        self.stats = {"bytes_in": 0, "chunks": 0, "new_chunks": 0,
+                      "device_steps": 0}
+
+    # (streaming consumers use TpuChunker below — the drop-in chunker
+    # backend; this class is the batched whole-stream pipeline)
+    def process_streams(self, streams: dict[str, bytes | np.ndarray],
+                        ) -> dict[str, StreamResult]:
+        """Chunk + fingerprint + probe complete streams (each stream fully
+        in memory; segmented on device internally)."""
+        names = sorted(streams)
+        arrs = {n: (np.frombuffer(streams[n], dtype=np.uint8)
+                    if not isinstance(streams[n], np.ndarray) else streams[n])
+                for n in names}
+        out: dict[str, StreamResult] = {}
+        # 1) candidates per stream (segmented, halo-carried)
+        seg = self.config.segment_bytes
+        all_cuts: dict[str, list[int]] = {}
+        for n in names:
+            a = arrs[n]
+            ends_parts = []
+            for off in range(0, len(a), seg):
+                part = a[off:off + seg]
+                hist = np.zeros((1, WINDOW - 1), dtype=np.uint8)
+                if off:
+                    hist[0] = a[off - (WINDOW - 1):off]
+                S = len(part)
+                S_pad = max(1 << 14, 1 << int(S - 1).bit_length())
+                buf = np.zeros((1, S_pad), dtype=np.uint8)
+                buf[0, :S] = part
+                m = candidate_mask(jnp.asarray(buf), self._table,
+                                   self.params.mask, self.params.magic,
+                                   history=jnp.asarray(hist))
+                self.stats["device_steps"] += 1
+                hits = np.nonzero(np.asarray(m)[0, :S])[0]
+                valid = hits + off >= WINDOW - 1
+                ends_parts.append(hits[valid] + 1 + off)
+            ends = np.concatenate(ends_parts) if ends_parts else np.empty(0, np.int64)
+            all_cuts[n] = select_cuts(ends, len(a), self.params)
+            self.stats["bytes_in"] += len(a)
+        # 2) hash all chunks (bucketed across all streams for batch density)
+        bounds_by_stream: dict[str, list[tuple[int, int]]] = {}
+        digests_by_stream: dict[str, list[bytes]] = {}
+        for n in names:
+            s = 0
+            bounds = []
+            for e in all_cuts[n]:
+                bounds.append((s, e))
+                s = e
+            bounds_by_stream[n] = bounds
+            digests_by_stream[n] = sha256_stream_chunks(arrs[n], bounds)
+        # 3) probe + insert
+        for n in names:
+            res = StreamResult()
+            digs = digests_by_stream[n]
+            if digs:
+                maybe = self.index.probe_confirmed(digs)
+            else:
+                maybe = []
+            for (s, e), d, present in zip(bounds_by_stream[n], digs, maybe):
+                is_new = not present
+                if is_new:
+                    self.index.insert(d)
+                res.chunks.append(ChunkRecord(s, e - s, d, is_new))
+                self.stats["chunks"] += 1
+                self.stats["new_chunks"] += int(is_new)
+            out[n] = res
+        return out
+
+
+class TpuChunker:
+    """chunker-interface adapter: feed/finalize returning absolute cut
+    offsets, computed by the device kernel.  Drop-in for CpuChunker in
+    transfer writers (``chunker="tpu"`` — the one-line config change from
+    BASELINE.json).  Buffers segment bytes host-side; candidate evaluation
+    is device-batched per feed."""
+
+    def __init__(self, params: ChunkerParams):
+        self.params = params
+        self._table = jnp.asarray(buzhash_table(params.seed))
+        self._tail = np.zeros(WINDOW - 1, dtype=np.uint8)
+        self._seen = 0
+        self._chunk_start = 0
+        self._cand: list[int] = []
+        self._cand_drained = 0
+        self._finalized = False
+
+    def _candidates(self, data: np.ndarray) -> np.ndarray:
+        S = len(data)
+        S_pad = max(1 << 14, 1 << int(S - 1).bit_length()) if S else 1 << 14
+        buf = np.zeros((1, S_pad), dtype=np.uint8)
+        buf[0, :S] = data
+        hist = self._tail[None]
+        m = candidate_mask(jnp.asarray(buf), self._table, self.params.mask,
+                           self.params.magic, history=jnp.asarray(hist))
+        hits = np.nonzero(np.asarray(m)[0, :S])[0]
+        valid = hits + self._seen >= WINDOW - 1
+        return hits[valid] + 1 + self._seen
+
+    def feed(self, data: bytes) -> list[int]:
+        if self._finalized:
+            raise RuntimeError("chunker already finalized")
+        if not data:
+            return []
+        arr = np.frombuffer(data, dtype=np.uint8)
+        self._cand.extend(self._candidates(arr).tolist())
+        self._seen += len(arr)
+        joined = np.concatenate([self._tail, arr])
+        self._tail = joined[-(WINDOW - 1):]
+        return self._drain(final=False)
+
+    def finalize(self) -> list[int]:
+        if self._finalized:
+            return []
+        self._finalized = True
+        return self._drain(final=True)
+
+    def _drain(self, final: bool) -> list[int]:
+        pending = np.array(self._cand[self._cand_drained:], dtype=np.int64)
+        cuts = select_cuts(pending, self._seen, self.params,
+                           start=self._chunk_start, final=final)
+        if cuts:
+            self._chunk_start = cuts[-1]
+            # advance the drained pointer past consumed candidates
+            k = self._cand_drained
+            while k < len(self._cand) and self._cand[k] <= self._chunk_start:
+                k += 1
+            self._cand_drained = k
+        return cuts

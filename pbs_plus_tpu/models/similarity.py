@@ -1,0 +1,58 @@
+"""SimilarityModel — cross-snapshot near-duplicate detection.
+
+BASELINE.json config #5: minhash/simhash over historical chunk digests.
+Use cases: locating the best previous snapshot for ref-dedup, flagging
+snapshot pairs that should share chunks but don't (chunker drift), and
+tape-layout grouping of similar snapshots.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ops.similarity import (
+    minhash_signature, minhash_similarity, pairwise_hamming, simhash_sketch,
+)
+
+
+class SimilarityModel:
+    def __init__(self, *, simhash_bits: int = 64, minhash_k: int = 128):
+        self.simhash_bits = simhash_bits
+        self.minhash_k = minhash_k
+
+    @staticmethod
+    def _digest_array(digests: list[bytes]) -> np.ndarray:
+        return np.frombuffer(b"".join(digests), dtype=np.uint8).reshape(-1, 32)
+
+    def snapshot_signature(self, digests: list[bytes]) -> np.ndarray:
+        """minhash signature of a snapshot's chunk-digest set."""
+        return minhash_signature(self._digest_array(digests), k=self.minhash_k)
+
+    def snapshot_similarity(self, sig_a: np.ndarray, sig_b: np.ndarray) -> float:
+        return minhash_similarity(sig_a, sig_b)
+
+    def best_previous(self, target_sig: np.ndarray,
+                      candidates: dict[str, np.ndarray],
+                      *, min_similarity: float = 0.05) -> tuple[str | None, float]:
+        """Pick the historical snapshot most similar to the target set."""
+        best, best_s = None, min_similarity
+        for name, sig in candidates.items():
+            s = minhash_similarity(target_sig, sig)
+            if s > best_s:
+                best, best_s = name, s
+        return best, (best_s if best else 0.0)
+
+    def chunk_sketches(self, digests: list[bytes]) -> np.ndarray:
+        """Per-chunk simhash sketches (uint32[N, bits/32])."""
+        return np.asarray(simhash_sketch(self._digest_array(digests),
+                                         k=self.simhash_bits))
+
+    def near_duplicates(self, sketches_a: np.ndarray, sketches_b: np.ndarray,
+                        *, max_distance: int = 6) -> list[tuple[int, int, int]]:
+        """All (i, j, dist) pairs with Hamming distance <= max_distance —
+        one MXU-friendly pairwise pass (device) + sparse host extraction."""
+        d = np.asarray(pairwise_hamming(jnp.asarray(sketches_a),
+                                        jnp.asarray(sketches_b)))
+        ii, jj = np.nonzero(d <= max_distance)
+        return [(int(i), int(j), int(d[i, j])) for i, j in zip(ii, jj)]

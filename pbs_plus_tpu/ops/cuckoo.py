@@ -1,0 +1,158 @@
+"""On-device chunk-index probe: vmap'd cuckoo lookups.
+
+Reference role: the server's chunk-index lookup — "only globally-novel
+chunks ever hit the datastore" (BASELINE.json north star; the reference
+does this inside the pxar library's dedup store, consumed at
+/root/reference/internal/pxarmount/commit_orchestrate.go:236-242).
+
+Design: cuckoo-filter style two-choice hashing.  The device table holds
+64-bit fingerprints (digest words 0..1) in ``uint32[n_buckets, SLOTS, 2]``;
+bucket₁ = digest word 2 masked, bucket₂ = bucket₁ ^ mix(fingerprint).
+Lookups are a fully-parallel gather+compare per digest (vmap over the
+batch).  Inserts run on a host-side numpy mirror (single-writer, matching
+the reference's async single-writer index update queue, SURVEY §2.10) with
+cuckoo eviction + table growth; ``device_table`` re-uploads after a batch
+of inserts.  The host dict stays authoritative — a 64-bit-fingerprint
+false positive (~2⁻⁶⁴ per probe) is confirmed against it before a chunk
+upload is skipped.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SLOTS = 4
+_MIX = np.uint32(0x9E3779B1)
+_MAX_KICKS = 500
+
+
+def _digest_words(digests: np.ndarray | jax.Array):
+    """digests uint8[N,32] → (fp0, fp1, idx) uint32[N] each."""
+    if isinstance(digests, np.ndarray):
+        w = digests.reshape(-1, 8, 4).astype(np.uint32)
+        word = (w[..., 0] << 24) | (w[..., 1] << 16) | (w[..., 2] << 8) | w[..., 3]
+        return word[:, 0], word[:, 1], word[:, 2]
+    w = digests.reshape(-1, 8, 4).astype(jnp.uint32)
+    word = (w[..., 0] << np.uint32(24)) | (w[..., 1] << np.uint32(16)) \
+        | (w[..., 2] << np.uint32(8)) | w[..., 3]
+    return word[:, 0], word[:, 1], word[:, 2]
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _lookup(table: jax.Array, digests: jax.Array) -> jax.Array:
+    """table uint32[NB, SLOTS, 2]; digests uint8[N,32] → bool[N]."""
+    nb = table.shape[0]
+    fp0, fp1, bidx = _digest_words(digests)
+    fp0 = jnp.where((fp0 == 0) & (fp1 == 0), jnp.uint32(0x5A5A5A5A), fp0)
+    mask = jnp.uint32(nb - 1)
+    b1 = bidx & mask
+    b2 = b1 ^ ((fp0 * _MIX) & mask)
+    s1 = table[b1]                      # [N, SLOTS, 2]
+    s2 = table[b2]
+    hit1 = jnp.any((s1[..., 0] == fp0[:, None]) & (s1[..., 1] == fp1[:, None]), axis=1)
+    hit2 = jnp.any((s2[..., 0] == fp0[:, None]) & (s2[..., 1] == fp1[:, None]), axis=1)
+    return hit1 | hit2
+
+
+class CuckooIndex:
+    """Chunk-presence index: device-probe, host-authoritative."""
+
+    def __init__(self, n_buckets: int = 1 << 16, seed: int = 0):
+        if n_buckets & (n_buckets - 1):
+            raise ValueError("n_buckets must be a power of two")
+        self.n_buckets = n_buckets
+        self._table = np.zeros((n_buckets, SLOTS, 2), dtype=np.uint32)
+        self._device_table: jax.Array | None = None
+        self._dirty = True
+        self._known: set[bytes] = set()       # authoritative
+        self._rng = np.random.default_rng(seed)
+
+    # -- host authoritative ----------------------------------------------
+    def __len__(self) -> int:
+        return len(self._known)
+
+    def contains_exact(self, digest: bytes) -> bool:
+        return digest in self._known
+
+    def _fp_bucket(self, digest: bytes) -> tuple[int, int, int, int]:
+        d = np.frombuffer(digest, dtype=np.uint8)[None]
+        fp0, fp1, bidx = _digest_words(d)
+        fp0, fp1, bidx = int(fp0[0]), int(fp1[0]), int(bidx[0])
+        if fp0 == 0 and fp1 == 0:
+            fp0 = 0x5A5A5A5A
+        mask = self.n_buckets - 1
+        b1 = bidx & mask
+        b2 = b1 ^ ((fp0 * int(_MIX)) & 0xFFFFFFFF & mask)
+        return fp0, fp1, b1, b2
+
+    def insert(self, digest: bytes) -> bool:
+        """Insert; returns False if already present."""
+        if digest in self._known:
+            return False
+        self._known.add(digest)
+        fp0, fp1, b1, b2 = self._fp_bucket(digest)
+        self._insert_fp(fp0, fp1, b1, b2)
+        self._dirty = True
+        return True
+
+    def _insert_fp(self, fp0: int, fp1: int, b1: int, b2: int) -> None:
+        for b in (b1, b2):
+            row = self._table[b]
+            for s in range(SLOTS):
+                if row[s, 0] == 0 and row[s, 1] == 0:
+                    row[s] = (fp0, fp1)
+                    return
+        # eviction chain
+        b = b1
+        cur = np.array([fp0, fp1], dtype=np.uint32)
+        for _ in range(_MAX_KICKS):
+            s = int(self._rng.integers(0, SLOTS))
+            victim = self._table[b, s].copy()
+            self._table[b, s] = cur
+            cur = victim
+            vfp0 = int(cur[0])
+            mask = self.n_buckets - 1
+            b = b ^ ((vfp0 * int(_MIX)) & 0xFFFFFFFF & mask)
+            row = self._table[b]
+            for s2 in range(SLOTS):
+                if row[s2, 0] == 0 and row[s2, 1] == 0:
+                    row[s2] = cur
+                    return
+        self._grow()
+        # re-place the displaced fingerprint after growth
+        mask = self.n_buckets - 1
+        # cannot recover its true b1 (bidx lost) — rebuild covers all knowns,
+        # so nothing else to do: _grow() reinserted every known digest
+        _ = mask
+
+    def _grow(self) -> None:
+        self.n_buckets *= 2
+        self._table = np.zeros((self.n_buckets, SLOTS, 2), dtype=np.uint32)
+        for d in self._known:
+            fp0, fp1, b1, b2 = self._fp_bucket(d)
+            self._insert_fp(fp0, fp1, b1, b2)
+
+    def insert_many(self, digests: list[bytes]) -> int:
+        return sum(self.insert(d) for d in digests)
+
+    # -- device probe -----------------------------------------------------
+    def device_table(self) -> jax.Array:
+        if self._dirty or self._device_table is None:
+            self._device_table = jnp.asarray(self._table)
+            self._dirty = False
+        return self._device_table
+
+    def probe(self, digests: np.ndarray | jax.Array) -> jax.Array:
+        """digests uint8[N,32] → bool[N] (maybe-present; exact-confirm via
+        contains_exact on hits if false positives matter)."""
+        d = jnp.asarray(digests, dtype=jnp.uint8)
+        return _lookup(self.device_table(), d)
+
+    def probe_confirmed(self, digests: list[bytes]) -> list[bool]:
+        arr = np.frombuffer(b"".join(digests), dtype=np.uint8).reshape(-1, 32)
+        maybe = np.asarray(self.probe(arr))
+        return [bool(m) and (d in self._known) for m, d in zip(maybe, digests)]

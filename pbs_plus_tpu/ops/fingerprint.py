@@ -1,0 +1,76 @@
+"""Scan-free 256-bit fold fingerprint — a fully-parallel routing digest.
+
+Per 64-byte block, words are multiplied by odd constants and rotated by a
+block-dependent amount, then XOR-folded across blocks — associative, so
+XLA lowers it to plain elementwise + reduce with no sequential chain.
+Useful where a cheap non-cryptographic content fingerprint suffices
+(similarity pre-filters, load-balancing keys, test doubles); chunk-store
+content addresses are always SHA-256 (ops/sha256.py, which compiles under
+SPMD via its rolled-rounds CPU variant).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_MULT = np.array([
+    0x9E3779B1, 0x85EBCA77, 0xC2B2AE3D, 0x27D4EB2F,
+    0x165667B1, 0xD3A2646D, 0xFD7046C5, 0xB55A4F09,
+], dtype=np.uint32)
+
+
+def fold_fingerprint(stream: jax.Array, starts: jax.Array,
+                     lengths: jax.Array, t_max: int) -> jax.Array:
+    """stream uint8[S]; starts/lengths int32[N] → uint32[N, 8].
+
+    Chunks longer than t_max*64 bytes are rejected by the caller contract
+    (same t_max bucketing as the sha kernel).
+    """
+    S = stream.shape[0]
+    N = starts.shape[0]
+    j = jnp.arange(t_max * 64, dtype=jnp.int32)
+    gidx = starts[:, None] + j[None, :]
+    raw = stream[jnp.clip(gidx, 0, S - 1)]                  # uint8[N, T*64]
+    valid = j[None, :] < lengths[:, None]
+    b = jnp.where(valid, raw, jnp.uint8(0)).astype(jnp.uint32)
+    blocks = b.reshape(N, t_max, 16, 4)
+    words = (blocks[..., 0] << np.uint32(24)) | (blocks[..., 1] << np.uint32(16)) \
+        | (blocks[..., 2] << np.uint32(8)) | blocks[..., 3]  # [N, T, 16]
+    w8 = words.reshape(N, t_max, 2, 8)                       # fold 16→8 lanes
+    lane = w8[:, :, 0, :] * jnp.asarray(_MULT)[None, None, :] \
+        ^ (w8[:, :, 1, :] * jnp.asarray(_MULT[::-1].copy())[None, None, :])
+    rot = (jnp.arange(t_max, dtype=jnp.uint32) * jnp.uint32(7)) % jnp.uint32(31) + jnp.uint32(1)
+    lane = (lane << rot[None, :, None]) | (lane >> (jnp.uint32(32) - rot[None, :, None]))
+    folded = jax.lax.reduce(lane, jnp.uint32(0), jax.lax.bitwise_xor, (1,))
+    # final avalanche + length binding
+    ln = lengths.astype(jnp.uint32)[:, None]
+    x = folded ^ (ln * jnp.asarray(_MULT)[None, :])
+    x = x * jnp.uint32(0x85EBCA77)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE3D)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+def fold_fingerprint_host(chunk: bytes) -> bytes:
+    """Reference host implementation (numpy) — parity oracle for tests."""
+    L = len(chunk)
+    t_max = max(1, (L + 63) // 64)
+    buf = np.zeros(t_max * 64, dtype=np.uint8)
+    buf[:L] = np.frombuffer(chunk, dtype=np.uint8)
+    words = buf.reshape(t_max, 16, 4).astype(np.uint32)
+    words = (words[..., 0] << 24) | (words[..., 1] << 16) | \
+        (words[..., 2] << 8) | words[..., 3]
+    w8 = words.reshape(t_max, 2, 8)
+    lane = (w8[:, 0, :] * _MULT) ^ (w8[:, 1, :] * _MULT[::-1])
+    rot = (np.arange(t_max, dtype=np.uint32) * 7) % 31 + 1
+    lane = ((lane << rot[:, None]) | (lane >> (32 - rot[:, None]))).astype(np.uint32)
+    folded = np.bitwise_xor.reduce(lane, axis=0)
+    x = folded ^ (np.uint32(L) * _MULT)
+    x = (x * np.uint32(0x85EBCA77)) & 0xFFFFFFFF
+    x = x ^ (x >> 13)
+    x = (x * np.uint32(0xC2B2AE3D)) & 0xFFFFFFFF
+    x = x ^ (x >> 16)
+    return x.astype(">u4").tobytes()

@@ -1,0 +1,112 @@
+"""Batched buzhash candidate computation on TPU.
+
+Implements chunker/spec.py's position-local closed form
+
+    h(i) = XOR_{k=0}^{63} rotl32(T[b[i-k]], k mod 32)
+
+with log2(W)=6 shift/rotate/XOR doubling passes over whole streams at once:
+
+    H_1(i)    = T[b[i]]
+    H_{2m}(i) = H_m(i) ^ rotl_{m mod 32}(H_m(i-m))
+
+Fully parallel over batch and sequence: the VPU evaluates every position's
+window hash with ~6 fused elementwise passes; no sequential rolling state
+(the CPU chunkers and this kernel are bit-identical —
+tests/test_ops.py::test_candidate_mask_matches_cpu).
+
+Bit parity gate: BASELINE.md config #2.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..chunker.spec import WINDOW, ChunkerParams, buzhash_table
+from ..chunker.spec import select_cuts
+
+
+def _rotl(x: jax.Array, r: int) -> jax.Array:
+    r &= 31
+    if r == 0:
+        return x
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _candidate_mask_impl(data: jax.Array, table: jax.Array, mask: int,
+                         magic: int, history: jax.Array | None = None) -> jax.Array:
+    """Candidate boolean mask for batched streams.
+
+    data:    uint8[B, S] — batch of stream segments
+    table:   uint32[256]
+    history: optional uint8[B, W-1] — the 63 bytes preceding each segment
+             (for segment-parallel / streaming use).  Without it, the first
+             W-1 positions of each stream are masked invalid.
+
+    Returns bool[B, S]: True where a chunk cut candidate ends at that byte.
+    """
+    if data.ndim == 1:
+        data = data[None]
+        squeeze = True
+    else:
+        squeeze = False
+    B, S = data.shape
+    hlen = 0
+    if history is not None:
+        hlen = history.shape[-1]
+        if hlen != WINDOW - 1:
+            raise ValueError(f"history must be {WINDOW-1} bytes")
+        data = jnp.concatenate([history, data], axis=-1)
+    h = table[data.astype(jnp.int32)]          # uint32[B, hlen+S]
+    m = 1
+    while m < WINDOW:
+        shifted = jnp.pad(h[:, :-m], ((0, 0), (m, 0)))
+        h = h ^ _rotl(shifted, m)
+        m *= 2
+    hit = (h & jnp.uint32(mask)) == jnp.uint32(magic)
+    # positions with an incomplete 64-byte window are invalid
+    pos = jnp.arange(hlen + S, dtype=jnp.int32)
+    hit = hit & (pos >= WINDOW - 1)[None, :]
+    hit = hit[:, hlen:]
+    return hit[0] if squeeze else hit
+
+
+_candidate_mask_jit = jax.jit(_candidate_mask_impl)
+
+
+def candidate_mask(data: jax.Array, table: jax.Array, mask: int,
+                   magic: int, *, history: jax.Array | None = None) -> jax.Array:
+    """Jitted public entry (see _candidate_mask_impl for the contract)."""
+    return _candidate_mask_jit(data, table, jnp.uint32(mask),
+                               jnp.uint32(magic), history)
+
+
+def candidate_ends_host(data: bytes | np.ndarray, params: ChunkerParams,
+                        *, device=None) -> np.ndarray:
+    """Convenience: run the device kernel on one stream and return sorted
+    absolute candidate end offsets (same contract as chunker.cpu.candidates
+    with no prefix).  Host round-trip included — for parity tests and
+    small inputs; the pipeline keeps everything on device."""
+    arr = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    table = jnp.asarray(buzhash_table(params.seed))
+    n = len(arr)
+    # pad to a power-of-two length so the jit cache sees few shapes
+    S = max(1 << 14, 1 << (n - 1).bit_length()) if n else 1 << 14
+    if S != n:
+        padded = np.zeros(S, dtype=np.uint8)
+        padded[:n] = arr
+        arr = padded
+    hit = candidate_mask(jnp.asarray(arr)[None], table, params.mask,
+                         params.magic)[0]
+    return (np.nonzero(np.asarray(hit)[:n])[0] + 1).astype(np.int64)
+
+
+def chunk_stream_device(data: bytes | np.ndarray, params: ChunkerParams,
+                        ) -> list[int]:
+    """Device candidates + the shared host-side greedy pass → cut offsets.
+    (Candidate density is ~1 per avg_size, so the greedy pass is O(n/avg)
+    host work — negligible.)"""
+    n = len(data)
+    ends = candidate_ends_host(data, params)
+    return select_cuts(ends, n, params)

@@ -1,0 +1,104 @@
+"""Cross-snapshot near-duplicate detection: simhash + minhash over chunk
+digests (BASELINE.json config #5: "minhash/simhash over 10k historical pxar
+chunk digests").
+
+simhash: each digest's 256 bits become a ±1 vector; a fixed random
+projection (MXU matmul) maps the batch to K-dim scores whose signs pack
+into K-bit sketches.  Snapshots are compared by Hamming distance between
+aggregated sketches (or per-chunk sketch sets).
+
+minhash: K universal-hash permutations over the digest set; the
+component-wise minimum forms the signature; expected fraction of equal
+components estimates Jaccard similarity of two snapshots' chunk sets.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _digests_to_bits(digests: jax.Array) -> jax.Array:
+    """uint8[N,32] → float32 ±1 [N,256] (bit order: byte-major, MSB first)."""
+    d = digests.astype(jnp.uint8)
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = (d[:, :, None] >> shifts[None, None, :]) & jnp.uint8(1)
+    bits = bits.reshape(d.shape[0], 256)
+    return bits.astype(jnp.float32) * 2.0 - 1.0
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _simhash(digests: jax.Array, proj: jax.Array, k: int) -> jax.Array:
+    scores = _digests_to_bits(digests) @ proj          # [N, k] — MXU
+    bits = (scores >= 0).astype(jnp.uint32)
+    words = bits.reshape(-1, k // 32, 32)
+    shifts = jnp.arange(31, -1, -1, dtype=jnp.uint32)
+    return jnp.sum(words << shifts[None, None, :], axis=-1, dtype=jnp.uint32)
+
+
+def simhash_projection(k: int = 64, seed: int = 1234) -> jax.Array:
+    key = jax.random.PRNGKey(seed)
+    return jax.random.normal(key, (256, k), dtype=jnp.float32)
+
+
+def simhash_sketch(digests: np.ndarray | jax.Array, *, k: int = 64,
+                   proj: jax.Array | None = None) -> jax.Array:
+    """uint8[N,32] digests → uint32[N, k/32] sketches."""
+    if k % 32:
+        raise ValueError("k must be a multiple of 32")
+    if proj is None:
+        proj = simhash_projection(k)
+    d = jnp.asarray(digests, dtype=jnp.uint8).reshape(-1, 32)
+    return _simhash(d, proj, k)
+
+
+@jax.jit
+def _popcount32(x: jax.Array) -> jax.Array:
+    x = x - ((x >> np.uint32(1)) & np.uint32(0x55555555))
+    x = (x & np.uint32(0x33333333)) + ((x >> np.uint32(2)) & np.uint32(0x33333333))
+    x = (x + (x >> np.uint32(4))) & np.uint32(0x0F0F0F0F)
+    return (x * np.uint32(0x01010101)) >> np.uint32(24)
+
+
+@jax.jit
+def pairwise_hamming(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a uint32[N,W], b uint32[M,W] → int32[N,M] Hamming distances."""
+    x = a[:, None, :] ^ b[None, :, :]
+    return jnp.sum(_popcount32(x), axis=-1).astype(jnp.int32)
+
+
+def _minhash_params(k: int, seed: int) -> tuple[np.ndarray, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    a = rng.integers(1, 1 << 32, size=k, dtype=np.uint64) | 1  # odd multipliers
+    b = rng.integers(0, 1 << 32, size=k, dtype=np.uint64)
+    return a.astype(np.uint32), b.astype(np.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _minhash(digests: jax.Array, a: jax.Array, b: jax.Array, k: int) -> jax.Array:
+    # mix each digest into one uint32, then k affine hashes, min over set
+    d = digests.astype(jnp.uint32)
+    w = (d[:, 0] << np.uint32(24)) | (d[:, 1] << np.uint32(16)) \
+        | (d[:, 2] << np.uint32(8)) | d[:, 3]
+    w = w ^ ((d[:, 4] << np.uint32(24)) | (d[:, 5] << np.uint32(16))
+             | (d[:, 6] << np.uint32(8)) | d[:, 7])
+    h = w[:, None] * a[None, :] + b[None, :]           # uint32 wrap [N, k]
+    return jnp.min(h, axis=0)
+
+
+def minhash_signature(digests: np.ndarray | jax.Array, *, k: int = 128,
+                      seed: int = 99) -> np.ndarray:
+    """uint8[N,32] digest set → uint32[k] minhash signature."""
+    d = jnp.asarray(digests, dtype=jnp.uint8).reshape(-1, 32)
+    a, b = _minhash_params(k, seed)
+    return np.asarray(_minhash(d, jnp.asarray(a), jnp.asarray(b), k))
+
+
+def minhash_similarity(sig_a: np.ndarray, sig_b: np.ndarray) -> float:
+    """Estimated Jaccard similarity of two digest sets."""
+    if sig_a.shape != sig_b.shape:
+        raise ValueError("signature length mismatch")
+    return float(np.mean(sig_a == sig_b))

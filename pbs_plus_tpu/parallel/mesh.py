@@ -1,0 +1,36 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: int | None = None, *,
+              axis_names: tuple[str, str] = ("data", "index"),
+              index_parallel: int | None = None) -> Mesh:
+    """2D mesh (data × index).  ``index_parallel`` defaults to 2 when the
+    device count is even (so collectives are exercised on both axes), else 1.
+    """
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    devs = devs[:n]
+    if index_parallel is None:
+        index_parallel = 2 if n % 2 == 0 and n >= 2 else 1
+    if n % index_parallel:
+        raise ValueError("index_parallel must divide device count")
+    shape = (n // index_parallel, index_parallel)
+    return Mesh(np.array(devs).reshape(shape), axis_names)
+
+
+def make_seq_mesh(n_devices: int | None = None, *,
+                  axis_name: str = "seq") -> Mesh:
+    """1D mesh for sequence-parallel chunking of one long stream."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (axis_name,))

@@ -15,6 +15,7 @@ half-snapshot visible (crash-safety rule from SURVEY §5.3).
 
 from __future__ import annotations
 
+import datetime as _dt
 import os
 import shutil
 import time
@@ -52,9 +53,9 @@ class BackupSession:
             chunker_factory=chunker_factory,
         )
         self._final_dir = store.datastore.snapshot_dir(ref)
-        self._tmp_dir = self._final_dir + ".tmp"
-        if os.path.exists(self._tmp_dir):
-            shutil.rmtree(self._tmp_dir)
+        # unique staging dir: concurrent same-second sessions must never
+        # share (or rmtree) each other's in-progress state
+        self._tmp_dir = f"{self._final_dir}.tmp.{os.getpid()}.{id(self):x}"
         os.makedirs(self._tmp_dir)
         self._done = False
 
@@ -63,24 +64,41 @@ class BackupSession:
         return self._prev_reader
 
     def finish(self, extra_manifest: dict | None = None) -> dict:
-        """Flush writers, write indexes + manifest, publish atomically."""
+        """Flush writers, write indexes + manifest, publish atomically.
+        On failure the staging dir is removed and the session is dead —
+        the datastore never sees a half-snapshot."""
         if self._done:
             raise RuntimeError("session already finished")
+        try:
+            midx, pidx, stats = self.writer.finish()
+            ds = self.store.datastore
+            midx.write(os.path.join(self._tmp_dir, ds.META_IDX))
+            pidx.write(os.path.join(self._tmp_dir, ds.PAYLOAD_IDX))
+            # same-second concurrent sessions: re-check the final dir at
+            # publish time and bump +1 s until free
+            while os.path.exists(self._final_dir):
+                t = _dt.datetime.strptime(
+                    self.ref.backup_time, "%Y-%m-%dT%H:%M:%SZ"
+                ).replace(tzinfo=_dt.timezone.utc).timestamp() + 1.0
+                self.ref = SnapshotRef(self.ref.backup_type,
+                                       self.ref.backup_id,
+                                       format_backup_time(t))
+                self._final_dir = ds.snapshot_dir(self.ref)
+            manifest = write_manifest(
+                os.path.join(self._tmp_dir, ds.MANIFEST),
+                ref=self.ref, midx=midx, pidx=pidx, stats=stats,
+                payload_params=self.store.params,
+                entry_count=self.writer.entry_count,
+                previous=str(self.previous_ref) if self.previous_ref else None,
+                extra=extra_manifest,
+            )
+            os.makedirs(os.path.dirname(self._final_dir), exist_ok=True)
+            os.replace(self._tmp_dir, self._final_dir)
+        except BaseException:
+            self._done = True
+            shutil.rmtree(self._tmp_dir, ignore_errors=True)
+            raise
         self._done = True
-        midx, pidx, stats = self.writer.finish()
-        ds = self.store.datastore
-        midx.write(os.path.join(self._tmp_dir, ds.META_IDX))
-        pidx.write(os.path.join(self._tmp_dir, ds.PAYLOAD_IDX))
-        manifest = write_manifest(
-            os.path.join(self._tmp_dir, ds.MANIFEST),
-            ref=self.ref, midx=midx, pidx=pidx, stats=stats,
-            payload_params=self.store.params,
-            entry_count=self.writer.entry_count,
-            previous=str(self.previous_ref) if self.previous_ref else None,
-            extra=extra_manifest,
-        )
-        os.makedirs(os.path.dirname(self._final_dir), exist_ok=True)
-        os.replace(self._tmp_dir, self._final_dir)
         return manifest
 
     def abort(self) -> None:

@@ -64,7 +64,6 @@ class ChunkStore:
         os.makedirs(self.base, exist_ok=True)
         self._cctx = zstandard.ZstdCompressor(level=compression_level)
         self._dctx = zstandard.ZstdDecompressor()
-        self._lock = threading.Lock()
 
     def _path(self, digest: bytes) -> str:
         h = digest.hex()
@@ -73,14 +72,16 @@ class ChunkStore:
     def has(self, digest: bytes) -> bool:
         return os.path.exists(self._path(digest))
 
-    def insert(self, digest: bytes, data: bytes) -> bool:
-        """Store a chunk; returns True if it was new.  Verifies the digest
-        (corrupt-write containment)."""
+    def insert(self, digest: bytes, data: bytes, *, verify: bool = True) -> bool:
+        """Store a chunk; returns True if it was new.  ``verify`` re-hashes
+        for corrupt-write containment — writers that just computed the
+        digest from the same buffer pass verify=False to avoid double
+        hashing on the hot path."""
         p = self._path(digest)
         if os.path.exists(p):
             self.touch(digest)
             return False
-        if hashlib.sha256(data).digest() != digest:
+        if verify and hashlib.sha256(data).digest() != digest:
             raise ValueError("chunk digest mismatch on insert")
         os.makedirs(os.path.dirname(p), exist_ok=True)
         tmp = f"{p}.tmp.{os.getpid()}.{threading.get_ident()}"

@@ -9,6 +9,7 @@ by tests to build golden archives from real trees.
 from __future__ import annotations
 
 import os
+import stat as statmod
 from typing import Callable, Iterator
 
 from .format import Entry, KIND_HARDLINK, entry_from_stat
@@ -56,7 +57,6 @@ def iter_tree(root: str, *, exclude: ExcludeFn | None = None,
                 continue
             if one_file_system and st.st_dev != root_dev:
                 continue
-            import stat as statmod
             if statmod.S_ISLNK(st.st_mode):
                 try:
                     target = os.readlink(abs_p)

@@ -1,0 +1,286 @@
+"""aRPC tests over real TLS loopback connections with a self-contained test
+PKI (reference: internal/arpc/arpc_test.go:26-120 — CA + leaf issuance
+driving real TCP+TLS+smux loopback; echo, concurrency, deadline, error
+mapping, raw-stream handshake, rejection, leak discipline)."""
+
+import asyncio
+import os
+import threading
+
+import pytest
+
+from pbs_plus_tpu.arpc import (
+    AgentsManager, HandlerError, MAX_FRAME, Request, Response, Router,
+    Session, TlsClientConfig, TlsServerConfig, connect_to_server,
+    receive_data_into, send_data_from_reader, serve,
+)
+from pbs_plus_tpu.arpc.call import CallError, RawStreamHandler
+from pbs_plus_tpu.arpc.transport import HandshakeError
+from pbs_plus_tpu.utils import mtls
+
+
+@pytest.fixture(scope="module")
+def pki(tmp_path_factory):
+    """Test PKI: CA + server leaf + two agent leaves."""
+    d = tmp_path_factory.mktemp("pki")
+    cm = mtls.CertManager(str(d))
+    cm.load_or_create_ca()
+    cm.ensure_server_identity("server.test")
+    paths = {"ca": cm.ca_cert_path, "server_cert": cm.server_cert_path,
+             "server_key": cm.server_key_path}
+    for name in ("agent-1", "agent-2"):
+        cert, key = cm.issue(name)
+        cp, kp = str(d / f"{name}.pem"), str(d / f"{name}.key")
+        open(cp, "wb").write(cert)
+        open(kp, "wb").write(key)
+        paths[name] = (cp, kp)
+    return paths
+
+
+def run_async(coro):
+    """Each test gets a fresh loop (leak discipline: the loop is closed and
+    all tasks must have completed)."""
+    return asyncio.run(coro)
+
+
+def make_router():
+    r = Router()
+
+    async def echo(req, ctx):
+        return req.payload
+
+    async def fail(req, ctx):
+        raise HandlerError("nope", status=418)
+
+    async def crash(req, ctx):
+        raise RuntimeError("boom")
+
+    async def slow(req, ctx):
+        await asyncio.sleep(5)
+        return "late"
+
+    async def download(req, ctx):
+        size = int(req.payload["n"])
+        data = bytes(range(256)) * (size // 256 + 1)
+
+        async def pump(stream):
+            await send_data_from_reader(stream, data[:size], size)
+        return RawStreamHandler(pump, data={"size": size})
+
+    r.handle("echo", echo)
+    r.handle("fail", fail)
+    r.handle("crash", crash)
+    r.handle("slow", slow)
+    r.handle("download", download)
+    return r
+
+
+async def start_server(pki, am: AgentsManager | None = None, port=0):
+    router = make_router()
+    sessions = []
+
+    async def on_conn(conn, peer, headers):
+        if am is not None:
+            sess = await am.register(peer, headers, conn)
+            sessions.append(sess)
+            try:
+                await router.serve_connection(conn, context=sess)
+            finally:
+                await am.unregister(sess)
+        else:
+            await router.serve_connection(conn)
+
+    tls = TlsServerConfig(pki["server_cert"], pki["server_key"], pki["ca"])
+    srv = await serve("127.0.0.1", port, tls, on_connection=on_conn,
+                      admit=am.admit if am else None)
+    return srv, srv.sockets[0].getsockname()[1], sessions
+
+
+def client_tls(pki, name="agent-1"):
+    cp, kp = pki[name]
+    return TlsClientConfig(cp, kp, pki["ca"])
+
+
+def test_echo_and_errors(pki):
+    async def main():
+        srv, port, _ = await start_server(pki)
+        conn = await connect_to_server("127.0.0.1", port, client_tls(pki))
+        s = Session(conn)
+        resp = await s.call("echo", {"x": 1, "b": b"\x00\xff"})
+        assert resp.data == {"x": 1, "b": b"\x00\xff"}
+        with pytest.raises(CallError) as ei:
+            await s.call("fail")
+        assert ei.value.response.status == 418
+        with pytest.raises(CallError) as ei:
+            await s.call("crash")
+        assert ei.value.response.status == 500
+        assert "boom" in ei.value.response.message
+        with pytest.raises(CallError) as ei:
+            await s.call("nosuch")
+        assert ei.value.response.status == 404
+        await conn.close()
+        srv.close()
+        await srv.wait_closed()
+    run_async(main())
+
+
+def test_concurrent_calls(pki):
+    async def main():
+        srv, port, _ = await start_server(pki)
+        conn = await connect_to_server("127.0.0.1", port, client_tls(pki))
+        s = Session(conn)
+        results = await asyncio.gather(
+            *[s.call("echo", i) for i in range(50)])
+        assert [r.data for r in results] == list(range(50))
+        await conn.close()
+        srv.close()
+        await srv.wait_closed()
+    run_async(main())
+
+
+def test_call_timeout(pki):
+    async def main():
+        srv, port, _ = await start_server(pki)
+        conn = await connect_to_server("127.0.0.1", port, client_tls(pki))
+        s = Session(conn)
+        with pytest.raises(asyncio.TimeoutError):
+            await s.call("slow", timeout=0.3)
+        # connection still usable after a timed-out call
+        assert (await s.call("echo", "ok")).data == "ok"
+        await conn.close()
+        srv.close()
+        await srv.wait_closed()
+    run_async(main())
+
+
+def test_raw_stream_download(pki):
+    async def main():
+        srv, port, _ = await start_server(pki)
+        conn = await connect_to_server("127.0.0.1", port, client_tls(pki))
+        s = Session(conn)
+        for size in (0, 1, 1000, 1 << 20):
+            buf = bytearray()
+            resp, n = await s.call_binary_into("download", {"n": size}, buf)
+            assert n == size == len(buf)
+            assert resp.data == {"size": size}
+            assert bytes(buf) == (bytes(range(256)) * (size // 256 + 1))[:size]
+        await conn.close()
+        srv.close()
+        await srv.wait_closed()
+    run_async(main())
+
+
+def test_mtls_required(pki, tmp_path):
+    """A client with a cert from a different CA is rejected at TLS."""
+    async def main():
+        srv, port, _ = await start_server(pki)
+        rogue_dir = tmp_path / "rogue"
+        rogue = mtls.CertManager(str(rogue_dir))
+        rogue.load_or_create_ca()
+        cert, key = rogue.issue("evil")
+        cp, kp = str(rogue_dir / "c.pem"), str(rogue_dir / "k.pem")
+        open(cp, "wb").write(cert)
+        open(kp, "wb").write(key)
+        with pytest.raises((ConnectionError, OSError, asyncio.TimeoutError,
+                            asyncio.IncompleteReadError, EOFError)):
+            await connect_to_server(
+                "127.0.0.1", port,
+                TlsClientConfig(cp, kp, pki["ca"]), timeout=5)
+        srv.close()
+        await srv.wait_closed()
+    run_async(main())
+
+
+def test_agents_manager_admission(pki):
+    async def main():
+        expected = {"agent-1"}
+
+        async def is_expected(cn, der):
+            return cn in expected
+
+        am = AgentsManager(is_expected=is_expected)
+        srv, port, _ = await start_server(pki, am)
+        # expected host connects
+        conn = await connect_to_server("127.0.0.1", port, client_tls(pki))
+        await asyncio.sleep(0.1)
+        assert am.get("agent-1") is not None
+        # unexpected host rejected with code
+        with pytest.raises(HandshakeError) as ei:
+            await connect_to_server("127.0.0.1", port,
+                                    client_tls(pki, "agent-2"))
+        assert ei.value.code == 403
+        # job session requires expect()
+        with pytest.raises(HandshakeError):
+            await connect_to_server(
+                "127.0.0.1", port, client_tls(pki),
+                headers={"X-PBS-Plus-BackupID": "job9"})
+        am.expect("agent-1|job9")
+        wait_task = asyncio.create_task(am.wait_session("agent-1|job9", 5))
+        jconn = await connect_to_server(
+            "127.0.0.1", port, client_tls(pki),
+            headers={"X-PBS-Plus-BackupID": "job9"})
+        sess = await wait_task
+        assert sess.client_id == "agent-1|job9"
+        # duplicate primary session evicts the old one (newest wins)
+        old_sess = am.get("agent-1")
+        conn2 = await connect_to_server("127.0.0.1", port, client_tls(pki))
+        await asyncio.sleep(0.2)
+        assert conn.closed                       # old client conn torn down
+        new_sess = am.get("agent-1")
+        assert new_sess is not old_sess and not new_sess.conn.closed
+        assert old_sess.conn.closed
+        await jconn.close()
+        await conn2.close()
+        srv.close()
+        await srv.wait_closed()
+    run_async(main())
+
+
+def test_rate_limit(pki):
+    async def main():
+        async def yes(cn, der):
+            return True
+        am = AgentsManager(is_expected=yes, rate=5, burst=3)
+        srv, port, _ = await start_server(pki, am)
+        ok = rejected = 0
+        for _ in range(8):
+            try:
+                c = await connect_to_server("127.0.0.1", port,
+                                            client_tls(pki))
+                ok += 1
+                await c.close()
+            except HandshakeError as e:
+                assert e.code == 429
+                rejected += 1
+        assert rejected >= 1 and ok >= 3
+        srv.close()
+        await srv.wait_closed()
+    run_async(main())
+
+
+def test_frame_cap():
+    from pbs_plus_tpu.arpc.mux import MuxError
+
+    class FakeStream:
+        async def write(self, b): pass
+    async def main():
+        with pytest.raises(MuxError):
+            await send_data_from_reader(FakeStream(), b"", MAX_FRAME + 1)
+    run_async(main())
+
+
+def test_no_thread_leaks(pki):
+    """Leak discipline (reference: TestLeak_*): after a full client/server
+    cycle no extra threads survive."""
+    before = threading.active_count()
+
+    async def main():
+        srv, port, _ = await start_server(pki)
+        conn = await connect_to_server("127.0.0.1", port, client_tls(pki))
+        s = Session(conn)
+        await s.call("echo", "x")
+        await conn.close()
+        srv.close()
+        await srv.wait_closed()
+    run_async(main())
+    assert threading.active_count() <= before + 1

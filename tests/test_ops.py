@@ -1,0 +1,156 @@
+"""TPU ops parity gates (run on the CPU backend; same XLA programs run on
+TPU).  Cut-point + digest bit-parity vs the CPU implementations is
+BASELINE.md config #2."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from pbs_plus_tpu.chunker import ChunkerParams, candidates, chunk_bounds
+from pbs_plus_tpu.chunker.spec import buzhash_table, select_cuts
+from pbs_plus_tpu.ops import (
+    CuckooIndex, candidate_ends_host, candidate_mask, minhash_signature,
+    pairwise_hamming, sha256_chunks, sha256_stream_chunks, simhash_sketch,
+)
+from pbs_plus_tpu.ops.rolling_hash import chunk_stream_device
+from pbs_plus_tpu.ops.similarity import minhash_similarity
+
+import jax.numpy as jnp
+
+P = ChunkerParams(avg_size=4 << 10)
+
+
+def _data(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+# --- rolling hash --------------------------------------------------------
+
+def test_candidate_mask_matches_cpu():
+    data = _data(200_000)
+    want = candidates(data, P, force_numpy=True)
+    got = candidate_ends_host(data, P)
+    assert np.array_equal(want, got)
+
+
+def test_candidate_mask_with_history():
+    """Batched/segmented evaluation with 63-byte halo == whole-stream."""
+    data = np.frombuffer(_data(131_072, seed=2), dtype=np.uint8)
+    table = jnp.asarray(buzhash_table(P.seed))
+    whole = np.asarray(candidate_mask(jnp.asarray(data), table, P.mask, P.magic))
+    # split into 2 segments, pass history halo to the second
+    half = len(data) // 2
+    seg = jnp.asarray(data.reshape(2, half))
+    hist = jnp.stack([np.zeros(63, np.uint8), data[half - 63:half]])
+    got = np.asarray(candidate_mask(seg, table, P.mask, P.magic, history=hist))
+    # segment 0 with zero-history: only positions >= 63 valid (matches whole)
+    assert np.array_equal(got[0][63:], whole[:half][63:])
+    assert not got[0][:63].any()
+    # segment 1 with real halo: every position matches the whole stream
+    assert np.array_equal(got[1], whole[half:])
+
+
+def test_device_cuts_match_cpu_cuts():
+    data = _data(300_000, seed=3)
+    assert chunk_stream_device(data, P) == [e for _, e in chunk_bounds(data, P)]
+
+
+# --- sha256 --------------------------------------------------------------
+
+def test_sha256_matches_hashlib():
+    sizes = [0, 1, 54, 55, 56, 57, 63, 64, 65, 119, 120, 128, 1000,
+             4096, 65_537]
+    chunks = [_data(n, seed=n + 1) for n in sizes]
+    got = sha256_chunks(chunks)
+    want = [hashlib.sha256(c).digest() for c in chunks]
+    assert got == want
+
+
+def test_sha256_stream_bounds():
+    data = _data(150_000, seed=5)
+    bounds = [(s, e) for s, e in chunk_bounds(data, P)]
+    got = sha256_stream_chunks(data, bounds)
+    want = [hashlib.sha256(data[s:e]).digest() for s, e in bounds]
+    assert got == want
+
+
+def test_sha256_rejects_oversized():
+    with pytest.raises(ValueError):
+        sha256_stream_chunks(b"x", [(0, 1 << 30)])
+
+
+def test_fold_fingerprint_device_host_parity():
+    from pbs_plus_tpu.ops.fingerprint import fold_fingerprint, fold_fingerprint_host
+    import jax.numpy as jnp
+    rng = np.random.default_rng(3)
+    sizes = [1, 63, 64, 65, 400, 4096]
+    stream = rng.integers(0, 256, 8192, dtype=np.uint8)
+    starts = np.zeros(len(sizes), np.int32)
+    lens = np.array(sizes, np.int32)
+    t_max = 64
+    out = np.asarray(fold_fingerprint(jnp.asarray(stream), jnp.asarray(starts),
+                                      jnp.asarray(lens), t_max))
+    for i, n in enumerate(sizes):
+        want = fold_fingerprint_host(stream[:n].tobytes())
+        assert out[i].astype(">u4").tobytes() == want, n
+    # distinct content → distinct fingerprints
+    assert len({out[i].astype(">u4").tobytes() for i in range(len(sizes))}) == len(sizes)
+
+
+# --- cuckoo index --------------------------------------------------------
+
+def test_cuckoo_probe():
+    idx = CuckooIndex(n_buckets=1 << 10)
+    present = [hashlib.sha256(bytes([i, 1])).digest() for i in range(200)]
+    absent = [hashlib.sha256(bytes([i, 2])).digest() for i in range(200)]
+    for d in present:
+        assert idx.insert(d) is True
+    assert idx.insert(present[0]) is False
+    arr = np.frombuffer(b"".join(present + absent), np.uint8).reshape(-1, 32)
+    got = np.asarray(idx.probe(arr))
+    assert got[:200].all()                      # no false negatives ever
+    assert got[200:].sum() <= 2                 # fp rate ~2^-64: expect 0
+    conf = idx.probe_confirmed(present[:5] + absent[:5])
+    assert conf == [True] * 5 + [False] * 5
+
+
+def test_cuckoo_growth():
+    idx = CuckooIndex(n_buckets=8)             # 32 slots — forces growth
+    digests = [hashlib.sha256(bytes([i & 0xFF, i >> 8, 3])).digest()
+               for i in range(500)]
+    for d in digests:
+        idx.insert(d)
+    assert idx.n_buckets > 8
+    arr = np.frombuffer(b"".join(digests), np.uint8).reshape(-1, 32)
+    assert np.asarray(idx.probe(arr)).all()
+
+
+# --- similarity ----------------------------------------------------------
+
+def test_simhash_deterministic_and_discriminative():
+    a = np.frombuffer(b"".join(hashlib.sha256(bytes([i, 7])).digest()
+                               for i in range(64)), np.uint8).reshape(-1, 32)
+    s1 = np.asarray(simhash_sketch(a))
+    s2 = np.asarray(simhash_sketch(a))
+    assert np.array_equal(s1, s2)
+    d_self = np.asarray(pairwise_hamming(jnp.asarray(s1), jnp.asarray(s1)))
+    assert (np.diag(d_self) == 0).all()
+    # distinct digests → distances spread around k/2
+    off = d_self[~np.eye(len(d_self), dtype=bool)]
+    assert 10 < off.mean() < 54
+
+
+def test_minhash_estimates_jaccard():
+    base = [hashlib.sha256(bytes([i & 0xFF, i >> 8, 9])).digest()
+            for i in range(2000)]
+    half = [hashlib.sha256(bytes([i & 0xFF, i >> 8, 10])).digest()
+            for i in range(1000)]
+    set_a = base                                 # 2000 elements
+    set_b = base[:1000] + half                   # overlap 1000, union 3000
+    sig_a = minhash_signature(np.frombuffer(b"".join(set_a), np.uint8).reshape(-1, 32), k=256)
+    sig_b = minhash_signature(np.frombuffer(b"".join(set_b), np.uint8).reshape(-1, 32), k=256)
+    est = minhash_similarity(sig_a, sig_b)
+    true_j = 1000 / 3000
+    assert abs(est - true_j) < 0.12
+    assert minhash_similarity(sig_a, sig_a) == 1.0

@@ -240,6 +240,25 @@ def test_snapshot_listing_and_same_second_bump(tmp_path, tree):
     assert store.datastore.last_snapshot("host", "t1") == refs[-1]
 
 
+def test_concurrent_same_second_sessions(tmp_path, tree):
+    """Two sessions for the same group in the same second must stage
+    independently and both publish (finish-time bump)."""
+    store = LocalStore(str(tmp_path / "ds"), P)
+    t0 = 1_700_000_000.0
+    s1 = store.start_session(backup_type="host", backup_id="t1", backup_time=t0)
+    s2 = store.start_session(backup_type="host", backup_id="t1", backup_time=t0)
+    backup_tree(s1, tree)
+    backup_tree(s2, tree)
+    m1 = s1.finish()
+    m2 = s2.finish()
+    assert m1["backup_time"] != m2["backup_time"]
+    snaps = store.datastore.list_snapshots("host", "t1")
+    assert len(snaps) == 2
+    for ref in snaps:
+        r = store.open_snapshot(ref)
+        assert len(list(r.entries())) == m1["entries"]
+
+
 def test_abort_leaves_no_snapshot(tmp_path, tree):
     store = LocalStore(str(tmp_path / "ds"), P)
     s = store.start_session(backup_type="host", backup_id="t1")
