@@ -1,0 +1,7 @@
+"""L3 agent (reference: internal/agent + cmd/agent, SURVEY §2.4).
+
+Components: bootstrap (CSR → server-signed cert), control-plane lifecycle
+(reconnect with backoff+jitter, handler table), agentfs (read-only remote
+file server for backups), snapshot manager (direct/LVM/btrfs/zfs), config
+registry (sealed secrets), fork-per-job CLI.
+"""

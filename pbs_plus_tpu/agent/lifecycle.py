@@ -1,0 +1,239 @@
+"""Agent control-plane lifecycle: connect, serve handlers, reconnect.
+
+Reference: internal/agent/lifecycle/manager.go:153-365 — ConnectARPC with
+exponential backoff + jitter (500 ms → 30 s, ×2, ±20%), handler table
+{ping, backup, restore, filetree, target_status, cleanup, cleanup_restore,
+verify_start, update}, cert-error → clear certs + re-bootstrap.
+
+Job execution model: the reference forks a child per job
+(internal/agent/cli/entry.go:14-88) so a crashing job can't take down the
+control session, and the child opens its own data connection carrying the
+X-PBS-Plus-BackupID header.  This build runs jobs as asyncio tasks by
+default (each with its own data connection — same wire behavior) and
+supports subprocess isolation via ``python -m pbs_plus_tpu.agent.cli``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..arpc import Router, Session, TlsClientConfig, connect_to_server
+from ..arpc.agents_manager import HDR_BACKUP_ID, HDR_RESTORE_ID
+from ..arpc.mux import MuxConnection
+from ..utils.log import L
+from .agentfs import AgentFSServer
+from .snapshots import Snapshot, SnapshotManager
+
+BACKOFF_MIN_S = 0.5
+BACKOFF_MAX_S = 30.0
+
+
+@dataclass
+class ActiveJob:
+    job_id: str
+    kind: str                    # backup | restore
+    conn: MuxConnection
+    snapshot: Snapshot | None
+    task: asyncio.Task | None = None
+
+
+@dataclass
+class AgentConfig:
+    hostname: str
+    server_host: str
+    server_port: int
+    tls: TlsClientConfig
+
+
+class AgentLifecycle:
+    """Owns the control session and job sessions."""
+
+    def __init__(self, config: AgentConfig, *,
+                 snapshot_manager: SnapshotManager | None = None):
+        self.config = config
+        self.snapshots = snapshot_manager or SnapshotManager()
+        self.router = Router()
+        self.jobs: dict[str, ActiveJob] = {}
+        self.conn: MuxConnection | None = None
+        self._stop = asyncio.Event()
+        self._register_handlers()
+        self.log = L.with_scope(agent=config.hostname)
+
+    # -- handlers ----------------------------------------------------------
+    def _register_handlers(self) -> None:
+        r = self.router
+        r.handle("ping", self._ping)
+        r.handle("target_status", self._target_status)
+        r.handle("backup", self._backup_start)
+        r.handle("cleanup", self._cleanup)
+        r.handle("restore", self._restore_start)
+        r.handle("cleanup_restore", self._cleanup)
+        r.handle("filetree", self._filetree)
+        r.handle("verify_start", self._verify_start)
+
+    async def _ping(self, req, ctx):
+        return {"pong": True, "hostname": self.config.hostname}
+
+    async def _target_status(self, req, ctx):
+        import os
+        path = req.payload.get("path", "/")
+        return {"ok": os.path.exists(path), "path": path}
+
+    async def _filetree(self, req, ctx):
+        """Shallow directory listing for the UI's file-tree browser."""
+        import os
+        path = req.payload.get("path", "/")
+        out = []
+        try:
+            with os.scandir(path) as it:
+                for e in sorted(it, key=lambda x: x.name)[:1000]:
+                    out.append({"name": e.name,
+                                "dir": e.is_dir(follow_symlinks=False)})
+        except OSError as e:
+            from ..arpc.router import HandlerError
+            raise HandlerError(str(e), status=404)
+        return {"entries": out}
+
+    async def _backup_start(self, req, ctx):
+        """Server-initiated backup: snapshot the source, open a job data
+        session, serve agentfs on it (reference: sync.BackupStartHandler →
+        cli.ExecBackup, SURVEY §3.2)."""
+        job_id = req.payload["job_id"]
+        source = req.payload["source"]
+        if job_id in self.jobs:
+            return {"ok": True, "already": True}
+        snap = await asyncio.get_running_loop().run_in_executor(
+            None, self.snapshots.create, source)
+        try:
+            conn = await connect_to_server(
+                self.config.server_host, self.config.server_port,
+                self.config.tls, headers={HDR_BACKUP_ID: job_id})
+        except BaseException:
+            self.snapshots.cleanup(snap)
+            raise
+        fs = AgentFSServer(snap.snapshot_path)
+        job_router = Router()
+        fs.register(job_router)
+        job = ActiveJob(job_id, "backup", conn, snap)
+        job.task = asyncio.create_task(
+            self._serve_job(job, job_router, fs))
+        self.jobs[job_id] = job
+        self.log.info("backup job session opened")
+        return {"ok": True, "snapshot_method": snap.method}
+
+    async def _restore_start(self, req, ctx):
+        """Server-initiated restore: open a job session on which the agent
+        *drives* the restore (pulls archive content from the server's
+        remote-pxar handlers and writes files locally)."""
+        from .restore import run_restore_job
+        job_id = req.payload["job_id"]
+        dest = req.payload["destination"]
+        if job_id in self.jobs:
+            return {"ok": True, "already": True}
+        conn = await connect_to_server(
+            self.config.server_host, self.config.server_port,
+            self.config.tls, headers={HDR_RESTORE_ID: job_id})
+        job = ActiveJob(job_id, "restore", conn, None)
+        job.task = asyncio.create_task(
+            self._run_restore(job, dest))
+        self.jobs[job_id] = job
+        return {"ok": True}
+
+    async def _run_restore(self, job: ActiveJob, dest: str) -> None:
+        from .restore import run_restore_job
+        try:
+            await run_restore_job(Session(job.conn), dest)
+        except Exception:
+            self.log.exception("restore job failed")
+        finally:
+            await job.conn.close()
+            self.jobs.pop(job.job_id, None)
+
+    async def _serve_job(self, job: ActiveJob, router: Router,
+                         fs: AgentFSServer) -> None:
+        try:
+            await router.serve_connection(job.conn)
+        finally:
+            fs.close_all()
+            if job.snapshot is not None:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.snapshots.cleanup, job.snapshot)
+            self.jobs.pop(job.job_id, None)
+            self.log.info("backup job session closed")
+
+    async def _cleanup(self, req, ctx):
+        """Kill a job session (reference: sync/backup.go:69-100)."""
+        job_id = req.payload["job_id"]
+        job = self.jobs.pop(job_id, None)
+        if job is not None:
+            await job.conn.close()
+            if job.task:
+                try:
+                    await asyncio.wait_for(job.task, 10)
+                except (asyncio.TimeoutError, Exception):
+                    pass
+        return {"ok": True, "found": job is not None}
+
+    async def _verify_start(self, req, ctx):
+        """Agent-side hash of a local file for spot-check verification
+        (reference: internal/agent/verification/handler.go:70-93)."""
+        import hashlib
+        path = req.payload["path"]
+        h = hashlib.sha256()
+        def _hash():
+            with open(path, "rb") as f:
+                while True:
+                    b = f.read(4 << 20)
+                    if not b:
+                        break
+                    h.update(b)
+            return h.hexdigest()
+        try:
+            digest = await asyncio.get_running_loop().run_in_executor(None, _hash)
+        except OSError as e:
+            from ..arpc.router import HandlerError
+            raise HandlerError(str(e), status=404)
+        return {"sha256": digest}
+
+    # -- connection loop ---------------------------------------------------
+    async def run(self) -> None:
+        """Reconnect loop with exponential backoff + jitter."""
+        backoff = BACKOFF_MIN_S
+        while not self._stop.is_set():
+            try:
+                self.conn = await connect_to_server(
+                    self.config.server_host, self.config.server_port,
+                    self.config.tls)
+                self.log.info("control session connected")
+                backoff = BACKOFF_MIN_S
+                await self.router.serve_connection(self.conn)
+                self.log.warning("control session lost: %s",
+                                 self.conn.close_reason)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.log.warning("connect failed: %s", e)
+            if self._stop.is_set():
+                return
+            sleep = backoff * (1 + random.uniform(-0.2, 0.2))
+            backoff = min(backoff * 2, BACKOFF_MAX_S)
+            try:
+                await asyncio.wait_for(self._stop.wait(), sleep)
+            except asyncio.TimeoutError:
+                pass
+
+    async def connect_once(self) -> None:
+        """Single connect + serve (tests / foreground)."""
+        self.conn = await connect_to_server(
+            self.config.server_host, self.config.server_port, self.config.tls)
+        await self.router.serve_connection(self.conn)
+
+    async def stop(self) -> None:
+        self._stop.set()
+        for job in list(self.jobs.values()):
+            await job.conn.close()
+        if self.conn is not None:
+            await self.conn.close()

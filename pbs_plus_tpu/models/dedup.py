@@ -30,9 +30,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..chunker.spec import WINDOW, ChunkerParams, buzhash_table, select_cuts
+from ..chunker.spec import WINDOW, ChunkerParams, select_cuts
 from ..ops.cuckoo import CuckooIndex
-from ..ops.rolling_hash import candidate_mask
+from ..ops.rolling_hash import candidate_mask, device_tables
 from ..ops.sha256 import sha256_stream_chunks
 
 
@@ -80,7 +80,7 @@ class DedupPipeline:
         self.params = self.config.params
         self.index = index if index is not None else CuckooIndex(
             n_buckets=self.config.index_buckets)
-        self._table = jnp.asarray(buzhash_table(self.params.seed))
+        self._tables = device_tables(self.params)
         self.stats = {"bytes_in": 0, "chunks": 0, "new_chunks": 0,
                       "device_steps": 0}
 
@@ -110,7 +110,7 @@ class DedupPipeline:
                 S_pad = max(1 << 14, 1 << int(S - 1).bit_length())
                 buf = np.zeros((1, S_pad), dtype=np.uint8)
                 buf[0, :S] = part
-                m = candidate_mask(jnp.asarray(buf), self._table,
+                m = candidate_mask(jnp.asarray(buf), self._tables,
                                    self.params.mask, self.params.magic,
                                    history=jnp.asarray(hist))
                 self.stats["device_steps"] += 1
@@ -159,7 +159,7 @@ class TpuChunker:
 
     def __init__(self, params: ChunkerParams):
         self.params = params
-        self._table = jnp.asarray(buzhash_table(params.seed))
+        self._tables = device_tables(params)
         self._tail = np.zeros(WINDOW - 1, dtype=np.uint8)
         self._seen = 0
         self._chunk_start = 0
@@ -173,7 +173,7 @@ class TpuChunker:
         buf = np.zeros((1, S_pad), dtype=np.uint8)
         buf[0, :S] = data
         hist = self._tail[None]
-        m = candidate_mask(jnp.asarray(buf), self._table, self.params.mask,
+        m = candidate_mask(jnp.asarray(buf), self._tables, self.params.mask,
                            self.params.magic, history=jnp.asarray(hist))
         hits = np.nonzero(np.asarray(m)[0, :S])[0]
         valid = hits + self._seen >= WINDOW - 1

@@ -21,9 +21,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
-from ..chunker.spec import ChunkerParams, buzhash_table
+from ..chunker.spec import ChunkerParams
 from ..ops.cuckoo import CuckooIndex
-from ..ops.rolling_hash import _candidate_mask_impl
+from ..ops.rolling_hash import _candidate_mask_impl, device_tables
 from ..ops.sha256 import _sha256_scan_impl
 from ..ops.similarity import simhash_projection
 from .dist_index import _probe_local
@@ -78,8 +78,8 @@ def multichip_dedup_step(mesh: Mesh, *, chunk_len: int, n_buckets: int,
         data_axis=data_axis, index_axis=index_axis)
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(P(data_axis, None), P(), P(index_axis, None, None),
-                  P(), P(), P()),
+        in_specs=(P(data_axis, None), P(None, None),
+                  P(index_axis, None, None), P(), P(), P()),
         out_specs=(P(data_axis), P(data_axis), P(data_axis, None), P()),
     )
     return jax.jit(fn)
@@ -97,7 +97,7 @@ def build_step_inputs(mesh: Mesh, *, batch: int, seg_len: int,
     streams = rng.integers(0, 256, (batch, seg_len), dtype=np.uint8)
     s_sharded = jax.device_put(
         jnp.asarray(streams), NamedSharding(mesh, P(data_axis, None)))
-    table = jnp.asarray(buzhash_table(params.seed))
+    table = device_tables(params)
     idx_tab = jax.device_put(
         jnp.asarray(index._table),
         NamedSharding(mesh, P(index_axis, None, None)))

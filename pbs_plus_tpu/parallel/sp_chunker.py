@@ -18,8 +18,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
-from ..chunker.spec import WINDOW, ChunkerParams, buzhash_table, select_cuts
-from ..ops.rolling_hash import _candidate_mask_impl
+from ..chunker.spec import WINDOW, ChunkerParams, select_cuts
+from ..ops.rolling_hash import _candidate_mask_impl, device_tables
 
 
 def _sp_mask_local(local: jax.Array, table: jax.Array, mask: jax.Array,
@@ -45,7 +45,7 @@ def sp_candidate_mask(mesh: Mesh, data: jax.Array, params: ChunkerParams,
     """Candidate mask of a single stream uint8[S] sharded over ``axis_name``
     (S must divide evenly by the axis size; pad on host if needed).
     Returns bool[S] with the same sharding."""
-    table = jnp.asarray(buzhash_table(params.seed))
+    table = device_tables(params)
     fn = shard_map(
         functools.partial(_sp_mask_local, axis_name=axis_name),
         mesh=mesh,

@@ -22,6 +22,7 @@ import time
 from dataclasses import dataclass
 
 from ..chunker import ChunkerParams
+from ..utils.log import L
 from .datastore import Datastore, SnapshotRef, format_backup_time, parse_backup_type
 from .transfer import (
     ChunkerFactory, DedupWriter, SplitReader, _default_chunker_factory,
@@ -131,6 +132,21 @@ class LocalStore:
             previous = previous.ref
         if previous is None and auto_previous:
             previous = self.datastore.last_snapshot(backup_type, backup_id)
+        if previous is not None:
+            # refuse ref-dedup across chunk-format/param changes — cuts
+            # would not line up and the link would silently destroy dedup
+            try:
+                man = self.datastore.load_manifest(previous)
+                ch = man.get("chunker", {})
+                from ..chunker import spec as _spec
+                if (ch.get("format", _spec.CHUNK_FORMAT) != _spec.CHUNK_FORMAT
+                        or ch.get("avg") != self.params.avg_size
+                        or ch.get("seed") != self.params.seed):
+                    L.warning("previous snapshot %s uses a different chunk "
+                              "format/params; starting a full backup", previous)
+                    previous = None
+            except OSError:
+                previous = None
         t = backup_time if backup_time is not None else time.time()
         ref = SnapshotRef(backup_type, backup_id, format_backup_time(t))
         while os.path.exists(self.datastore.snapshot_dir(ref)):

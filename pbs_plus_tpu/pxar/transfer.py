@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Iterator
 
 from ..chunker import ChunkerParams, CpuChunker
+from ..chunker import spec as _spec
 from .datastore import ChunkStore, Datastore, DynamicIndex, SnapshotRef
 from .format import Entry, KIND_DIR, KIND_FILE, decode_entries
 
@@ -484,6 +485,7 @@ def write_manifest(path: str, *, ref: SnapshotRef, midx: DynamicIndex,
         "meta_chunks": len(midx),
         "payload_chunks": len(pidx),
         "chunker": {
+            "format": _spec.CHUNK_FORMAT,
             "avg": payload_params.avg_size,
             "min": payload_params.min_size,
             "max": payload_params.max_size,

@@ -1,0 +1,9 @@
+"""L4/L5 server core (reference: internal/server, SURVEY §2.5).
+
+Components: sqlite database (jobs/targets/hosts/tokens/exclusions),
+jobs.Manager (dedup by id, dynamic-capacity queue + concurrency semaphore),
+scheduler (calendar ticks + retry policy), backup/restore/verification job
+factories driving OUR archive writer (no proxmox-backup-client exec —
+SURVEY §2.9), the aRPC listener wiring with AgentsManager admission, the
+web API, metrics, notifications.
+"""

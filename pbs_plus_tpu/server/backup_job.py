@@ -1,0 +1,313 @@
+"""The backup job — the reference's most important path (SURVEY §3.2),
+TPU-first redesign.
+
+Reference flow: scheduler → preExecute (queued task log, pre-script,
+target_status probe, FUSE mount of agentfs) → execute (exec
+proxmox-backup-client against the mount; pbc reads cross kernel-FUSE +
+aRPC per read) → post-process logs → cleanup (unmount, kill agent child).
+
+This build owns the archive writer (SURVEY §2.9: no pbc exec), so the hot
+loop loses two kernel crossings: the server walks agentfs directly over
+aRPC and streams file content straight into the DedupWriter (whose chunker
+backend is the pluggable CPU/TPU pipeline).  Dataflow:
+
+    agent pread ← aRPC raw stream ← [async prefetcher] → bounded queue →
+    [writer thread: CDC chunker → chunk store] → DIDX + manifest
+
+The async side prefetches up to ``queue_depth`` file blocks ahead (the
+reference's readahead/buffer-pool role); the writer thread runs the
+synchronous dedup writer without blocking the event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..agent.agentfs import AgentFSClient
+from ..arpc import Session
+from ..arpc.agents_manager import AgentsManager
+from ..chunker import ChunkerParams, CpuChunker
+from ..pxar.backupproxy import BackupSession, LocalStore
+from ..pxar.format import (
+    Entry, KIND_DEVICE, KIND_DIR, KIND_FIFO, KIND_FILE, KIND_HARDLINK,
+    KIND_SOCKET, KIND_SYMLINK,
+)
+from ..utils.log import L
+from . import database
+
+READ_BLOCK = 8 << 20          # agentfs read granularity
+QUEUE_DEPTH = 8               # prefetched blocks in flight
+
+_SENTINEL = object()
+
+
+def make_chunker_factory(kind: str):
+    """The one-line config change (BASELINE.json): chunker = cpu | tpu."""
+    if kind == "tpu":
+        from ..models.dedup import TpuChunker
+        return lambda p: TpuChunker(p)
+    return lambda p: CpuChunker(p)
+
+
+@dataclass
+class BackupResult:
+    snapshot: str = ""
+    entries: int = 0
+    bytes_total: int = 0
+    files: int = 0
+    errors: list[str] = field(default_factory=list)
+    manifest: dict = field(default_factory=dict)
+
+
+class _QueuePumpReader:
+    """File-like .read(n) fed by a thread-safe queue of blocks (async
+    producer / sync writer-thread consumer)."""
+
+    def __init__(self, q: "queue.Queue"):
+        self._q = q
+        self._buf = b""
+        self._eof = False
+
+    def read(self, n: int = -1) -> bytes:
+        while not self._buf and not self._eof:
+            item = self._q.get()
+            if item is _SENTINEL:
+                self._eof = True
+                break
+            if isinstance(item, Exception):
+                self._eof = True
+                raise item
+            self._buf = item
+        if not self._buf:
+            return b""
+        if n < 0 or n >= len(self._buf):
+            out = self._buf
+            self._buf = b""
+        else:
+            out = self._buf[:n]
+            self._buf = self._buf[n:]
+        return out
+
+
+class RemoteTreeBackup:
+    """Walks an agentfs tree in archive (DFS) order and streams it into a
+    BackupSession writer."""
+
+    def __init__(self, client: AgentFSClient, session: BackupSession, *,
+                 exclusions: list[str] | None = None,
+                 job_log=None):
+        self.fs = client
+        self.session = session
+        self.exclusions = exclusions or []
+        self.log = job_log or L
+        self.result = BackupResult()
+        self._wq: queue.Queue = queue.Queue(maxsize=QUEUE_DEPTH)
+        self._writer_exc: BaseException | None = None
+        self._seen_inodes: dict[tuple[int, int], str] = {}
+
+    def _excluded(self, rel: str) -> bool:
+        for pat in self.exclusions:
+            p = pat.strip()
+            if not p:
+                continue
+            if p.startswith("/"):
+                p = p[1:]
+            if fnmatch.fnmatch(rel, p) or fnmatch.fnmatch("/" + rel, pat):
+                return True
+            # directory prefix patterns ("cache/" style)
+            if p.endswith("/") and (rel + "/").startswith(p):
+                return True
+        return False
+
+    @staticmethod
+    def _to_entry(rel: str, m: dict) -> Entry:
+        kind = m["kind"]
+        return Entry(
+            path=rel, kind=kind, mode=m["mode"], uid=m["uid"], gid=m["gid"],
+            mtime_ns=m["mtime_ns"],
+            size=m["size"] if kind == KIND_FILE else 0,
+            link_target=m.get("target", ""),
+            rdev=m.get("rdev", 0),
+        )
+
+    async def run(self) -> BackupResult:
+        writer_thread = threading.Thread(
+            target=self._writer_loop, name="backup-writer", daemon=True)
+        writer_thread.start()
+        try:
+            root_attr = await self.fs.attr("")
+            await self._put(("entry", self._to_entry("", root_attr), None))
+            await self._walk("")
+        except BaseException as e:
+            await self._put(e if isinstance(e, Exception) else RuntimeError(str(e)))
+            raise
+        finally:
+            await self._put(_SENTINEL)
+            await asyncio.get_running_loop().run_in_executor(
+                None, writer_thread.join)
+        if self._writer_exc is not None:
+            raise self._writer_exc
+        return self.result
+
+    async def _put(self, item) -> None:
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._wq.put, item)
+
+    async def _walk(self, rel: str) -> None:
+        seen_inodes = self._seen_inodes
+        try:
+            entries = await self.fs.read_dir(rel)
+        except Exception as e:
+            self.result.errors.append(f"{rel}: {e}")
+            return
+        for m in entries:
+            child = f"{rel}/{m['name']}" if rel else m["name"]
+            if self._excluded(child):
+                continue
+            kind = m["kind"]
+            e = self._to_entry(child, m)
+            if kind == KIND_DIR:
+                await self._put(("entry", e, None))
+                await self._walk(child)
+            elif kind == KIND_FILE:
+                key = (m.get("dev", 0), m.get("ino", 0))
+                if m.get("nlink", 1) > 1 and key in seen_inodes:
+                    e.kind = KIND_HARDLINK
+                    e.link_target = seen_inodes[key]
+                    e.size = 0
+                    await self._put(("entry", e, None))
+                else:
+                    if m.get("nlink", 1) > 1:
+                        seen_inodes[key] = child
+                    await self._stream_file(child, e)
+            elif kind in (KIND_SYMLINK, KIND_FIFO, KIND_SOCKET, KIND_DEVICE):
+                await self._put(("entry", e, None))
+            self.result.entries += 1
+
+    async def _stream_file(self, rel: str, entry: Entry) -> None:
+        """Prefetch file blocks over aRPC into the writer queue."""
+        try:
+            handle = await self.fs.open(rel)
+        except Exception as e:
+            self.result.errors.append(f"{rel}: open: {e}")
+            return
+        fq: queue.Queue = queue.Queue(maxsize=QUEUE_DEPTH)
+        await self._put(("file", entry, _QueuePumpReader(fq)))
+        off = 0
+        try:
+            while True:
+                block = await self.fs.read_at(handle, off, READ_BLOCK)
+                if not block:
+                    break
+                await asyncio.get_running_loop().run_in_executor(
+                    None, fq.put, block)
+                off += len(block)
+                self.result.bytes_total += len(block)
+        except Exception as e:
+            await asyncio.get_running_loop().run_in_executor(
+                None, fq.put, RuntimeError(f"read {rel}: {e}"))
+            self.result.errors.append(f"{rel}: read: {e}")
+            return
+        finally:
+            await asyncio.get_running_loop().run_in_executor(
+                None, fq.put, _SENTINEL)
+            try:
+                await self.fs.close(handle)
+            except Exception:
+                pass
+        self.result.files += 1
+
+    def _writer_loop(self) -> None:
+        w = self.session.writer
+        try:
+            while True:
+                item = self._wq.get()
+                if item is _SENTINEL:
+                    return
+                if isinstance(item, BaseException):
+                    return
+                tag, entry, reader = item
+                if tag == "entry":
+                    w.write_entry(entry)
+                else:
+                    w.write_entry_reader(entry, reader)
+        except BaseException as e:
+            self._writer_exc = e
+            # drain so the producer never blocks on a dead consumer
+            while True:
+                item = self._wq.get()
+                if item is _SENTINEL or isinstance(item, BaseException):
+                    return
+
+
+async def run_backup_job(row: database.BackupJobRow, *,
+                         db: database.Database,
+                         agents: AgentsManager,
+                         store: LocalStore,
+                         job_suffix: str | None = None) -> BackupResult:
+    """End-to-end agent backup: ask the agent to open a job session, walk
+    its agentfs, stream into a datastore session, publish the snapshot."""
+    job_id = job_suffix or f"{row.id}-{uuid.uuid4().hex[:8]}"
+    target = db.get_target(row.target)
+    if target is None:
+        raise RuntimeError(f"unknown target {row.target!r}")
+    hostname = target["hostname"] or row.target
+    log = L.with_scope(job_id=row.id, backup_id=job_id)
+
+    control = agents.get(hostname)
+    if control is None:
+        raise RuntimeError(f"agent {hostname!r} not connected")
+    control_sess = Session(control.conn)
+
+    # target_status probe over the control plane (reference: job.go:489-543)
+    st = await control_sess.call(
+        "target_status", {"path": row.source_path})
+    if not st.data.get("ok"):
+        raise RuntimeError(f"target path unavailable: {st.data}")
+    db.touch_target_online(row.target)
+
+    # announce + request the job data session (reference: Expect + "backup")
+    client_id = f"{hostname}|{job_id}"
+    agents.expect(client_id)
+    try:
+        resp = await control_sess.call(
+            "backup", {"job_id": job_id, "source": row.source_path},
+            timeout=120)
+        log.info("agent accepted backup (snapshot=%s)",
+                 resp.data.get("snapshot_method"))
+        job_sess_info = await agents.wait_session(client_id, timeout=60)
+        fs = AgentFSClient(Session(job_sess_info.conn))
+
+        session = store.start_session(
+            backup_type="host", backup_id=row.backup_id or row.target)
+        try:
+            pump = RemoteTreeBackup(
+                fs, session,
+                exclusions=row.exclusions + db.list_exclusions(row.id),
+                job_log=log)
+            result = await pump.run()
+            manifest = await asyncio.get_running_loop().run_in_executor(
+                None, session.finish,
+                {"job": row.id, "errors": pump.result.errors[:100]})
+            result.snapshot = str(session.ref)
+            result.manifest = manifest
+            log.info("backup complete: %d entries, %d bytes, snapshot %s",
+                     result.entries, result.bytes_total, result.snapshot)
+            return result
+        except BaseException:
+            session.abort()
+            raise
+    finally:
+        agents.unexpect(client_id)
+        # tear down the agent-side job session (reference: "cleanup" RPC)
+        try:
+            await control_sess.call("cleanup", {"job_id": job_id}, timeout=15)
+        except Exception:
+            pass

@@ -1,0 +1,469 @@
+"""SQLite database: schema migrations + typed CRUD.
+
+Reference: internal/server/database (~8.1k LoC) — modernc sqlite +
+golang-migrate (36 migrations) + sqlc-generated queries; domain types at
+types.go:10-238 (Backup/Restore/Target/VerificationJob/Exclusion/Token/
+AgentHost/JobStatus with typed ShouldRetry).
+
+Python sqlite3 (serialized mode) with an explicit migration list; secrets
+sealed via utils.crypto before they land in rows (reference:
+store.go:21 crypto.Seal).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..utils import crypto
+
+# -- job status (reference: database/types.go:36-47 typed JobStatus) -------
+STATUS_QUEUED = "queued"
+STATUS_RUNNING = "running"
+STATUS_SUCCESS = "success"
+STATUS_WARNING = "warnings"
+STATUS_ERROR = "error"
+STATUS_CANCELLED = "cancelled"
+
+RETRYABLE = {STATUS_ERROR}
+
+
+def should_retry(status: str) -> bool:
+    return status in RETRYABLE
+
+
+_MIGRATIONS: list[str] = [
+    # 001 — core tables
+    """
+    CREATE TABLE backup_jobs (
+        id TEXT PRIMARY KEY,
+        target TEXT NOT NULL,
+        source_path TEXT NOT NULL,
+        store TEXT NOT NULL DEFAULT '',
+        backup_id TEXT NOT NULL DEFAULT '',
+        schedule TEXT NOT NULL DEFAULT '',
+        retry INTEGER NOT NULL DEFAULT 0,
+        retry_interval_s INTEGER NOT NULL DEFAULT 60,
+        exclusions TEXT NOT NULL DEFAULT '[]',
+        chunker TEXT NOT NULL DEFAULT 'cpu',
+        pre_script TEXT NOT NULL DEFAULT '',
+        post_script TEXT NOT NULL DEFAULT '',
+        enabled INTEGER NOT NULL DEFAULT 1,
+        last_run_at REAL,
+        last_status TEXT,
+        last_error TEXT,
+        last_snapshot TEXT,
+        created_at REAL NOT NULL
+    );
+    """,
+    """
+    CREATE TABLE targets (
+        name TEXT PRIMARY KEY,
+        kind TEXT NOT NULL DEFAULT 'agent',     -- agent | local | s3
+        hostname TEXT NOT NULL DEFAULT '',
+        root_path TEXT NOT NULL DEFAULT '',
+        config TEXT NOT NULL DEFAULT '{}',
+        online_at REAL,
+        created_at REAL NOT NULL
+    );
+    """,
+    """
+    CREATE TABLE agent_hosts (
+        hostname TEXT PRIMARY KEY,
+        cert_pem BLOB NOT NULL,
+        cert_fingerprint TEXT NOT NULL,
+        drives TEXT NOT NULL DEFAULT '[]',
+        bootstrapped_at REAL NOT NULL,
+        renewed_at REAL
+    );
+    """,
+    """
+    CREATE TABLE tokens (
+        id TEXT PRIMARY KEY,
+        kind TEXT NOT NULL DEFAULT 'bootstrap',
+        sealed_secret BLOB NOT NULL,
+        created_at REAL NOT NULL,
+        expires_at REAL,
+        revoked INTEGER NOT NULL DEFAULT 0
+    );
+    """,
+    # 002 — restores + verification
+    """
+    CREATE TABLE restore_jobs (
+        id TEXT PRIMARY KEY,
+        target TEXT NOT NULL,
+        snapshot TEXT NOT NULL,
+        destination TEXT NOT NULL,
+        subpath TEXT NOT NULL DEFAULT '',
+        status TEXT,
+        error TEXT,
+        started_at REAL,
+        finished_at REAL,
+        created_at REAL NOT NULL
+    );
+    """,
+    """
+    CREATE TABLE verification_jobs (
+        id TEXT PRIMARY KEY,
+        store TEXT NOT NULL DEFAULT '',
+        schedule TEXT NOT NULL DEFAULT '',
+        sample_rate REAL NOT NULL DEFAULT 0.1,
+        run_on_backup INTEGER NOT NULL DEFAULT 0,
+        last_run_at REAL,
+        last_status TEXT,
+        last_report TEXT,
+        created_at REAL NOT NULL
+    );
+    """,
+    # 003 — task log + notifications
+    """
+    CREATE TABLE task_log (
+        upid TEXT PRIMARY KEY,
+        job_id TEXT NOT NULL,
+        kind TEXT NOT NULL,
+        status TEXT NOT NULL,
+        detail TEXT NOT NULL DEFAULT '',
+        log TEXT NOT NULL DEFAULT '',
+        started_at REAL NOT NULL,
+        finished_at REAL
+    );
+    """,
+    """
+    CREATE TABLE alert_settings (
+        key TEXT PRIMARY KEY,
+        value TEXT NOT NULL
+    );
+    """,
+    # 004 — exclusions as their own table (global + per-job)
+    """
+    CREATE TABLE exclusions (
+        id INTEGER PRIMARY KEY AUTOINCREMENT,
+        job_id TEXT NOT NULL DEFAULT '',      -- '' == global
+        pattern TEXT NOT NULL,
+        comment TEXT NOT NULL DEFAULT ''
+    );
+    """,
+]
+
+
+@dataclass
+class BackupJobRow:
+    id: str
+    target: str
+    source_path: str
+    store: str = ""
+    backup_id: str = ""
+    schedule: str = ""
+    retry: int = 0
+    retry_interval_s: int = 60
+    exclusions: list[str] = field(default_factory=list)
+    chunker: str = "cpu"
+    pre_script: str = ""
+    post_script: str = ""
+    enabled: bool = True
+    last_run_at: float | None = None
+    last_status: str | None = None
+    last_error: str | None = None
+    last_snapshot: str | None = None
+
+
+class Database:
+    def __init__(self, path: str, *, seal_key: bytes | None = None):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA foreign_keys=ON")
+        self._lock = threading.RLock()
+        self._seal_key = seal_key
+        self._migrate()
+
+    def _migrate(self) -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS schema_version (v INTEGER)")
+            row = self._conn.execute(
+                "SELECT v FROM schema_version").fetchone()
+            current = row["v"] if row else 0
+            if row is None:
+                self._conn.execute("INSERT INTO schema_version VALUES (0)")
+            for i, sql in enumerate(_MIGRATIONS[current:], start=current + 1):
+                self._conn.executescript(sql)
+                self._conn.execute("UPDATE schema_version SET v = ?", (i,))
+
+    def close(self) -> None:
+        self._conn.close()
+
+    # -- backup jobs -------------------------------------------------------
+    def upsert_backup_job(self, j: BackupJobRow) -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                """INSERT INTO backup_jobs (id,target,source_path,store,
+                   backup_id,schedule,retry,retry_interval_s,exclusions,
+                   chunker,pre_script,post_script,enabled,created_at)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)
+                   ON CONFLICT(id) DO UPDATE SET target=excluded.target,
+                     source_path=excluded.source_path, store=excluded.store,
+                     backup_id=excluded.backup_id, schedule=excluded.schedule,
+                     retry=excluded.retry,
+                     retry_interval_s=excluded.retry_interval_s,
+                     exclusions=excluded.exclusions, chunker=excluded.chunker,
+                     pre_script=excluded.pre_script,
+                     post_script=excluded.post_script,
+                     enabled=excluded.enabled""",
+                (j.id, j.target, j.source_path, j.store, j.backup_id,
+                 j.schedule, j.retry, j.retry_interval_s,
+                 json.dumps(j.exclusions), j.chunker, j.pre_script,
+                 j.post_script, int(j.enabled), time.time()))
+
+    def _row_to_job(self, r: sqlite3.Row) -> BackupJobRow:
+        return BackupJobRow(
+            id=r["id"], target=r["target"], source_path=r["source_path"],
+            store=r["store"], backup_id=r["backup_id"], schedule=r["schedule"],
+            retry=r["retry"], retry_interval_s=r["retry_interval_s"],
+            exclusions=json.loads(r["exclusions"]), chunker=r["chunker"],
+            pre_script=r["pre_script"], post_script=r["post_script"],
+            enabled=bool(r["enabled"]), last_run_at=r["last_run_at"],
+            last_status=r["last_status"], last_error=r["last_error"],
+            last_snapshot=r["last_snapshot"])
+
+    def get_backup_job(self, job_id: str) -> Optional[BackupJobRow]:
+        with self._lock:
+            r = self._conn.execute(
+                "SELECT * FROM backup_jobs WHERE id=?", (job_id,)).fetchone()
+        return self._row_to_job(r) if r else None
+
+    def list_backup_jobs(self, *, enabled_only: bool = False) -> list[BackupJobRow]:
+        q = "SELECT * FROM backup_jobs"
+        if enabled_only:
+            q += " WHERE enabled=1"
+        with self._lock:
+            return [self._row_to_job(r) for r in self._conn.execute(q)]
+
+    def delete_backup_job(self, job_id: str) -> None:
+        with self._lock, self._conn:
+            self._conn.execute("DELETE FROM backup_jobs WHERE id=?", (job_id,))
+
+    def record_backup_result(self, job_id: str, status: str,
+                             error: str = "", snapshot: str = "") -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                """UPDATE backup_jobs SET last_run_at=?, last_status=?,
+                   last_error=?, last_snapshot=COALESCE(NULLIF(?,''),
+                   last_snapshot) WHERE id=?""",
+                (time.time(), status, error, snapshot, job_id))
+
+    # -- targets -----------------------------------------------------------
+    def upsert_target(self, name: str, kind: str, hostname: str = "",
+                      root_path: str = "", config: dict | None = None) -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                """INSERT INTO targets (name,kind,hostname,root_path,config,
+                   created_at) VALUES (?,?,?,?,?,?)
+                   ON CONFLICT(name) DO UPDATE SET kind=excluded.kind,
+                     hostname=excluded.hostname, root_path=excluded.root_path,
+                     config=excluded.config""",
+                (name, kind, hostname, root_path,
+                 json.dumps(config or {}), time.time()))
+
+    def get_target(self, name: str) -> Optional[dict]:
+        with self._lock:
+            r = self._conn.execute(
+                "SELECT * FROM targets WHERE name=?", (name,)).fetchone()
+        if r is None:
+            return None
+        d = dict(r)
+        d["config"] = json.loads(d["config"])
+        return d
+
+    def list_targets(self) -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute("SELECT * FROM targets").fetchall()
+        out = []
+        for r in rows:
+            d = dict(r)
+            d["config"] = json.loads(d["config"])
+            out.append(d)
+        return out
+
+    def touch_target_online(self, name: str) -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                "UPDATE targets SET online_at=? WHERE name=?",
+                (time.time(), name))
+
+    # -- agent hosts (the aRPC expected list) --------------------------------
+    def upsert_agent_host(self, hostname: str, cert_pem: bytes,
+                          fingerprint: str, drives: list | None = None) -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                """INSERT INTO agent_hosts (hostname,cert_pem,
+                   cert_fingerprint,drives,bootstrapped_at)
+                   VALUES (?,?,?,?,?)
+                   ON CONFLICT(hostname) DO UPDATE SET
+                     cert_pem=excluded.cert_pem,
+                     cert_fingerprint=excluded.cert_fingerprint,
+                     drives=excluded.drives, renewed_at=excluded.bootstrapped_at""",
+                (hostname, cert_pem, fingerprint,
+                 json.dumps(drives or []), time.time()))
+
+    def get_agent_host(self, hostname: str) -> Optional[dict]:
+        with self._lock:
+            r = self._conn.execute(
+                "SELECT * FROM agent_hosts WHERE hostname=?",
+                (hostname,)).fetchone()
+        return dict(r) if r else None
+
+    def list_agent_hosts(self) -> list[dict]:
+        with self._lock:
+            return [dict(r) for r in
+                    self._conn.execute("SELECT * FROM agent_hosts")]
+
+    def delete_agent_host(self, hostname: str) -> None:
+        with self._lock, self._conn:
+            self._conn.execute("DELETE FROM agent_hosts WHERE hostname=?",
+                               (hostname,))
+
+    # -- tokens (sealed) -----------------------------------------------------
+    def put_token(self, token_id: str, secret: bytes, kind: str = "bootstrap",
+                  expires_at: float | None = None) -> None:
+        if self._seal_key is None:
+            raise RuntimeError("database has no seal key")
+        sealed = crypto.seal(self._seal_key, secret, aad=token_id.encode())
+        with self._lock, self._conn:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO tokens VALUES (?,?,?,?,?,0)",
+                (token_id, kind, sealed, time.time(), expires_at))
+
+    def check_token(self, token_id: str, secret: bytes) -> bool:
+        with self._lock:
+            r = self._conn.execute(
+                "SELECT * FROM tokens WHERE id=? AND revoked=0",
+                (token_id,)).fetchone()
+        if r is None or self._seal_key is None:
+            return False
+        if r["expires_at"] is not None and r["expires_at"] < time.time():
+            return False
+        try:
+            want = crypto.unseal(self._seal_key, r["sealed_secret"],
+                                 aad=token_id.encode())
+        except Exception:
+            return False
+        return crypto.constant_time_equal(want, secret)
+
+    def revoke_token(self, token_id: str) -> None:
+        with self._lock, self._conn:
+            self._conn.execute("UPDATE tokens SET revoked=1 WHERE id=?",
+                               (token_id,))
+
+    # -- restores ------------------------------------------------------------
+    def create_restore(self, rid: str, target: str, snapshot: str,
+                       destination: str, subpath: str = "") -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                """INSERT INTO restore_jobs (id,target,snapshot,destination,
+                   subpath,created_at) VALUES (?,?,?,?,?,?)""",
+                (rid, target, snapshot, destination, subpath, time.time()))
+
+    def update_restore(self, rid: str, status: str, error: str = "") -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                """UPDATE restore_jobs SET status=?, error=?,
+                   started_at=COALESCE(started_at, ?),
+                   finished_at=CASE WHEN ? IN ('success','error')
+                     THEN ? ELSE finished_at END
+                   WHERE id=?""",
+                (status, error, time.time(), status, time.time(), rid))
+
+    def get_restore(self, rid: str) -> Optional[dict]:
+        with self._lock:
+            r = self._conn.execute(
+                "SELECT * FROM restore_jobs WHERE id=?", (rid,)).fetchone()
+        return dict(r) if r else None
+
+    # -- verification --------------------------------------------------------
+    def upsert_verification_job(self, vid: str, store: str = "",
+                                schedule: str = "", sample_rate: float = 0.1,
+                                run_on_backup: bool = False) -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                """INSERT INTO verification_jobs (id,store,schedule,
+                   sample_rate,run_on_backup,created_at) VALUES (?,?,?,?,?,?)
+                   ON CONFLICT(id) DO UPDATE SET store=excluded.store,
+                     schedule=excluded.schedule,
+                     sample_rate=excluded.sample_rate,
+                     run_on_backup=excluded.run_on_backup""",
+                (vid, store, schedule, sample_rate, int(run_on_backup),
+                 time.time()))
+
+    def list_verification_jobs(self) -> list[dict]:
+        with self._lock:
+            return [dict(r) for r in
+                    self._conn.execute("SELECT * FROM verification_jobs")]
+
+    def record_verification_result(self, vid: str, status: str,
+                                   report: dict) -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                """UPDATE verification_jobs SET last_run_at=?, last_status=?,
+                   last_report=? WHERE id=?""",
+                (time.time(), status, json.dumps(report), vid))
+
+    # -- task log (PBS-visible tasks, §2.6) ----------------------------------
+    def create_task(self, upid: str, job_id: str, kind: str,
+                    detail: str = "") -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                """INSERT OR REPLACE INTO task_log (upid,job_id,kind,status,
+                   detail,started_at) VALUES (?,?,?,?,?,?)""",
+                (upid, job_id, kind, STATUS_RUNNING, detail, time.time()))
+
+    def append_task_log(self, upid: str, line: str) -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                "UPDATE task_log SET log = log || ? WHERE upid=?",
+                (line.rstrip("\n") + "\n", upid))
+
+    def finish_task(self, upid: str, status: str) -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                "UPDATE task_log SET status=?, finished_at=? WHERE upid=?",
+                (status, time.time(), upid))
+
+    def get_task(self, upid: str) -> Optional[dict]:
+        with self._lock:
+            r = self._conn.execute(
+                "SELECT * FROM task_log WHERE upid=?", (upid,)).fetchone()
+        return dict(r) if r else None
+
+    def list_tasks(self, *, job_id: str | None = None,
+                   limit: int = 100) -> list[dict]:
+        q = "SELECT * FROM task_log"
+        args: tuple = ()
+        if job_id:
+            q += " WHERE job_id=?"
+            args = (job_id,)
+        q += " ORDER BY started_at DESC LIMIT ?"
+        with self._lock:
+            return [dict(r) for r in self._conn.execute(q, args + (limit,))]
+
+    # -- exclusions ----------------------------------------------------------
+    def add_exclusion(self, pattern: str, job_id: str = "",
+                      comment: str = "") -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                "INSERT INTO exclusions (job_id,pattern,comment) VALUES (?,?,?)",
+                (job_id, pattern, comment))
+
+    def list_exclusions(self, job_id: str = "") -> list[str]:
+        """Global exclusions + per-job ones."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT pattern FROM exclusions WHERE job_id='' OR job_id=?",
+                (job_id,)).fetchall()
+        return [r["pattern"] for r in rows]

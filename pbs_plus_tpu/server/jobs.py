@@ -1,0 +1,120 @@
+"""Jobs manager: dedup by id, queue, concurrency gate, lifecycle hooks.
+
+Reference: internal/server/jobs/manager.go:12-203 — Job = {ID, PreExec,
+Execute, OnSuccess, OnError, Cleanup}; dedup by ID; dynamic-capacity queue
++ executionSem concurrency gate (RAM-derived, conf.max_concurrent_clients);
+PreExec runs BEFORE acquiring the execution slot (mount while queued);
+StartupMu serializes client startups.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional
+
+from ..utils import conf
+from ..utils.log import L
+
+AsyncFn = Callable[[], Awaitable[None]]
+
+
+@dataclass
+class Job:
+    id: str
+    kind: str = "backup"
+    pre_exec: Optional[AsyncFn] = None        # runs before the exec slot
+    execute: Optional[AsyncFn] = None
+    on_success: Optional[AsyncFn] = None
+    on_error: Optional[Callable[[BaseException], Awaitable[None]]] = None
+    cleanup: Optional[AsyncFn] = None
+
+
+class JobsManager:
+    def __init__(self, *, max_concurrent: int | None = None):
+        self.max_concurrent = max_concurrent or conf.max_concurrent_clients()
+        self._sem = asyncio.Semaphore(self.max_concurrent)
+        self._active: dict[str, asyncio.Task] = {}
+        self._startup_mu = asyncio.Lock()      # reference: StartupMu
+        self.stats = {"enqueued": 0, "completed": 0, "failed": 0,
+                      "deduped": 0}
+
+    def enqueue(self, job: Job) -> bool:
+        """Returns False if a job with the same id is already active
+        (reference dedup-by-ID, manager.go:61)."""
+        if job.id in self._active:
+            self.stats["deduped"] += 1
+            return False
+        task = asyncio.create_task(self._run(job), name=f"job:{job.id}")
+        self._active[job.id] = task
+        self.stats["enqueued"] += 1
+        return True
+
+    def is_active(self, job_id: str) -> bool:
+        return job_id in self._active
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    async def wait(self, job_id: str, timeout: float | None = None) -> None:
+        t = self._active.get(job_id)
+        if t is not None:
+            await asyncio.wait_for(asyncio.shield(t), timeout)
+
+    async def cancel(self, job_id: str) -> bool:
+        t = self._active.get(job_id)
+        if t is None:
+            return False
+        t.cancel()
+        try:
+            await t
+        except (asyncio.CancelledError, Exception):
+            pass
+        return True
+
+    async def _run(self, job: Job) -> None:
+        log = L.with_scope(job_id=job.id, kind=job.kind)
+        failed: BaseException | None = None
+        try:
+            if job.pre_exec is not None:
+                # before the execution slot: target mounts while queued
+                await job.pre_exec()
+            async with self._sem:
+                if job.execute is not None:
+                    await job.execute()
+        except asyncio.CancelledError as e:
+            failed = e
+            log.warning("job cancelled")
+        except BaseException as e:
+            failed = e
+            log.exception("job failed")
+        finally:
+            try:
+                if failed is None:
+                    self.stats["completed"] += 1
+                    if job.on_success is not None:
+                        await job.on_success()
+                else:
+                    self.stats["failed"] += 1
+                    if job.on_error is not None:
+                        await job.on_error(failed)
+            except Exception:
+                log.exception("job completion hook failed")
+            try:
+                if job.cleanup is not None:
+                    await job.cleanup()
+            except Exception:
+                log.exception("job cleanup failed")
+            self._active.pop(job.id, None)
+
+    @property
+    def startup_mu(self) -> asyncio.Lock:
+        """Serializes backup-session startups (reference: StartupMu)."""
+        return self._startup_mu
+
+    async def drain(self, timeout: float = 60.0) -> None:
+        tasks = list(self._active.values())
+        if tasks:
+            await asyncio.wait_for(
+                asyncio.gather(*tasks, return_exceptions=True), timeout)

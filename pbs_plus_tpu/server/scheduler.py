@@ -1,0 +1,138 @@
+"""Scheduler: calendar-expression ticks, retry policy, pending
+verifications.
+
+Reference: internal/server/scheduler/scheduler.go:20-377 — 30 s tick;
+ComputeNextEvent with lastEnqueued dedup; Retry/RetryInterval with typed
+JobStatus.ShouldRetry; verification scheduling incl. run-on-backup-complete
+pending mode + TriggerPendingVerifications.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime as dt
+import time
+from typing import Awaitable, Callable
+
+from ..utils import calendar
+from ..utils.log import L
+from . import database
+from .jobs import JobsManager
+
+TICK_S = 30.0
+
+EnqueueFn = Callable[[database.BackupJobRow], Awaitable[None]]
+VerifyFn = Callable[[dict], Awaitable[None]]
+
+
+class Scheduler:
+    def __init__(self, db: database.Database, jobs: JobsManager, *,
+                 enqueue_backup: EnqueueFn,
+                 enqueue_verification: VerifyFn | None = None,
+                 tick_s: float = TICK_S):
+        self.db = db
+        self.jobs = jobs
+        self.enqueue_backup = enqueue_backup
+        self.enqueue_verification = enqueue_verification
+        self.tick_s = tick_s
+        self._last_enqueued: dict[str, dt.datetime] = {}
+        self._retry_at: dict[str, float] = {}
+        self._pending_verifications: set[str] = set()
+        self._stop = asyncio.Event()
+
+    async def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                L.exception("scheduler tick crashed")   # panic containment
+            try:
+                await asyncio.wait_for(self._stop.wait(), self.tick_s)
+            except asyncio.TimeoutError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    async def tick(self, now: dt.datetime | None = None) -> None:
+        now = now or dt.datetime.now()
+        for row in self.db.list_backup_jobs(enabled_only=True):
+            if self.jobs.is_active(row.id):
+                continue
+            if await self._due_retry(row, now):
+                continue
+            if not row.schedule:
+                continue
+            try:
+                prev = self._reference_time(row, now)
+                nxt = calendar.compute_next_event(row.schedule, prev)
+            except calendar.CalendarError:
+                L.warning("job %s has invalid schedule %r", row.id, row.schedule)
+                continue
+            if nxt is not None and nxt <= now:
+                last = self._last_enqueued.get(row.id)
+                if last is not None and last >= nxt:
+                    continue                      # lastEnqueued dedup
+                self._last_enqueued[row.id] = now
+                await self.enqueue_backup(row)
+        await self._tick_verifications(now)
+
+    def _reference_time(self, row: database.BackupJobRow,
+                        now: dt.datetime) -> dt.datetime:
+        if row.last_run_at:
+            return dt.datetime.fromtimestamp(row.last_run_at)
+        last = self._last_enqueued.get(row.id)
+        if last is not None:
+            return last
+        return now - dt.timedelta(seconds=2 * self.tick_s)
+
+    async def _due_retry(self, row: database.BackupJobRow,
+                         now: dt.datetime) -> bool:
+        """Typed retry policy (reference: scheduler.go:159-180)."""
+        if not row.retry or row.last_status is None:
+            return False
+        if not database.should_retry(row.last_status):
+            self._retry_at.pop(row.id, None)
+            return False
+        key = row.id
+        at = self._retry_at.get(key)
+        if at is None:
+            base = row.last_run_at or time.time()
+            self._retry_at[key] = base + row.retry_interval_s
+            return False
+        if time.time() >= at:
+            self._retry_at[key] = time.time() + row.retry_interval_s
+            L.info("retrying failed job %s", row.id)
+            await self.enqueue_backup(row)
+            return True
+        return False
+
+    # -- verifications -----------------------------------------------------
+    def on_backup_complete(self, store: str) -> None:
+        """Mark run-on-backup verifications pending (reference:
+        OnBackupComplete → TriggerPendingVerifications)."""
+        for v in self.db.list_verification_jobs():
+            if v["run_on_backup"] and (not v["store"] or v["store"] == store):
+                self._pending_verifications.add(v["id"])
+
+    async def _tick_verifications(self, now: dt.datetime) -> None:
+        if self.enqueue_verification is None:
+            return
+        for v in self.db.list_verification_jobs():
+            due = False
+            if v["id"] in self._pending_verifications:
+                due = True
+            elif v["schedule"]:
+                try:
+                    ref = (dt.datetime.fromtimestamp(v["last_run_at"])
+                           if v["last_run_at"]
+                           else now - dt.timedelta(seconds=2 * self.tick_s))
+                    nxt = calendar.compute_next_event(v["schedule"], ref)
+                    due = nxt is not None and nxt <= now
+                except calendar.CalendarError:
+                    continue
+            if due:
+                self._pending_verifications.discard(v["id"])
+                await self.enqueue_verification(v)

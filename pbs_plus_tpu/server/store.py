@@ -1,0 +1,239 @@
+"""Server composition root + bootstrap wiring.
+
+Reference: internal/server/store/store.go:24-118 (the Store god-object:
+DB, app services, AgentsManager, jobs Manager, notification tracker,
+CertManager) and internal/server/bootstrap.go:29-196 (startup sequence:
+cleanup queued backups → secret key → CA validate → stale-mount cleanup →
+RPC servers in self-restarting loops → jobs manager → scheduler).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Optional
+
+from ..arpc import AgentsManager, Router, Session, TlsServerConfig, serve
+from ..chunker import ChunkerParams
+from ..pxar.backupproxy import LocalStore
+from ..utils import conf, crypto
+from ..utils.log import L
+from ..utils.mtls import CertManager
+from . import database
+from .backup_job import make_chunker_factory, run_backup_job
+from .jobs import Job, JobsManager
+from .scheduler import Scheduler
+
+
+def make_upid(kind: str, job_id: str) -> str:
+    """PBS-style unique process id for task logs (reference:
+    internal/proxmox/upid.go:23-141 — same capability, our own format)."""
+    return f"UPID:pbs-plus-tpu:{int(time.time()):08X}:{uuid.uuid4().hex[:8]}:{kind}:{job_id}"
+
+
+@dataclass
+class ServerConfig:
+    state_dir: str
+    cert_dir: str
+    datastore_dir: str
+    arpc_host: str = "127.0.0.1"
+    arpc_port: int = 0                      # 0 = ephemeral (tests)
+    chunk_avg: int = 4 << 20
+    chunker: str = "cpu"                    # default backend; per-job override
+    max_concurrent: int | None = None
+    hostname: str = "pbs-plus-tpu-server"
+
+
+class Server:
+    """Owns every server-side component; start()/stop() lifecycle."""
+
+    def __init__(self, config: ServerConfig):
+        self.config = config
+        os.makedirs(config.state_dir, exist_ok=True)
+        self.seal_key = crypto.load_or_create_key(
+            os.path.join(config.state_dir, "secret.key"))
+        self.db = database.Database(
+            os.path.join(config.state_dir, conf.DEFAULT_DB_NAME),
+            seal_key=self.seal_key)
+        self.certs = CertManager(config.cert_dir)
+        self.certs.load_or_create_ca()
+        self.certs.validate()
+        self.certs.ensure_server_identity(config.hostname)
+        self.agents = AgentsManager(is_expected=self._is_expected_host)
+        self.jobs = JobsManager(max_concurrent=config.max_concurrent)
+        params = ChunkerParams(avg_size=config.chunk_avg)
+        self.datastore = LocalStore(
+            config.datastore_dir, params,
+            chunker_factory=make_chunker_factory(config.chunker))
+        self.scheduler = Scheduler(
+            self.db, self.jobs,
+            enqueue_backup=self._enqueue_backup_row,
+            enqueue_verification=self._enqueue_verification)
+        self.router = Router()          # control-plane server handlers
+        self._register_handlers()
+        # routers pre-attached to expected job sessions (restore jobs serve
+        # the remote-archive protocol on their data session)
+        self._job_routers: dict[str, Router] = {}
+        self._arpc_server: Optional[asyncio.AbstractServer] = None
+        self._tasks: list[asyncio.Task] = []
+        self.log = L.with_scope(component="server")
+
+    # -- admission ---------------------------------------------------------
+    async def _is_expected_host(self, cn: str, cert_der: bytes) -> bool:
+        """Expected-list gate: cert must be in agent_hosts (reference:
+        SetExtraExpectFunc cert-in-DB check, web/server.go:193-227)."""
+        row = self.db.get_agent_host(cn)
+        if row is None:
+            return False
+        # pin: the presented cert must be byte-identical to the one issued
+        # at bootstrap/renewal (DER compare)
+        from cryptography import x509
+        from cryptography.hazmat.primitives.serialization import Encoding
+        try:
+            stored = x509.load_pem_x509_certificate(row["cert_pem"])
+        except Exception:
+            return False
+        return stored.public_bytes(Encoding.DER) == cert_der
+
+    def _register_handlers(self) -> None:
+        async def ping(req, ctx):
+            return {"pong": True}
+        self.router.handle("ping", ping)
+
+    # -- aRPC listener -----------------------------------------------------
+    async def start_arpc(self) -> int:
+        tls = TlsServerConfig(self.certs.server_cert_path,
+                              self.certs.server_key_path,
+                              self.certs.ca_cert_path)
+
+        async def on_connection(conn, peer, headers):
+            sess = await self.agents.register(peer, headers, conn)
+            try:
+                if sess.client_id == sess.cn:
+                    # primary control session: serve our handlers on it too
+                    await self.router.serve_connection(conn, context=sess)
+                else:
+                    # job data session: serve the job's pre-attached router
+                    # (restore: remote-archive handlers; backup: empty — the
+                    # server side acts as the client on that session)
+                    router = self._job_routers.pop(sess.client_id, None) \
+                        or Router()
+                    await router.serve_connection(conn, context=sess)
+            finally:
+                await self.agents.unregister(sess)
+
+        self._arpc_server = await serve(
+            self.config.arpc_host, self.config.arpc_port, tls,
+            on_connection=on_connection, admit=self.agents.admit)
+        port = self._arpc_server.sockets[0].getsockname()[1]
+        self.log.info("aRPC listening on %s:%d", self.config.arpc_host, port)
+        return port
+
+    async def start(self) -> None:
+        port = await self.start_arpc()
+        self.config.arpc_port = port
+        self._tasks.append(asyncio.create_task(self.scheduler.run()))
+
+    async def stop(self) -> None:
+        self.scheduler.stop()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        for sess in self.agents.sessions():
+            await sess.conn.close()
+        if self._arpc_server is not None:
+            self._arpc_server.close()
+            try:
+                await asyncio.wait_for(self._arpc_server.wait_closed(), 5)
+            except asyncio.TimeoutError:
+                pass
+        await self.jobs.drain(timeout=10)
+        self.db.close()
+
+    # -- bootstrap endpoint logic (used by the web API) --------------------
+    def bootstrap_agent(self, hostname: str, csr_pem: bytes,
+                        token_id: str, token_secret: bytes,
+                        drives: list | None = None) -> bytes:
+        """CSR signing flow (reference: AgentBootstrapHandler →
+        CertManager.SignCSR + host cert stored in DB as expected list)."""
+        if not self.db.check_token(token_id, token_secret):
+            raise PermissionError("invalid bootstrap token")
+        cert_pem = self.certs.sign_csr(csr_pem)
+        from ..utils.mtls import common_name
+        cn = common_name(cert_pem)
+        if cn != hostname:
+            raise PermissionError(f"CSR CN {cn!r} != hostname {hostname!r}")
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes
+        fp = x509.load_pem_x509_certificate(cert_pem).fingerprint(
+            hashes.SHA256()).hex()
+        self.db.upsert_agent_host(hostname, cert_pem, fp, drives)
+        self.db.upsert_target(hostname, "agent", hostname=hostname)
+        return cert_pem
+
+    def issue_bootstrap_token(self, *, ttl_s: float = 3600.0) -> tuple[str, bytes]:
+        token_id = uuid.uuid4().hex[:12]
+        secret = os.urandom(24)
+        self.db.put_token(token_id, secret, expires_at=time.time() + ttl_s)
+        return token_id, secret
+
+    # -- job enqueue -------------------------------------------------------
+    async def _enqueue_backup_row(self, row: database.BackupJobRow) -> None:
+        self.enqueue_backup(row.id)
+
+    def enqueue_backup(self, job_id: str) -> bool:
+        row = self.db.get_backup_job(job_id)
+        if row is None:
+            raise KeyError(f"unknown backup job {job_id!r}")
+        upid = make_upid("backup", row.id)
+        self.db.create_task(upid, row.id, "backup", detail=row.source_path)
+        result_box: dict = {}
+
+        store = self.datastore
+        if row.chunker and row.chunker != self.config.chunker:
+            store = LocalStore(
+                self.config.datastore_dir,
+                ChunkerParams(avg_size=self.config.chunk_avg),
+                chunker_factory=make_chunker_factory(row.chunker))
+
+        async def execute():
+            async with self.jobs.startup_mu:   # serialize session startups
+                pass
+            res = await run_backup_job(
+                row, db=self.db, agents=self.agents, store=store)
+            result_box["res"] = res
+            self.db.append_task_log(
+                upid, f"backup complete: {res.entries} entries, "
+                      f"{res.bytes_total} bytes -> {res.snapshot}")
+            for err in res.errors[:50]:
+                self.db.append_task_log(upid, f"warning: {err}")
+
+        async def on_success():
+            res = result_box.get("res")
+            status = (database.STATUS_WARNING
+                      if res and res.errors else database.STATUS_SUCCESS)
+            self.db.finish_task(upid, status)
+            self.db.record_backup_result(
+                row.id, status, snapshot=res.snapshot if res else "")
+            self.scheduler.on_backup_complete(row.store)
+
+        async def on_error(exc: BaseException):
+            self.db.append_task_log(upid, f"error: {exc}")
+            self.db.finish_task(upid, database.STATUS_ERROR)
+            self.db.record_backup_result(row.id, database.STATUS_ERROR,
+                                         error=str(exc))
+
+        return self.jobs.enqueue(Job(
+            id=f"backup:{row.id}", kind="backup",
+            execute=execute, on_success=on_success, on_error=on_error))
+
+    async def _enqueue_verification(self, v: dict) -> None:
+        from .verification_job import enqueue_verification
+        enqueue_verification(self, v)

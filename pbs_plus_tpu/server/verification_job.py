@@ -1,0 +1,86 @@
+"""Verification job: spot-check stored snapshots by re-hashing content.
+
+Reference: internal/server/verification/job.go:41-130,765-1273 — weighted-
+random backup selection by staleness, systematic file sampling, server-side
+sha256 vs stored digests.  Here the re-hash is the batched VerifyPipeline
+(one device dispatch instead of a worker pool).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import numpy as np
+
+from ..models.verify import VerifyPipeline
+from ..pxar.transfer import SplitReader
+from ..utils.log import L
+from . import database
+
+
+def pick_snapshots(server, *, store_filter: str = "",
+                   max_count: int = 3) -> list:
+    """Weighted-random selection by staleness: older unverified snapshots
+    first (reference: weighted-random by staleness)."""
+    ds = server.datastore.datastore
+    snaps = ds.list_snapshots()
+    if not snaps:
+        return []
+    weights = []
+    now = time.time()
+    for ref in snaps:
+        try:
+            man = ds.load_manifest(ref)
+        except Exception:
+            continue
+        verified_at = man.get("verified_at", 0)
+        age = max(1.0, now - max(verified_at, man.get("created_unix", 0)))
+        weights.append((ref, age))
+    weights.sort(key=lambda x: -x[1])
+    return [ref for ref, _ in weights[:max_count]]
+
+
+async def run_verification(server, v: dict) -> dict:
+    vp = VerifyPipeline()
+    rng = np.random.default_rng()
+    report = {"checked": 0, "corrupt": [], "snapshots": []}
+    for ref in pick_snapshots(server, store_filter=v.get("store", "")):
+        reader = SplitReader.open_snapshot(server.datastore.datastore, ref)
+        res = await asyncio.get_running_loop().run_in_executor(
+            None, lambda r=reader: vp.verify_snapshot(
+                r, sample_rate=float(v.get("sample_rate", 0.1)), rng=rng))
+        report["checked"] += res.checked
+        report["snapshots"].append(str(ref))
+        if not res.ok:
+            report["corrupt"].append(
+                {"snapshot": str(ref), "files": res.corrupt})
+    return report
+
+
+def enqueue_verification(server, v: dict) -> bool:
+    from .jobs import Job
+    from .store import make_upid
+    vid = v["id"]
+    upid = make_upid("verify", vid)
+    server.db.create_task(upid, vid, "verify")
+
+    async def execute():
+        report = await run_verification(server, v)
+        status = (database.STATUS_SUCCESS if not report["corrupt"]
+                  else database.STATUS_ERROR)
+        server.db.record_verification_result(vid, status, report)
+        server.db.append_task_log(
+            upid, f"verified {report['checked']} files across "
+                  f"{len(report['snapshots'])} snapshots; "
+                  f"{len(report['corrupt'])} corruption reports")
+        server.db.finish_task(upid, status)
+        if report["corrupt"]:
+            L.error("verification found corruption: %s", report["corrupt"])
+
+    async def on_error(exc):
+        server.db.finish_task(upid, database.STATUS_ERROR)
+
+    return server.jobs.enqueue(
+        Job(id=f"verify:{vid}", kind="verify", execute=execute,
+            on_error=on_error))

@@ -18,7 +18,7 @@ from pbs_plus_tpu.chunker.spec import buzhash_table
 
 P = ChunkerParams(avg_size=4 << 10)  # test scale: 4 KiB avg, 1 KiB min, 16 KiB max
 
-_TABLE_GOLDEN = {0: 2600206059, 1: 927838666, 128: 1044634582, 255: 2351172489}
+_TABLE_GOLDEN = {0: 300073802, 1: 1793749598, 128: 3807579735, 255: 3407920848}
 
 
 def _data(n: int, seed: int = 7) -> bytes:
@@ -26,12 +26,17 @@ def _data(n: int, seed: int = 7) -> bytes:
 
 
 def test_table_deterministic():
+    from pbs_plus_tpu.chunker.spec import buzhash_subtables
     t1 = buzhash_table()
     t2 = buzhash_table()
     assert t1.dtype == np.uint32
     assert np.array_equal(t1, t2)
     assert len(np.unique(t1)) > 250
     assert not t1.flags.writeable  # shared table must be immutable
+    # nibble decomposition invariant (the TPU lookup relies on it)
+    a, b = buzhash_subtables()
+    x = np.arange(256)
+    assert np.array_equal(t1, a[x >> 4] ^ b[x & 0xF])
     # golden spot values: the table is part of the on-disk dedup format —
     # any change here orphans every stored chunk
     golden = {0: int(t1[0]), 1: int(t1[1]), 128: int(t1[128]), 255: int(t1[255])}
@@ -76,6 +81,25 @@ def test_shift_invariance_of_cuts():
     ha = {hashlib.sha256(body[s:e]).hexdigest() for s, e in a}
     hb = {hashlib.sha256((prefix + body)[s:e]).hexdigest() for s, e in b}
     assert len(ha & hb) >= len(ha) - 3
+
+
+def test_cut_density():
+    """The structured table must keep candidate density ~ 1/avg on random
+    data (empirical guard for the nibble-decomposed table's hash quality)."""
+    data = _data(2_000_000, seed=99)
+    ends = candidates(data, P)
+    density = len(ends) / len(data)
+    expect = 1 / P.avg_size
+    assert 0.6 * expect < density < 1.6 * expect
+    # and on low-entropy ASCII-ish data
+    text = (b"the quick brown fox jumps over the lazy dog 0123456789\n" * 40000)
+    rng = np.random.default_rng(5)
+    arr = np.frombuffer(text, np.uint8).copy()
+    idx = rng.integers(0, len(arr), len(arr) // 20)
+    arr[idx] = rng.integers(32, 127, len(idx), dtype=np.uint8)
+    ends2 = candidates(arr.tobytes(), P)
+    density2 = len(ends2) / len(arr)
+    assert 0.3 * expect < density2 < 3 * expect
 
 
 def test_forced_cut_on_incompressible_run():

@@ -8,7 +8,8 @@ import numpy as np
 import pytest
 
 from pbs_plus_tpu.chunker import ChunkerParams, candidates, chunk_bounds
-from pbs_plus_tpu.chunker.spec import buzhash_table, select_cuts
+from pbs_plus_tpu.chunker.spec import select_cuts
+from pbs_plus_tpu.ops.rolling_hash import device_tables
 from pbs_plus_tpu.ops import (
     CuckooIndex, candidate_ends_host, candidate_mask, minhash_signature,
     pairwise_hamming, sha256_chunks, sha256_stream_chunks, simhash_sketch,
@@ -37,7 +38,7 @@ def test_candidate_mask_matches_cpu():
 def test_candidate_mask_with_history():
     """Batched/segmented evaluation with 63-byte halo == whole-stream."""
     data = np.frombuffer(_data(131_072, seed=2), dtype=np.uint8)
-    table = jnp.asarray(buzhash_table(P.seed))
+    table = device_tables(P)
     whole = np.asarray(candidate_mask(jnp.asarray(data), table, P.mask, P.magic))
     # split into 2 segments, pass history halo to the second
     half = len(data) // 2
