@@ -193,7 +193,8 @@ def _digests_to_bytes(d: np.ndarray) -> list[bytes]:
 
 
 def sha256_stream_chunks(stream, bounds: list[tuple[int, int]], *,
-                         max_batch: int = 4096) -> list[bytes]:
+                         max_batch: int = 4096,
+                         unroll: int | None = None) -> list[bytes]:
     """SHA-256 of ``stream[s:e]`` for each (s, e) in bounds, bucketed by
     block count.  ``stream`` may be bytes / numpy uint8 / jax uint8 (kept
     on device if already there).  Returns 32-byte digests in input order.
@@ -230,7 +231,7 @@ def sha256_stream_chunks(stream, bounds: list[tuple[int, int]], *,
             bl[:n] = lens[part]
             dig = np.asarray(_sha256_scan(dstream, jnp.asarray(bs),
                                           jnp.asarray(bl), t_max,
-                                          assume_padded=True))
+                                          unroll=unroll, assume_padded=True))
             for k, i in enumerate(part):
                 out[i] = dig[k].astype(">u4").tobytes()
     return out  # type: ignore[return-value]

@@ -49,10 +49,18 @@ _SENTINEL = object()
 
 
 def make_chunker_factory(kind: str):
-    """The one-line config change (BASELINE.json): chunker = cpu | tpu."""
+    """The one-line config change (BASELINE.json):
+    chunker = cpu | tpu | sidecar:<host:port>."""
     if kind == "tpu":
         from ..models.dedup import TpuChunker
         return lambda p: TpuChunker(p)
+    if kind.startswith("sidecar:"):
+        from ..sidecar.client import SidecarChunker, SidecarClient
+        client = SidecarClient(kind.split(":", 1)[1])
+        return lambda p: SidecarChunker(p, client)
+    if kind not in ("", "cpu"):
+        raise ValueError(f"unknown chunker backend {kind!r} "
+                         "(want cpu | tpu | sidecar:<host:port>)")
     return lambda p: CpuChunker(p)
 
 

@@ -340,12 +340,17 @@ class Database:
                 "INSERT OR REPLACE INTO tokens VALUES (?,?,?,?,?,0)",
                 (token_id, kind, sealed, time.time(), expires_at))
 
-    def check_token(self, token_id: str, secret: bytes) -> bool:
+    def check_token(self, token_id: str, secret: bytes,
+                    kind: str | None = None) -> bool:
+        """``kind`` restricts which token class is acceptable — bootstrap
+        tokens must never authorize API calls and vice versa."""
         with self._lock:
             r = self._conn.execute(
                 "SELECT * FROM tokens WHERE id=? AND revoked=0",
                 (token_id,)).fetchone()
         if r is None or self._seal_key is None:
+            return False
+        if kind is not None and r["kind"] != kind:
             return False
         if r["expires_at"] is not None and r["expires_at"] < time.time():
             return False

@@ -1,0 +1,78 @@
+"""Prometheus metrics (reference: internal/server/web/api/metrics.go:21-344
+— ~45 gauges: per-backup last-run success/timestamps/duration, live
+bytes/files speeds, snapshot sizes, totals).
+
+Text exposition format rendered directly (no client library needed).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from .store import Server
+
+
+def _esc(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class MetricsRegistry:
+    def __init__(self, server: "Server"):
+        self.server = server
+
+    def render(self) -> str:
+        s = self.server
+        lines: list[str] = []
+
+        def gauge(name: str, help_: str, samples: list[tuple[dict, float]]):
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} gauge")
+            for labels, value in samples:
+                lbl = ",".join(f'{k}="{_esc(str(v))}"'
+                               for k, v in sorted(labels.items()))
+                lines.append(f"{name}{{{lbl}}} {value}"
+                             if lbl else f"{name} {value}")
+
+        jobs = s.db.list_backup_jobs()
+        gauge("pbs_plus_backup_last_run_timestamp",
+              "Unix time of the last run",
+              [({"job": j.id}, j.last_run_at or 0) for j in jobs])
+        gauge("pbs_plus_backup_last_run_success",
+              "1 if the last run succeeded",
+              [({"job": j.id},
+                1.0 if j.last_status in ("success", "warnings") else 0.0)
+               for j in jobs])
+        gauge("pbs_plus_backup_running",
+              "1 while the job is running",
+              [({"job": j.id},
+                1.0 if s.jobs.is_active(f"backup:{j.id}") else 0.0)
+               for j in jobs])
+        gauge("pbs_plus_jobs_active", "Active jobs",
+              [({}, float(s.jobs.active_count))])
+        gauge("pbs_plus_jobs_total", "Job counters",
+              [({"result": k}, float(v)) for k, v in s.jobs.stats.items()])
+        gauge("pbs_plus_agents_connected", "Connected agent sessions",
+              [({}, float(len(s.agents.sessions())))])
+
+        snaps = s.datastore.datastore.list_snapshots()
+        gauge("pbs_plus_snapshots_total", "Snapshots in the datastore",
+              [({}, float(len(snaps)))])
+        per_group: dict[str, int] = {}
+        size_per_group: dict[str, int] = {}
+        for ref in snaps:
+            key = f"{ref.backup_type}/{ref.backup_id}"
+            per_group[key] = per_group.get(key, 0) + 1
+            try:
+                man = s.datastore.datastore.load_manifest(ref)
+                size_per_group[key] = size_per_group.get(key, 0) + \
+                    man.get("payload_size", 0)
+            except OSError:
+                pass
+        gauge("pbs_plus_snapshots_per_group", "Snapshots per backup group",
+              [({"group": g}, float(n)) for g, n in per_group.items()])
+        gauge("pbs_plus_snapshot_bytes", "Logical bytes per backup group",
+              [({"group": g}, float(n)) for g, n in size_per_group.items()])
+        gauge("pbs_plus_scrape_timestamp", "Scrape time", [({}, time.time())])
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,144 @@
+"""Notifications: per-job results, batch aggregation, alert scanner.
+
+Reference: internal/server/notification — proxmox-notify spool/sendmail
+delivery, batch tracker aggregating multi-job runs with timeout flush,
+hourly alert scanner (stale backups, unconfigured/offline targets) with
+cooldowns (notification.go:73-247, batch.go:25-356, scanner.go:17-206).
+
+Delivery here is pluggable sinks (callable / file spool); sendmail exec is
+gated on availability.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import shutil
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..utils.log import L
+
+Sink = Callable[[str, str, dict], None]     # (severity, title, body)
+
+
+def file_spool_sink(spool_dir: str) -> Sink:
+    os.makedirs(spool_dir, exist_ok=True)
+    counter = iter(range(1 << 62))
+
+    def sink(severity: str, title: str, body: dict) -> None:
+        name = f"{int(time.time()*1000)}-{next(counter):06d}-{severity}.json"
+        with open(os.path.join(spool_dir, name), "w") as f:
+            json.dump({"severity": severity, "title": title,
+                       "body": body, "time": time.time()}, f)
+    return sink
+
+
+def sendmail_sink(recipient: str) -> Sink | None:
+    if shutil.which("sendmail") is None:
+        return None
+
+    def sink(severity: str, title: str, body: dict) -> None:
+        msg = (f"To: {recipient}\nSubject: [pbs-plus-tpu/{severity}] {title}\n\n"
+               + json.dumps(body, indent=1))
+        try:
+            subprocess.run(["sendmail", "-t"], input=msg.encode(),
+                           timeout=30, check=False)
+        except Exception:
+            L.exception("sendmail delivery failed")
+    return sink
+
+
+@dataclass
+class BatchTracker:
+    """Aggregates job results of one scheduling wave into a single
+    notification, flushed after ``window_s`` of quiet."""
+
+    sink: Sink
+    window_s: float = 60.0
+    _results: list[dict] = field(default_factory=list)
+    _flush_task: asyncio.Task | None = None
+
+    def record(self, job_id: str, status: str, detail: str = "") -> None:
+        self._results.append({"job": job_id, "status": status,
+                              "detail": detail, "time": time.time()})
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+        self._flush_task = asyncio.create_task(self._flush_later())
+
+    async def _flush_later(self) -> None:
+        try:
+            await asyncio.sleep(self.window_s)
+        except asyncio.CancelledError:
+            return
+        self.flush()
+
+    def flush(self) -> None:
+        if not self._results:
+            return
+        results, self._results = self._results, []
+        bad = [r for r in results if r["status"] not in ("success",)]
+        severity = "error" if any(r["status"] == "error" for r in results) \
+            else ("warning" if bad else "info")
+        self.sink(severity,
+                  f"{len(results)} job(s): "
+                  f"{len(results) - len(bad)} ok, {len(bad)} not ok",
+                  {"results": results})
+
+
+class AlertScanner:
+    """Periodic health alerts with cooldown (reference: hourly scanner)."""
+
+    def __init__(self, server, sink: Sink, *, interval_s: float = 3600.0,
+                 stale_after_s: float = 2 * 86400.0,
+                 cooldown_s: float = 6 * 3600.0):
+        self.server = server
+        self.sink = sink
+        self.interval_s = interval_s
+        self.stale_after_s = stale_after_s
+        self.cooldown_s = cooldown_s
+        self._last_alert: dict[str, float] = {}
+        self._stop = asyncio.Event()
+
+    def scan(self) -> list[tuple[str, str, dict]]:
+        alerts = []
+        now = time.time()
+        for j in self.server.db.list_backup_jobs(enabled_only=True):
+            if j.schedule and (j.last_run_at or 0) < now - self.stale_after_s:
+                alerts.append(("warning", f"backup {j.id} is stale",
+                               {"job": j.id, "last_run_at": j.last_run_at}))
+            if j.last_status == "error":
+                alerts.append(("error", f"backup {j.id} failing",
+                               {"job": j.id, "error": j.last_error}))
+        connected = {s.cn for s in self.server.agents.sessions()}
+        for t in self.server.db.list_targets():
+            if t["kind"] == "agent" and t["hostname"] not in connected:
+                alerts.append(("warning",
+                               f"target {t['name']} offline",
+                               {"target": t["name"]}))
+        return alerts
+
+    def _emit(self, alerts) -> None:
+        now = time.time()
+        for severity, title, body in alerts:
+            if now - self._last_alert.get(title, 0) < self.cooldown_s:
+                continue
+            self._last_alert[title] = now
+            self.sink(severity, title, body)
+
+    async def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._emit(self.scan())
+            except Exception:
+                L.exception("alert scan failed")
+            try:
+                await asyncio.wait_for(self._stop.wait(), self.interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -78,6 +78,9 @@ class Server:
         # the remote-archive protocol on their data session)
         self._job_routers: dict[str, Router] = {}
         self._arpc_server: Optional[asyncio.AbstractServer] = None
+        # notification batch tracker (reference: BatchTracker.RecordJobResult
+        # in the backup OnSuccess path) — a sink is attached by the caller
+        self.notifications = None
         self._tasks: list[asyncio.Task] = []
         self.log = L.with_scope(component="server")
 
@@ -163,7 +166,7 @@ class Server:
                         drives: list | None = None) -> bytes:
         """CSR signing flow (reference: AgentBootstrapHandler →
         CertManager.SignCSR + host cert stored in DB as expected list)."""
-        if not self.db.check_token(token_id, token_secret):
+        if not self.db.check_token(token_id, token_secret, kind="bootstrap"):
             raise PermissionError("invalid bootstrap token")
         cert_pem = self.certs.sign_csr(csr_pem)
         from ..utils.mtls import common_name
@@ -223,12 +226,17 @@ class Server:
             self.db.record_backup_result(
                 row.id, status, snapshot=res.snapshot if res else "")
             self.scheduler.on_backup_complete(row.store)
+            if self.notifications is not None:
+                self.notifications.record(row.id, status)
 
         async def on_error(exc: BaseException):
             self.db.append_task_log(upid, f"error: {exc}")
             self.db.finish_task(upid, database.STATUS_ERROR)
             self.db.record_backup_result(row.id, database.STATUS_ERROR,
                                          error=str(exc))
+            if self.notifications is not None:
+                self.notifications.record(row.id, database.STATUS_ERROR,
+                                          detail=str(exc))
 
         return self.jobs.enqueue(Job(
             id=f"backup:{row.id}", kind="backup",

@@ -1,0 +1,406 @@
+"""HTTP API (reference: internal/server/web — ~60 HTTPS routes on
+:8017/:8018 with middleware chain SecurityHeaders→RateLimit→Recovery→
+RequestLogger→RequestID, PBS-ticket auth for UI routes, bearer/bootstrap
+auth for agent routes, Prometheus /plus/metrics, healthz/readyz).
+
+aiohttp application; route groups:
+
+  agent side (reference :8018):
+    POST /plus/agent/bootstrap        CSR + bootstrap token → signed cert
+    POST /plus/agent/renew            mTLS-bootstrapped host renews its cert
+  api side (reference :8017):
+    GET  /plus/healthz | /plus/readyz
+    GET  /plus/metrics                     Prometheus text
+    GET/POST/DELETE /api2/json/d2d/backup        job CRUD
+    POST /api2/json/d2d/backup/{id}/run          trigger now
+    GET/POST /api2/json/d2d/target               targets
+    POST /api2/json/d2d/restore                  start restore
+    GET  /api2/json/d2d/snapshots                datastore listing
+    GET  /api2/json/d2d/tasks[/{upid}]           task logs
+    GET  /api2/json/d2d/exclusion (+POST)        exclusions
+    POST /api2/json/d2d/token                    issue bootstrap token
+    GET  /api2/json/d2d/filetree?target=&path=   live agent browse
+    GET/POST /api2/json/d2d/verification         verification jobs
+
+Auth: API routes use bearer tokens minted by ``api_token`` (sealed in DB);
+the reference proxies PBS ticket auth — a PBS host integration can swap
+the authenticator (web/auth.go analog) without touching handlers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import secrets
+import time
+import uuid
+from typing import TYPE_CHECKING
+
+from aiohttp import web
+
+from ..utils.log import L
+from . import database
+from .metrics import MetricsRegistry
+
+if TYPE_CHECKING:
+    from .store import Server
+
+
+@web.middleware
+async def security_headers(request: web.Request, handler):
+    resp = await handler(request)
+    resp.headers.setdefault("X-Content-Type-Options", "nosniff")
+    resp.headers.setdefault("X-Frame-Options", "DENY")
+    resp.headers.setdefault("Referrer-Policy", "no-referrer")
+    return resp
+
+
+@web.middleware
+async def recovery(request: web.Request, handler):
+    try:
+        return await handler(request)
+    except web.HTTPException:
+        raise
+    except Exception as e:
+        L.exception("http handler crashed: %s %s", request.method,
+                    request.path)
+        return web.json_response({"error": f"{type(e).__name__}: {e}"},
+                                 status=500)
+
+
+@web.middleware
+async def request_id(request: web.Request, handler):
+    rid = uuid.uuid4().hex[:12]
+    request["request_id"] = rid
+    resp = await handler(request)
+    resp.headers["X-Request-ID"] = rid
+    return resp
+
+
+class RateLimiter:
+    def __init__(self, rate: float = 50.0, burst: int = 100):
+        self.rate, self.burst = rate, burst
+        self._buckets: dict[str, tuple[float, float]] = {}
+
+    def allow(self, key: str) -> bool:
+        now = time.monotonic()
+        if len(self._buckets) > 4096:
+            # evict buckets idle long enough to have fully refilled
+            idle = self.burst / self.rate
+            self._buckets = {k: v for k, v in self._buckets.items()
+                             if now - v[1] < idle}
+        tokens, last = self._buckets.get(key, (float(self.burst), now))
+        tokens = min(self.burst, tokens + (now - last) * self.rate)
+        if tokens < 1.0:
+            self._buckets[key] = (tokens, now)
+            return False
+        self._buckets[key] = (tokens - 1.0, now)
+        return True
+
+
+def build_app(server: "Server", *, require_auth: bool = True) -> web.Application:
+    metrics = MetricsRegistry(server)
+    limiter = RateLimiter()
+
+    @web.middleware
+    async def rate_limit(request: web.Request, handler):
+        peer = request.remote or "?"
+        if not limiter.allow(peer):
+            return web.json_response({"error": "rate limited"}, status=429)
+        return await handler(request)
+
+    @web.middleware
+    async def auth(request: web.Request, handler):
+        open_paths = ("/plus/healthz", "/plus/readyz", "/plus/metrics",
+                      "/plus/agent/bootstrap", "/plus/agent/renew")
+        if not require_auth or request.path in open_paths:
+            return await handler(request)
+        hdr = request.headers.get("Authorization", "")
+        authorized = False
+        if hdr.startswith("Bearer "):
+            tok = hdr[7:]
+            if ":" in tok:
+                tid, sec = tok.split(":", 1)
+                try:
+                    authorized = server.db.check_token(tid, sec.encode(),
+                                                       kind="api")
+                except Exception:
+                    authorized = False
+        if not authorized:
+            return web.json_response({"error": "unauthorized"}, status=401)
+        return await handler(request)
+
+    app = web.Application(middlewares=[
+        security_headers, rate_limit, recovery, request_id, auth,
+    ], client_max_size=16 << 20)
+
+    # -- health / metrics --------------------------------------------------
+    async def healthz(request):
+        return web.json_response({"ok": True})
+
+    async def readyz(request):
+        try:
+            server.db.list_targets()
+            return web.json_response({"ok": True})
+        except Exception as e:
+            return web.json_response({"ok": False, "error": str(e)},
+                                     status=503)
+
+    async def metrics_handler(request):
+        return web.Response(text=metrics.render(),
+                            content_type="text/plain")
+
+    # -- agent bootstrap / renew ------------------------------------------
+    async def agent_bootstrap(request):
+        body = await request.json()
+        raw = body.get("token_secret", "")
+        try:
+            secret = bytes.fromhex(raw)      # tokens travel hex-encoded
+        except ValueError:
+            secret = raw.encode()
+        try:
+            cert = server.bootstrap_agent(
+                body["hostname"], body["csr"].encode(),
+                body["token_id"], secret,
+                drives=body.get("drives"))
+        except PermissionError as e:
+            return web.json_response({"error": str(e)}, status=403)
+        return web.json_response({
+            "cert": cert.decode(),
+            "ca": open(server.certs.ca_cert_path).read(),
+        })
+
+    async def agent_renew(request):
+        body = await request.json()
+        hostname = body["hostname"]
+        row = server.db.get_agent_host(hostname)
+        if row is None:
+            return web.json_response({"error": "unknown host"}, status=403)
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding, PublicFormat)
+        try:
+            csr = x509.load_pem_x509_csr(body["csr"].encode())
+        except Exception:
+            return web.json_response({"error": "bad CSR"}, status=400)
+        # renewal proof: the CSR must be self-signed by the SAME keypair as
+        # the stored cert (possession of the private key), and its CN must
+        # match the hostname — fingerprint knowledge alone is public info
+        stored = x509.load_pem_x509_certificate(row["cert_pem"])
+        same_key = csr.public_key().public_bytes(
+            Encoding.DER, PublicFormat.SubjectPublicKeyInfo) == \
+            stored.public_key().public_bytes(
+                Encoding.DER, PublicFormat.SubjectPublicKeyInfo)
+        cn_attrs = csr.subject.get_attributes_for_oid(
+            x509.oid.NameOID.COMMON_NAME)
+        cn_ok = bool(cn_attrs) and str(cn_attrs[0].value) == hostname
+        if not (csr.is_signature_valid and same_key and cn_ok):
+            return web.json_response({"error": "renewal proof failed"},
+                                     status=403)
+        cert = server.certs.sign_csr(body["csr"].encode())
+        fp = x509.load_pem_x509_certificate(cert).fingerprint(
+            hashes.SHA256()).hex()
+        server.db.upsert_agent_host(hostname, cert, fp)
+        return web.json_response({"cert": cert.decode()})
+
+    # -- backup job CRUD ---------------------------------------------------
+    def _job_dict(j: database.BackupJobRow) -> dict:
+        return {
+            "id": j.id, "target": j.target, "source_path": j.source_path,
+            "backup_id": j.backup_id, "schedule": j.schedule,
+            "retry": j.retry, "retry_interval_s": j.retry_interval_s,
+            "exclusions": j.exclusions, "chunker": j.chunker,
+            "enabled": j.enabled, "last_run_at": j.last_run_at,
+            "last_status": j.last_status, "last_error": j.last_error,
+            "last_snapshot": j.last_snapshot,
+            "running": server.jobs.is_active(f"backup:{j.id}"),
+        }
+
+    async def backup_list(request):
+        return web.json_response(
+            {"data": [_job_dict(j) for j in server.db.list_backup_jobs()]})
+
+    async def backup_upsert(request):
+        b = await request.json()
+        from ..utils import validate
+        from .backup_job import make_chunker_factory
+        chunker = b.get("chunker", server.config.chunker)
+        make_chunker_factory(chunker)   # reject unknown backends up front
+        row = database.BackupJobRow(
+            id=validate.job_id(b["id"]), target=b["target"],
+            source_path=b["source_path"],
+            backup_id=validate.job_id(b["backup_id"])
+            if b.get("backup_id") else "",
+            schedule=b.get("schedule", ""), retry=int(b.get("retry", 0)),
+            retry_interval_s=int(b.get("retry_interval_s", 60)),
+            exclusions=list(b.get("exclusions", [])),
+            chunker=chunker,
+            enabled=bool(b.get("enabled", True)))
+        server.db.upsert_backup_job(row)
+        return web.json_response({"data": _job_dict(row)})
+
+    async def backup_delete(request):
+        server.db.delete_backup_job(request.match_info["id"])
+        return web.json_response({"ok": True})
+
+    async def backup_run(request):
+        job_id = request.match_info["id"]
+        try:
+            started = server.enqueue_backup(job_id)
+        except KeyError:
+            return web.json_response({"error": "unknown job"}, status=404)
+        return web.json_response({"started": started})
+
+    # -- targets -----------------------------------------------------------
+    async def target_list(request):
+        connected = {s.cn for s in server.agents.sessions()}
+        out = []
+        for t in server.db.list_targets():
+            t["connected"] = t["hostname"] in connected
+            out.append(t)
+        return web.json_response({"data": out})
+
+    async def target_upsert(request):
+        b = await request.json()
+        server.db.upsert_target(b["name"], b.get("kind", "agent"),
+                                hostname=b.get("hostname", b["name"]),
+                                root_path=b.get("root_path", ""),
+                                config=b.get("config"))
+        return web.json_response({"ok": True})
+
+    # -- restore -----------------------------------------------------------
+    async def restore_start(request):
+        b = await request.json()
+        from .restore_job import enqueue_restore
+        rid = enqueue_restore(server, target=b["target"],
+                              snapshot=b["snapshot"],
+                              destination=b["destination"],
+                              subpath=b.get("subpath", ""))
+        return web.json_response({"restore_id": rid})
+
+    async def restore_status(request):
+        r = server.db.get_restore(request.match_info["rid"])
+        if r is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({"data": r})
+
+    # -- snapshots ---------------------------------------------------------
+    async def snapshots(request):
+        ds = server.datastore.datastore
+        out = []
+        for ref in ds.list_snapshots():
+            item = {"snapshot": str(ref), "type": ref.backup_type,
+                    "id": ref.backup_id, "time": ref.backup_time}
+            try:
+                man = ds.load_manifest(ref)
+                item.update(entries=man.get("entries"),
+                            payload_size=man.get("payload_size"),
+                            previous=man.get("previous"))
+            except OSError:
+                pass
+            out.append(item)
+        return web.json_response({"data": out})
+
+    # -- tasks -------------------------------------------------------------
+    async def tasks(request):
+        job = request.query.get("job")
+        return web.json_response(
+            {"data": server.db.list_tasks(job_id=job or None)})
+
+    async def task_get(request):
+        t = server.db.get_task(request.match_info["upid"])
+        if t is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({"data": t})
+
+    # -- exclusions --------------------------------------------------------
+    async def exclusion_list(request):
+        return web.json_response(
+            {"data": server.db.list_exclusions(request.query.get("job", ""))})
+
+    async def exclusion_add(request):
+        b = await request.json()
+        server.db.add_exclusion(b["pattern"], b.get("job", ""),
+                                b.get("comment", ""))
+        return web.json_response({"ok": True})
+
+    # -- tokens ------------------------------------------------------------
+    async def token_create(request):
+        b = await request.json() if request.can_read_body else {}
+        ttl = float(b.get("ttl_s", 3600))
+        tid, secret = server.issue_bootstrap_token(ttl_s=ttl)
+        return web.json_response({"token_id": tid,
+                                  "token_secret": secret.hex()})
+
+    # -- filetree (live agent browse) --------------------------------------
+    async def filetree(request):
+        target = request.query.get("target", "")
+        path = request.query.get("path", "/")
+        sess = server.agents.get(target)
+        if sess is None:
+            return web.json_response({"error": "agent offline"}, status=503)
+        from ..arpc import Session
+        resp = await Session(sess.conn).call("filetree", {"path": path})
+        return web.json_response({"data": resp.data["entries"]})
+
+    # -- verification ------------------------------------------------------
+    async def verification_list(request):
+        return web.json_response({"data": server.db.list_verification_jobs()})
+
+    async def verification_upsert(request):
+        b = await request.json()
+        server.db.upsert_verification_job(
+            b["id"], store=b.get("store", ""), schedule=b.get("schedule", ""),
+            sample_rate=float(b.get("sample_rate", 0.1)),
+            run_on_backup=bool(b.get("run_on_backup", False)))
+        return web.json_response({"ok": True})
+
+    async def verification_run(request):
+        from .verification_job import enqueue_verification
+        vid = request.match_info["id"]
+        rows = [v for v in server.db.list_verification_jobs()
+                if v["id"] == vid]
+        if not rows:
+            return web.json_response({"error": "unknown job"}, status=404)
+        return web.json_response(
+            {"started": enqueue_verification(server, rows[0])})
+
+    app.router.add_get("/plus/healthz", healthz)
+    app.router.add_get("/plus/readyz", readyz)
+    app.router.add_get("/plus/metrics", metrics_handler)
+    app.router.add_post("/plus/agent/bootstrap", agent_bootstrap)
+    app.router.add_post("/plus/agent/renew", agent_renew)
+    app.router.add_get("/api2/json/d2d/backup", backup_list)
+    app.router.add_post("/api2/json/d2d/backup", backup_upsert)
+    app.router.add_delete("/api2/json/d2d/backup/{id}", backup_delete)
+    app.router.add_post("/api2/json/d2d/backup/{id}/run", backup_run)
+    app.router.add_get("/api2/json/d2d/target", target_list)
+    app.router.add_post("/api2/json/d2d/target", target_upsert)
+    app.router.add_post("/api2/json/d2d/restore", restore_start)
+    app.router.add_get("/api2/json/d2d/restore/{rid}", restore_status)
+    app.router.add_get("/api2/json/d2d/snapshots", snapshots)
+    app.router.add_get("/api2/json/d2d/tasks", tasks)
+    app.router.add_get("/api2/json/d2d/tasks/{upid}", task_get)
+    app.router.add_get("/api2/json/d2d/exclusion", exclusion_list)
+    app.router.add_post("/api2/json/d2d/exclusion", exclusion_add)
+    app.router.add_post("/api2/json/d2d/token", token_create)
+    app.router.add_get("/api2/json/d2d/filetree", filetree)
+    app.router.add_get("/api2/json/d2d/verification", verification_list)
+    app.router.add_post("/api2/json/d2d/verification", verification_upsert)
+    app.router.add_post("/api2/json/d2d/verification/{id}/run",
+                        verification_run)
+    return app
+
+
+async def start_web(server: "Server", *, host: str = "127.0.0.1",
+                    port: int = 0, require_auth: bool = True,
+                    ) -> tuple[web.AppRunner, int]:
+    app = build_app(server, require_auth=require_auth)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    bound = site._server.sockets[0].getsockname()[1]
+    L.info("web API listening on %s:%d", host, bound)
+    return runner, bound

@@ -1,0 +1,18 @@
+"""The dedup sidecar — the gRPC shim between the system plane and the JAX
+data plane (BASELINE.json north star: "the Go agent/server talk to the JAX
+sidecar over a thin gRPC shim").
+
+In this build both planes are Python, so the pipeline is importable
+in-process (models.DedupPipeline) — but the sidecar remains a first-class
+deployment shape: a separate process owning the TPU, reached over gRPC, so
+N backup servers (or the k8s operator's 128-PVC fan-in, config #4) can
+share one chip.  grpcio is used with msgpack-serialized messages (no
+grpc_tools/protoc codegen is available in this image; the service uses
+explicit method handlers with custom serializers, which is wire-compatible
+gRPC with an application-defined message encoding).
+"""
+
+from .service import DedupService, serve_sidecar
+from .client import SidecarClient, SidecarChunker
+
+__all__ = ["DedupService", "serve_sidecar", "SidecarClient", "SidecarChunker"]

@@ -1,0 +1,69 @@
+"""Sidecar client + the chunker-interface adapter that routes a writer's
+CDC through the sidecar (``chunker = "sidecar:host:port"``)."""
+
+from __future__ import annotations
+
+import grpc
+
+from ..chunker.spec import ChunkerParams
+from ..utils import codec
+
+
+class SidecarClient:
+    def __init__(self, address: str):
+        self.channel = grpc.insecure_channel(
+            address,
+            options=[("grpc.max_receive_message_length", 128 << 20),
+                     ("grpc.max_send_message_length", 128 << 20)])
+
+    def _call(self, method: str, req: dict) -> dict:
+        fn = self.channel.unary_unary(
+            method,
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        return codec.decode_map(fn(codec.encode(req), timeout=300))
+
+    def chunk(self, stream_id: str, data: bytes, *, eof: bool = False) -> dict:
+        return self._call("/pbsplus.Dedup/Chunk",
+                          {"stream_id": stream_id, "data": data, "eof": eof})
+
+    def probe_index(self, digests: list[bytes]) -> list[bool]:
+        return self._call("/pbsplus.Dedup/ProbeIndex",
+                          {"digests": digests})["present"]
+
+    def insert_index(self, digests: list[bytes]) -> int:
+        return self._call("/pbsplus.Dedup/InsertIndex",
+                          {"digests": digests})["inserted"]
+
+    def stats(self) -> dict:
+        return self._call("/pbsplus.Dedup/Stats", {})
+
+    def snapshot_signature(self, digests: list[bytes]) -> list[int]:
+        return self._call("/pbsplus.Dedup/Similarity",
+                          {"digests": digests})["signature"]
+
+    def close(self) -> None:
+        self.channel.close()
+
+
+class SidecarChunker:
+    """feed/finalize chunker backed by the sidecar's Chunk method —
+    plugs into transfer writers like Cpu/TpuChunker.  Stream ids are
+    uuids: many processes share one sidecar without collisions."""
+
+    def __init__(self, params: ChunkerParams, client: SidecarClient):
+        import uuid
+        self.client = client
+        self.stream_id = uuid.uuid4().hex
+        self._finalized = False
+
+    def feed(self, data: bytes) -> list[int]:
+        if self._finalized:
+            raise RuntimeError("chunker already finalized")
+        return list(self.client.chunk(self.stream_id, bytes(data))["cuts"])
+
+    def finalize(self) -> list[int]:
+        if self._finalized:
+            return []
+        self._finalized = True
+        return list(self.client.chunk(self.stream_id, b"", eof=True)["cuts"])

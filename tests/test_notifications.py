@@ -1,0 +1,74 @@
+"""Notification batch tracker + alert scanner tests (reference analogs:
+batch_test.go, scanner coverage)."""
+
+import asyncio
+import json
+import os
+import time
+
+from pbs_plus_tpu.server import database
+from pbs_plus_tpu.server.notifications import (
+    AlertScanner, BatchTracker, file_spool_sink,
+)
+from pbs_plus_tpu.server.store import Server, ServerConfig
+
+
+def test_batch_tracker_aggregates(tmp_path):
+    async def main():
+        events = []
+        bt = BatchTracker(sink=lambda s, t, b: events.append((s, t, b)),
+                          window_s=0.1)
+        bt.record("a", "success")
+        bt.record("b", "error", "boom")
+        bt.record("c", "warnings")
+        await asyncio.sleep(0.3)
+        assert len(events) == 1
+        sev, title, body = events[0]
+        assert sev == "error"                     # worst status wins
+        assert "3 job(s)" in title
+        assert len(body["results"]) == 3
+        # second wave flushes separately
+        bt.record("d", "success")
+        await asyncio.sleep(0.3)
+        assert len(events) == 2
+        assert events[1][0] == "info"
+    asyncio.run(main())
+
+
+def test_file_spool_sink(tmp_path):
+    sink = file_spool_sink(str(tmp_path / "spool"))
+    sink("warning", "hello", {"x": 1})
+    files = os.listdir(tmp_path / "spool")
+    assert len(files) == 1
+    data = json.load(open(tmp_path / "spool" / files[0]))
+    assert data["severity"] == "warning" and data["body"] == {"x": 1}
+
+
+def test_alert_scanner(tmp_path):
+    async def main():
+        server = Server(ServerConfig(
+            state_dir=str(tmp_path / "s"), cert_dir=str(tmp_path / "c"),
+            datastore_dir=str(tmp_path / "d"), max_concurrent=2))
+        await server.start()
+        # stale scheduled job + failing job + offline agent target
+        server.db.upsert_backup_job(database.BackupJobRow(
+            id="stale", target="t1", source_path="/", schedule="daily"))
+        server.db.upsert_backup_job(database.BackupJobRow(
+            id="failing", target="t1", source_path="/"))
+        server.db.record_backup_result("failing", database.STATUS_ERROR,
+                                       error="disk on fire")
+        server.db.upsert_target("t1", "agent", hostname="agent-gone")
+        events = []
+        sc = AlertScanner(server, sink=lambda s, t, b: events.append((s, t)),
+                          cooldown_s=3600)
+        sc._emit(sc.scan())
+        titles = [t for _, t in events]
+        assert any("stale" in t for t in titles)
+        assert any("failing" in t for t in titles)
+        assert any("offline" in t for t in titles)
+        # cooldown suppresses repeats
+        n = len(events)
+        sc._emit(sc.scan())
+        assert len(events) == n
+        await server.stop()
+    asyncio.run(main())
