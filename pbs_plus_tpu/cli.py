@@ -1,0 +1,243 @@
+"""Command-line entrypoints — the reference's cmd/ binaries (SURVEY §2.8):
+
+    python -m pbs_plus_tpu server   ...   (cmd/pbs_plus daemon)
+    python -m pbs_plus_tpu agent    ...   (cmd/agent service loop)
+    python -m pbs_plus_tpu mount    ...   (cmd/pxar-mount serve/init)
+    python -m pbs_plus_tpu commit   ...   (pxar-mount commit client)
+    python -m pbs_plus_tpu sidecar  ...   (the dedup sidecar)
+    python -m pbs_plus_tpu bench          (bench.py equivalent)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+
+
+def _cmd_server(args: argparse.Namespace) -> int:
+    from .server.store import Server, ServerConfig
+    from .server.web import start_web
+    from .server.notifications import AlertScanner, BatchTracker, file_spool_sink
+
+    async def main():
+        server = Server(ServerConfig(
+            state_dir=args.state_dir, cert_dir=args.cert_dir,
+            datastore_dir=args.datastore, arpc_host=args.host,
+            arpc_port=args.arpc_port, chunker=args.chunker,
+            chunk_avg=args.chunk_avg))
+        sink = file_spool_sink(os.path.join(args.state_dir, "notify-spool"))
+        server.notifications = BatchTracker(sink=sink)
+        scanner = AlertScanner(server, sink)
+        await server.start()
+        runner, web_port = await start_web(
+            server, host=args.host, port=args.web_port,
+            require_auth=not args.no_auth)
+        scan_task = asyncio.create_task(scanner.run())
+        print(f"pbs-plus-tpu server: aRPC :{server.config.arpc_port}, "
+              f"web :{web_port}", flush=True)
+        if args.print_token:
+            tid, secret = server.issue_bootstrap_token(ttl_s=24 * 3600)
+            print(f"bootstrap token: {tid}:{secret.hex()}", flush=True)
+            aid, asecret = server.issue_api_token()
+            print(f"api token:       {aid}:{asecret.hex()}", flush=True)
+        stop = asyncio.Event()
+        try:
+            await stop.wait()
+        finally:
+            scanner.stop()
+            scan_task.cancel()
+            await runner.cleanup()
+            await server.stop()
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _cmd_agent(args: argparse.Namespace) -> int:
+    import aiohttp
+    from .agent.lifecycle import AgentConfig, AgentLifecycle
+    from .arpc import TlsClientConfig
+    from .utils import mtls
+
+    state = os.path.abspath(args.state_dir)
+    os.makedirs(state, exist_ok=True)
+    cert_p = os.path.join(state, "agent.pem")
+    key_p = os.path.join(state, "agent.key")
+    ca_p = os.path.join(state, "ca.pem")
+
+    async def bootstrap():
+        key = mtls.generate_private_key()
+        csr = mtls.make_csr(key, args.hostname)
+        tid, sec = args.bootstrap_token.split(":", 1)
+        async with aiohttp.ClientSession() as http:
+            r = await http.post(
+                f"{args.bootstrap_url}/plus/agent/bootstrap",
+                json={"hostname": args.hostname, "csr": csr.decode(),
+                      "token_id": tid, "token_secret": sec})
+            if r.status != 200:
+                raise SystemExit(f"bootstrap failed: {await r.text()}")
+            body = await r.json()
+        open(cert_p, "w").write(body["cert"])
+        open(ca_p, "w").write(body["ca"])
+        fd = os.open(key_p, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        os.write(fd, mtls.key_pem(key))
+        os.close(fd)
+        print("bootstrapped: certificate stored", flush=True)
+
+    async def main():
+        if not os.path.exists(cert_p):
+            if not args.bootstrap_token or not args.bootstrap_url:
+                raise SystemExit(
+                    "no certificate; pass --bootstrap-url and "
+                    "--bootstrap-token for first-time setup")
+            await bootstrap()
+        host, port = args.server.rsplit(":", 1)
+        agent = AgentLifecycle(AgentConfig(
+            hostname=args.hostname, server_host=host, server_port=int(port),
+            tls=TlsClientConfig(cert_p, key_p, ca_p)))
+        await agent.run()
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _cmd_mount(args: argparse.Namespace) -> int:
+    from .chunker import ChunkerParams
+    from .mount import ArchiveView, CommitEngine, Journal, MutableFS
+    from .mount.control import MountControl
+    from .pxar import LocalStore
+    from .pxar.datastore import SnapshotRef
+
+    async def main():
+        store = LocalStore(args.store, ChunkerParams(avg_size=args.chunk_avg))
+        previous = None
+        if args.snapshot:
+            previous = SnapshotRef(*args.snapshot.strip("/").split("/"))
+            view = ArchiveView(store.open_snapshot(previous))
+        else:
+            view = ArchiveView(None)     # init mode: empty archive
+        state = os.path.abspath(args.mount_state)
+        journal = Journal(os.path.join(state, "journal.db"))
+        fs = MutableFS(view, journal, os.path.join(state, "passthrough"))
+        bid = args.backup_id or (previous.backup_id if previous else "mount")
+        engine = CommitEngine(fs, store, backup_id=bid, previous=previous)
+        ctl = MountControl(engine, args.socket)
+        await ctl.start()
+        print(f"mounted {'(init mode)' if not args.snapshot else args.snapshot}"
+              f"; control socket {args.socket}", flush=True)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await ctl.stop()
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _cmd_commit(args: argparse.Namespace) -> int:
+    from .mount.control import commit_via_socket
+
+    async def main():
+        snap = await commit_via_socket(args.socket, timeout=args.timeout)
+        print(snap)
+    asyncio.run(main())
+    return 0
+
+
+def _cmd_sidecar(args: argparse.Namespace) -> int:
+    from .chunker import ChunkerParams
+    from .sidecar import serve_sidecar
+
+    server, port, svc = serve_sidecar(
+        args.listen, params=ChunkerParams(avg_size=args.chunk_avg),
+        use_tpu=None if args.tpu == "auto" else (args.tpu == "on"))
+    print(f"sidecar listening on port {port} (tpu={svc.use_tpu})", flush=True)
+    try:
+        server.wait_for_termination()
+    except KeyboardInterrupt:
+        server.stop(grace=5)
+    return 0
+
+
+def _cmd_bench(args: argparse.Namespace) -> int:
+    import runpy
+    sys.argv = ["bench.py"]
+    runpy.run_path(os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py"), run_name="__main__")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    # this image preloads jax with a TPU plugin before env vars are read;
+    # make JAX_PLATFORMS authoritative for CLI runs
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            import jax
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"].split(",")[0])
+        except Exception:
+            pass
+    p = argparse.ArgumentParser(prog="pbs-plus-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("server", help="run the backup server daemon")
+    s.add_argument("--state-dir", default="/var/lib/pbs-plus-tpu")
+    s.add_argument("--cert-dir", default="/etc/pbs-plus-tpu/certs")
+    s.add_argument("--datastore", required=True)
+    s.add_argument("--host", default="0.0.0.0")
+    s.add_argument("--arpc-port", type=int, default=8008)
+    s.add_argument("--web-port", type=int, default=8017)
+    s.add_argument("--chunker", default="cpu")
+    s.add_argument("--chunk-avg", type=int, default=4 << 20)
+    s.add_argument("--no-auth", action="store_true")
+    s.add_argument("--print-token", action="store_true",
+                   help="mint + print a bootstrap token at startup")
+    s.set_defaults(fn=_cmd_server)
+
+    a = sub.add_parser("agent", help="run the backup agent")
+    a.add_argument("--hostname", default=os.uname().nodename)
+    a.add_argument("--server", required=True, help="aRPC host:port")
+    a.add_argument("--state-dir", default="/var/lib/pbs-plus-tpu-agent")
+    a.add_argument("--bootstrap-url", default="",
+                   help="http(s)://server:web-port for first-time bootstrap")
+    a.add_argument("--bootstrap-token", default="", help="token_id:secret_hex")
+    a.set_defaults(fn=_cmd_agent)
+
+    m = sub.add_parser("mount", help="serve a mutable archive mount")
+    m.add_argument("--store", required=True)
+    m.add_argument("--snapshot", default="",
+                   help="type/id/time (omit for init mode)")
+    m.add_argument("--mount-state", required=True)
+    m.add_argument("--socket", required=True)
+    m.add_argument("--backup-id", default="")
+    m.add_argument("--chunk-avg", type=int, default=4 << 20)
+    m.set_defaults(fn=_cmd_mount)
+
+    c = sub.add_parser("commit", help="commit a mounted archive")
+    c.add_argument("--socket", required=True)
+    c.add_argument("--timeout", type=float, default=600.0)
+    c.set_defaults(fn=_cmd_commit)
+
+    d = sub.add_parser("sidecar", help="run the dedup sidecar")
+    d.add_argument("--listen", default="127.0.0.1:18900")
+    d.add_argument("--chunk-avg", type=int, default=4 << 20)
+    d.add_argument("--tpu", choices=["auto", "on", "off"], default="auto")
+    d.set_defaults(fn=_cmd_sidecar)
+
+    b = sub.add_parser("bench", help="run the benchmark")
+    b.set_defaults(fn=_cmd_bench)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

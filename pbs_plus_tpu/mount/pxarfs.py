@@ -1,0 +1,60 @@
+"""ArchiveView — the immutable archive side of a mount, with HotSwap.
+
+Reference: internal/pxarmount/pxarfs.go:24-727 — slim dirent cache with
+inode registry, stale eviction, and ``HotSwap(reader)`` replacing the
+archive under a live mount after a commit.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..pxar.format import Entry
+from ..pxar.transfer import SplitReader
+
+
+class ArchiveView:
+    def __init__(self, reader: SplitReader | None):
+        self._reader = reader
+        self._lock = threading.RLock()
+        self.generation = 0
+        self.stats = {"lookups": 0, "reads": 0, "bytes": 0, "swaps": 0}
+
+    @property
+    def reader(self) -> Optional[SplitReader]:
+        with self._lock:
+            return self._reader
+
+    def hot_swap(self, reader: SplitReader) -> None:
+        """Replace the archive under the live mount (reference: HotSwap —
+        performed only after a successful commit publish)."""
+        with self._lock:
+            self._reader = reader
+            self.generation += 1
+            self.stats["swaps"] += 1
+
+    # -- lookups (None-safe for init-mode empty mounts) --------------------
+    def lookup(self, path: str) -> Optional[Entry]:
+        self.stats["lookups"] += 1
+        r = self.reader
+        if r is None:
+            return Entry(path="", kind="d", mode=0o755) if path.strip("/") == "" else None
+        return r.lookup(path)
+
+    def read_dir(self, path: str) -> list[Entry]:
+        r = self.reader
+        if r is None:
+            if path.strip("/") == "":
+                return []
+            raise FileNotFoundError(path)
+        return r.read_dir(path)
+
+    def read_file(self, entry: Entry, off: int = 0, size: int = -1) -> bytes:
+        r = self.reader
+        if r is None:
+            raise FileNotFoundError(entry.path)
+        data = r.read_file(entry, off, size)
+        self.stats["reads"] += 1
+        self.stats["bytes"] += len(data)
+        return data

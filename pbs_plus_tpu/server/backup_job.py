@@ -48,6 +48,14 @@ QUEUE_DEPTH = 8               # prefetched blocks in flight
 _SENTINEL = object()
 
 
+def validate_chunker_kind(kind: str) -> None:
+    """Cheap syntactic validation (no clients constructed — web CRUD path)."""
+    if kind in ("", "cpu", "tpu") or kind.startswith("sidecar:"):
+        return
+    raise ValueError(f"unknown chunker backend {kind!r} "
+                     "(want cpu | tpu | sidecar:<host:port>)")
+
+
 def make_chunker_factory(kind: str):
     """The one-line config change (BASELINE.json):
     chunker = cpu | tpu | sidecar:<host:port>."""

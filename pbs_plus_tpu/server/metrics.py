@@ -68,8 +68,8 @@ class MetricsRegistry:
                 man = s.datastore.datastore.load_manifest(ref)
                 size_per_group[key] = size_per_group.get(key, 0) + \
                     man.get("payload_size", 0)
-            except OSError:
-                pass
+            except Exception:
+                pass    # a corrupt manifest must not kill the scrape
         gauge("pbs_plus_snapshots_per_group", "Snapshots per backup group",
               [({"group": g}, float(n)) for g, n in per_group.items()])
         gauge("pbs_plus_snapshot_bytes", "Logical bytes per backup group",

@@ -74,7 +74,8 @@ class BatchTracker:
             await asyncio.sleep(self.window_s)
         except asyncio.CancelledError:
             return
-        self.flush()
+        # sinks may block (sendmail) — keep them off the event loop
+        await asyncio.get_running_loop().run_in_executor(None, self.flush)
 
     def flush(self) -> None:
         if not self._results:
@@ -130,9 +131,11 @@ class AlertScanner:
             self.sink(severity, title, body)
 
     async def run(self) -> None:
+        loop = asyncio.get_running_loop()
         while not self._stop.is_set():
             try:
-                self._emit(self.scan())
+                alerts = await loop.run_in_executor(None, self.scan)
+                await loop.run_in_executor(None, self._emit, alerts)
             except Exception:
                 L.exception("alert scan failed")
             try:

@@ -187,6 +187,13 @@ class Server:
         self.db.put_token(token_id, secret, expires_at=time.time() + ttl_s)
         return token_id, secret
 
+    def issue_api_token(self, *, ttl_s: float | None = None) -> tuple[str, bytes]:
+        token_id = uuid.uuid4().hex[:12]
+        secret = os.urandom(24)
+        self.db.put_token(token_id, secret, kind="api",
+                          expires_at=time.time() + ttl_s if ttl_s else None)
+        return token_id, secret
+
     # -- job enqueue -------------------------------------------------------
     async def _enqueue_backup_row(self, row: database.BackupJobRow) -> None:
         self.enqueue_backup(row.id)

@@ -201,7 +201,9 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
         cert = server.certs.sign_csr(body["csr"].encode())
         fp = x509.load_pem_x509_certificate(cert).fingerprint(
             hashes.SHA256()).hex()
-        server.db.upsert_agent_host(hostname, cert, fp)
+        import json as _json
+        drives = _json.loads(row["drives"] or "[]")   # preserve inventory
+        server.db.upsert_agent_host(hostname, cert, fp, drives)
         return web.json_response({"cert": cert.decode()})
 
     # -- backup job CRUD ---------------------------------------------------
@@ -224,9 +226,9 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
     async def backup_upsert(request):
         b = await request.json()
         from ..utils import validate
-        from .backup_job import make_chunker_factory
+        from .backup_job import validate_chunker_kind
         chunker = b.get("chunker", server.config.chunker)
-        make_chunker_factory(chunker)   # reject unknown backends up front
+        validate_chunker_kind(chunker)  # reject unknown backends up front
         row = database.BackupJobRow(
             id=validate.job_id(b["id"]), target=b["target"],
             source_path=b["source_path"],
@@ -297,8 +299,8 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
                 item.update(entries=man.get("entries"),
                             payload_size=man.get("payload_size"),
                             previous=man.get("previous"))
-            except OSError:
-                pass
+            except Exception:
+                item["manifest_error"] = True
             out.append(item)
         return web.json_response({"data": out})
 

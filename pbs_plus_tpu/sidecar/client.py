@@ -51,11 +51,25 @@ class SidecarChunker:
     plugs into transfer writers like Cpu/TpuChunker.  Stream ids are
     uuids: many processes share one sidecar without collisions."""
 
+    _params_checked: set[int] = set()
+
     def __init__(self, params: ChunkerParams, client: SidecarClient):
         import uuid
         self.client = client
         self.stream_id = uuid.uuid4().hex
         self._finalized = False
+        # the sidecar chunks with ITS params — a silent mismatch would move
+        # every cut point, so verify once per client
+        if id(client) not in SidecarChunker._params_checked:
+            remote = client.stats().get("chunker", {})
+            if remote and (remote.get("avg") != params.avg_size
+                           or remote.get("seed") != params.seed
+                           or remote.get("min") != params.min_size
+                           or remote.get("max") != params.max_size):
+                raise ValueError(
+                    f"sidecar chunker params {remote} differ from the "
+                    f"writer's (avg={params.avg_size}, seed={params.seed})")
+            SidecarChunker._params_checked.add(id(client))
 
     def feed(self, data: bytes) -> list[int]:
         if self._finalized:

@@ -30,6 +30,11 @@ from ..utils import codec
 from ..utils.log import L
 
 
+import time as _time
+
+_STREAM_IDLE_EVICT_S = 3600.0      # abandoned-stream GC
+
+
 class _StreamState:
     def __init__(self, params: ChunkerParams, use_tpu: bool):
         if use_tpu:
@@ -40,6 +45,7 @@ class _StreamState:
         self.pending = bytearray()     # bytes not yet emitted as chunks
         self.base = 0                  # stream offset of pending[0]
         self.lock = threading.Lock()   # serialize calls per stream
+        self.last_used = _time.monotonic()
 
 
 class DedupService:
@@ -65,11 +71,17 @@ class DedupService:
         data = req.get("data", b"")
         eof = bool(req.get("eof", False))
         with self._lock:
+            now = _time.monotonic()
+            # GC streams abandoned by crashed clients (never sent eof)
+            for k in [k for k, v in self._streams.items()
+                      if now - v.last_used > _STREAM_IDLE_EVICT_S]:
+                del self._streams[k]
             st = self._streams.get(sid)
             if st is None:
                 st = _StreamState(self.params, self.use_tpu)
                 self._streams[sid] = st
                 self.stats["streams"] += 1
+            st.last_used = now
         with st.lock:                       # serialize per-stream feeds
             st.pending += data
             cuts = st.chunker.feed(data) if data else []
@@ -101,7 +113,11 @@ class DedupService:
 
     def get_stats(self, req: dict) -> dict:
         return {**self.stats, "index_size": len(self.index),
-                "use_tpu": self.use_tpu}
+                "use_tpu": self.use_tpu,
+                "chunker": {"avg": self.params.avg_size,
+                            "min": self.params.min_size,
+                            "max": self.params.max_size,
+                            "seed": self.params.seed}}
 
     def snapshot_signature(self, req: dict) -> dict:
         sig = self.similarity.snapshot_signature(list(req["digests"]))
