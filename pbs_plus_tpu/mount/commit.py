@@ -116,6 +116,7 @@ class CommitEngine:
                 with open(p, "rb") as f:
                     writer.write_entry_reader(e, f)
                 self.progress.changed_files += 1
+                self._changed_paths.append(rel)
             elif n.base_path is not None:
                 self._ref_or_reencode(writer, prev_entries, e, n.base_path)
             else:
@@ -159,6 +160,7 @@ class CommitEngine:
                 import io
                 writer.write_entry_reader(e, io.BytesIO(data))
                 self.progress.changed_files += 1
+                self._changed_paths.append(e.path)
 
     # -- the commit --------------------------------------------------------
     def commit(self) -> SnapshotRef:
@@ -183,6 +185,7 @@ class CommitEngine:
                                 for e in session.previous_reader.entries()}
             try:
                 prog.emit("walk")
+                self._changed_paths = []
                 root = fs.journal.get_node(ROOT_ID)
                 assert root is not None
                 session.writer.write_entry(self._entry_from_node(root, ""))
@@ -190,22 +193,31 @@ class CommitEngine:
                 self._walk(session.writer, prev_entries, root,
                            root.base_path, "")
 
+                # verify runs via the pre-publish hook: a failure aborts the
+                # staging dir and the datastore never sees the bad snapshot
                 prog.emit("upload")
+
+                def _pre_publish_verify(reader):
+                    prog.emit("verify")
+                    self._verify(reader)
+
                 manifest = session.finish(
                     {"commit": True,
-                     "journal": fs.journal.stats()})
+                     "journal": fs.journal.stats()},
+                    verify_hook=_pre_publish_verify)
             except BaseException:
                 session.abort()
                 raise
 
-            prog.emit("verify")
             new_ref = session.ref
             reader = self.store.open_snapshot(new_ref)
-            self._verify(reader)
 
             prog.emit("swap")
-            fs.journal.clear()
+            # readers are also excluded by the freeze barrier (read paths
+            # participate in op accounting), so the journal-clear/hot-swap
+            # pair is not observable half-done
             fs.view.hot_swap(reader)
+            fs.journal.clear()
             for name in os.listdir(fs.passthrough):
                 p = os.path.join(fs.passthrough, name)
                 try:
@@ -227,11 +239,16 @@ class CommitEngine:
             fs.unfreeze()
 
     def _verify(self, reader: SplitReader) -> None:
-        """Re-hash changed files in the new snapshot against their recorded
-        digests (reference: verifyBackedFileHashes worker pool)."""
+        """Re-hash the files this commit wrote (changed/new content) against
+        their recorded digests (reference: verifyBackedFileHashes — only
+        passthrough-backed files, so commit cost stays O(changed bytes))."""
+        changed = set(getattr(self, "_changed_paths", []))
         vp = VerifyPipeline()
-        res = vp.verify_snapshot(reader, sample_rate=1.0)
+        entries = [e for e in reader.entries()
+                   if e.is_file and e.size and e.digest and e.path in changed]
+        chunks = [reader.read_file(e) for e in entries]
+        res = vp.verify_chunks(chunks, [e.digest for e in entries])
         self.progress.verified = res.checked
         if not res.ok:
             raise RuntimeError(
-                f"post-commit verification failed for {len(res.corrupt)} files")
+                f"commit verification failed for {len(res.corrupt)} files")

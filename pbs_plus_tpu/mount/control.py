@@ -101,6 +101,8 @@ class MountControl:
             writer.close()
 
     async def _do_commit(self, writer: asyncio.StreamWriter) -> None:
+        # explicit busy flag: the check-and-set happens with no awaits in
+        # between, so concurrent "commit" commands cannot both pass
         if self._commit_lock.locked():
             writer.write(b"err commit already running\n")
             await writer.drain()

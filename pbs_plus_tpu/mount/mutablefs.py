@@ -147,6 +147,7 @@ class MutableFS:
         return Resolved(node, arch)
 
     # -- attrs -------------------------------------------------------------
+    @_mutating
     def getattr(self, path: str) -> Entry:
         r = self.resolve(path)
         if not r.exists:
@@ -174,6 +175,7 @@ class MutableFS:
         out.path = rel
         return out
 
+    @_mutating
     def readdir(self, path: str) -> list[Entry]:
         r = self.resolve(path)
         if not r.exists:
@@ -207,6 +209,7 @@ class MutableFS:
         return out
 
     # -- data --------------------------------------------------------------
+    @_mutating
     def read(self, path: str, off: int = 0, size: int = -1) -> bytes:
         self.stats["reads"] += 1
         r = self.resolve(path)
@@ -375,6 +378,7 @@ class MutableFS:
         self.journal.put_node(node)
         self.journal.set_edge(pnode.id, name, node.id)
 
+    @_mutating
     def readlink(self, path: str) -> str:
         e = self.getattr(path)
         if e.kind != KIND_SYMLINK:
@@ -427,8 +431,14 @@ class MutableFS:
         if not r.exists:
             raise FileNotFoundError(src)
         if self.resolve(dst).exists:
-            # posix rename-over: target must be removable
+            # posix rename-over semantics: file->dir is EISDIR, dir->file is
+            # ENOTDIR, dir->nonempty-dir is ENOTEMPTY (rmdir raises)
+            se = self.getattr(src)
             de = self.getattr(dst)
+            if de.is_dir and not se.is_dir:
+                raise IsADirectoryError(dst)
+            if se.is_dir and not de.is_dir:
+                raise NotADirectoryError(dst)
             if de.is_dir:
                 self.rmdir(dst)
             else:
@@ -496,6 +506,7 @@ class MutableFS:
         n = self._node_for_meta(path)
         self.journal.set_xattr(n.id, name, value)
 
+    @_mutating
     def get_xattrs(self, path: str) -> dict[str, bytes]:
         r = self.resolve(path)
         if not r.exists:

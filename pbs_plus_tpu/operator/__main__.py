@@ -1,0 +1,26 @@
+"""Operator entrypoint (reference: cmd/operator/main.go:1-73)."""
+
+import asyncio
+import os
+
+
+async def main() -> None:
+    import aiohttp
+
+    from .kube import KubeClient
+    from .operator import Operator, OperatorConfig
+
+    async with aiohttp.ClientSession() as http:
+        kube = KubeClient.in_cluster(http)
+        op = Operator(kube, OperatorConfig(
+            server_url=os.environ["PBS_PLUS_SERVER_URL"],
+            bootstrap_url=os.environ["PBS_PLUS_BOOTSTRAP_URL"],
+            bootstrap_token=os.environ.get("PBS_PLUS_BOOTSTRAP_TOKEN", ""),
+            agent_image=os.environ.get("PBS_PLUS_AGENT_IMAGE",
+                                       "pbs-plus-tpu:latest"),
+        ))
+        await op.run()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,119 @@
+"""Pallas TPU kernel for the buzhash candidate mask.
+
+The jnp path (ops/rolling_hash.py) materializes the uint32 hash array
+between each of the 6 doubling passes — ~8 HBM round-trips per byte when
+XLA doesn't fuse them all.  This kernel runs the whole chain per tile in
+VMEM: nibble-table lookup (unrolled selects over compile-time constants),
+log2(W)=6 shift-rotate-XOR doubling passes, and the mask compare — one
+HBM read of the bytes, one write of the mask.
+
+Tiling: the position-local window needs the previous 63 bytes, so each
+grid step gets its tile plus a 64-byte halo (prepared host-side with a
+cheap slice).  Buffer = 64 + TILE bytes = 16384 (a [1, 16384] row — lane
+dim 128×128) so rolls stay within one row.
+
+Runs under ``interpret=True`` on CPU for parity tests; real TPU lowering
+is exercised by bench.py when a chip is present (use_pallas=True).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..chunker.spec import WINDOW, ChunkerParams, buzhash_subtables
+
+HALO = 64                    # one extra over W-1 keeps the buffer 128-aligned
+TILE = 16384 - HALO          # payload bytes per grid step
+BUF = HALO + TILE            # 16384 = 128 * 128
+
+
+def _kernel_factory(table_a: np.ndarray, table_b: np.ndarray,
+                    mask: int, magic: int):
+    A = [np.uint32(x) for x in table_a]
+    B = [np.uint32(x) for x in table_b]
+    mask_c = np.uint32(mask)
+    magic_c = np.uint32(magic)
+
+    def kernel(halo_ref, tile_ref, out_ref):
+        # [1, BUF] uint8 buffer = halo ++ tile
+        buf = jnp.concatenate([halo_ref[...], tile_ref[...]], axis=1)
+        hi = buf >> np.uint8(4)
+        lo = buf & np.uint8(0xF)
+        h = jnp.zeros(buf.shape, dtype=jnp.uint32)
+        for i in range(16):
+            iv = np.uint8(i)
+            h = h ^ jnp.where(hi == iv, A[i], np.uint32(0)) \
+                  ^ jnp.where(lo == iv, B[i], np.uint32(0))
+        m = 1
+        while m < WINDOW:
+            r = m & 31
+            prev = jnp.roll(h, m, axis=1)       # wrapped head lands in halo
+            if r:
+                rot = (prev << np.uint32(r)) | (prev >> np.uint32(32 - r))
+            else:
+                rot = prev
+            h = h ^ rot
+            m *= 2
+        hit = ((h & mask_c) == magic_c).astype(jnp.uint8)
+        out_ref[...] = hit[:, HALO:]
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("mask", "magic", "seed",
+                                             "interpret"))
+def _candidate_mask_tiles(halos: jax.Array, tiles: jax.Array, *,
+                          mask: int, magic: int, seed: int,
+                          interpret: bool) -> jax.Array:
+    from jax.experimental import pallas as pl
+
+    a, b = buzhash_subtables(seed)
+    kernel = _kernel_factory(a, b, mask, magic)
+    n = tiles.shape[0]
+    return pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, HALO), lambda i: (i, 0)),
+            pl.BlockSpec((1, TILE), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, TILE), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, TILE), jnp.uint8),
+        interpret=interpret,
+    )(halos, tiles)
+
+
+def candidate_mask_pallas(data: jax.Array, params: ChunkerParams, *,
+                          interpret: bool | None = None) -> jax.Array:
+    """bool[B, S] candidate mask via the Pallas kernel.  S is padded to a
+    TILE multiple internally; the first W-1 positions of each stream are
+    masked invalid (no full window), matching the jnp kernel with no
+    history."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if data.ndim == 1:
+        data = data[None]
+        squeeze = True
+    else:
+        squeeze = False
+    Bn, S = data.shape
+    pad = (-S) % TILE
+    padded = jnp.pad(data, ((0, 0), (0, pad))) if pad else data
+    Sp = S + pad
+    nt = Sp // TILE
+    tiles = padded.reshape(Bn * nt, TILE)
+    # halo i = the 64 bytes preceding tile i within its stream (zeros for
+    # the first tile of each stream)
+    shifted = jnp.pad(padded, ((0, 0), (HALO, 0)))[:, :Sp]
+    halos = shifted.reshape(Bn * nt, TILE)[:, :HALO]
+    hit = _candidate_mask_tiles(
+        halos, tiles, mask=params.mask, magic=params.magic,
+        seed=params.seed, interpret=bool(interpret))
+    hit = hit.reshape(Bn, Sp)[:, :S].astype(bool)
+    pos = jnp.arange(S, dtype=jnp.int32)
+    hit = hit & (pos >= WINDOW - 1)[None, :]
+    return hit[0] if squeeze else hit

@@ -64,10 +64,13 @@ class BackupSession:
     def previous_reader(self) -> SplitReader | None:
         return self._prev_reader
 
-    def finish(self, extra_manifest: dict | None = None) -> dict:
+    def finish(self, extra_manifest: dict | None = None, *,
+               verify_hook=None) -> dict:
         """Flush writers, write indexes + manifest, publish atomically.
-        On failure the staging dir is removed and the session is dead —
-        the datastore never sees a half-snapshot."""
+        ``verify_hook(reader)`` runs against the staged (pre-publish)
+        snapshot — raising there aborts the staging dir, so a corrupt
+        snapshot is never published.  On failure the staging dir is removed
+        and the session is dead — the datastore never sees a half-snapshot."""
         if self._done:
             raise RuntimeError("session already finished")
         try:
@@ -75,6 +78,8 @@ class BackupSession:
             ds = self.store.datastore
             midx.write(os.path.join(self._tmp_dir, ds.META_IDX))
             pidx.write(os.path.join(self._tmp_dir, ds.PAYLOAD_IDX))
+            if verify_hook is not None:
+                verify_hook(SplitReader(midx, pidx, ds.chunks))
             # same-second concurrent sessions: re-check the final dir at
             # publish time and bump +1 s until free
             while os.path.exists(self._final_dir):

@@ -51,16 +51,19 @@ class SidecarChunker:
     plugs into transfer writers like Cpu/TpuChunker.  Stream ids are
     uuids: many processes share one sidecar without collisions."""
 
-    _params_checked: set[int] = set()
-
     def __init__(self, params: ChunkerParams, client: SidecarClient):
         import uuid
         self.client = client
         self.stream_id = uuid.uuid4().hex
         self._finalized = False
         # the sidecar chunks with ITS params — a silent mismatch would move
-        # every cut point, so verify once per client
-        if id(client) not in SidecarChunker._params_checked:
+        # every cut point, so verify once per (client, params) combination
+        # (cached on the client object itself)
+        key = (params.avg_size, params.min_size, params.max_size, params.seed)
+        checked = getattr(client, "_checked_params", None)
+        if checked is None:
+            checked = client._checked_params = set()
+        if key not in checked:
             remote = client.stats().get("chunker", {})
             if remote and (remote.get("avg") != params.avg_size
                            or remote.get("seed") != params.seed
@@ -69,7 +72,7 @@ class SidecarChunker:
                 raise ValueError(
                     f"sidecar chunker params {remote} differ from the "
                     f"writer's (avg={params.avg_size}, seed={params.seed})")
-            SidecarChunker._params_checked.add(id(client))
+            checked.add(key)
 
     def feed(self, data: bytes) -> list[int]:
         if self._finalized:

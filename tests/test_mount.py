@@ -231,6 +231,9 @@ def test_commit_failure_leaves_old_state(mounted, monkeypatch):
     # journal + passthrough intact, old archive still serving
     assert fs.read("docs/a.txt")[:8] == b"WILLFAIL"
     assert fs.view.generation == 0
+    # verification runs PRE-publish: the failed snapshot never landed in
+    # the datastore (no pollution of the group's `previous` chain)
+    assert store.datastore.list_snapshots() == before
     # mutations still possible after the failed commit (unfrozen)
     fs.write("docs/a.txt", b"again", 0)
 

@@ -1,0 +1,185 @@
+"""Operator reconcile tests against a fake kube API server (aiohttp)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import ClientSession, web
+
+from pbs_plus_tpu.operator import KubeClient, Operator, OperatorConfig
+
+
+class FakeKube:
+    """In-memory PVCs/pods/snapshots behind the kube REST surface."""
+
+    def __init__(self):
+        self.pvcs: dict[str, dict] = {}
+        self.pods: dict[str, dict] = {}
+        self.snaps: dict[str, dict] = {}
+        self.snap_ready = True
+
+    def app(self) -> web.Application:
+        app = web.Application()
+        r = app.router
+        r.add_get("/api/v1/namespaces/{ns}/persistentvolumeclaims",
+                  self._list_pvcs)
+        r.add_post("/api/v1/namespaces/{ns}/persistentvolumeclaims",
+                   self._create_pvc)
+        r.add_delete("/api/v1/namespaces/{ns}/persistentvolumeclaims/{name}",
+                     self._delete_pvc)
+        r.add_get("/api/v1/namespaces/{ns}/pods/{name}", self._get_pod)
+        r.add_post("/api/v1/namespaces/{ns}/pods", self._create_pod)
+        r.add_delete("/api/v1/namespaces/{ns}/pods/{name}", self._delete_pod)
+        base = "/apis/snapshot.storage.k8s.io/v1/namespaces/{ns}/volumesnapshots"
+        r.add_post(base, self._create_snap)
+        r.add_get(base + "/{name}", self._get_snap)
+        r.add_delete(base + "/{name}", self._delete_snap)
+        return app
+
+    async def _list_pvcs(self, req):
+        return web.json_response({"items": list(self.pvcs.values())})
+
+    async def _create_pvc(self, req):
+        body = await req.json()
+        name = body["metadata"]["name"]
+        if name in self.pvcs:
+            return web.json_response({"reason": "AlreadyExists"}, status=409)
+        self.pvcs[name] = body
+        return web.json_response(body)
+
+    async def _delete_pvc(self, req):
+        self.pvcs.pop(req.match_info["name"], None)
+        return web.json_response({})
+
+    async def _get_pod(self, req):
+        pod = self.pods.get(req.match_info["name"])
+        if pod is None:
+            return web.json_response({"reason": "NotFound"}, status=404)
+        return web.json_response(pod)
+
+    async def _create_pod(self, req):
+        body = await req.json()
+        body.setdefault("status", {"phase": "Running"})
+        self.pods[body["metadata"]["name"]] = body
+        return web.json_response(body)
+
+    async def _delete_pod(self, req):
+        self.pods.pop(req.match_info["name"], None)
+        return web.json_response({})
+
+    async def _create_snap(self, req):
+        body = await req.json()
+        body["status"] = {"readyToUse": self.snap_ready}
+        self.snaps[body["metadata"]["name"]] = body
+        return web.json_response(body)
+
+    async def _get_snap(self, req):
+        s = self.snaps.get(req.match_info["name"])
+        if s is None:
+            return web.json_response({"reason": "NotFound"}, status=404)
+        s["status"] = {"readyToUse": self.snap_ready}
+        return web.json_response(s)
+
+    async def _delete_snap(self, req):
+        self.snaps.pop(req.match_info["name"], None)
+        return web.json_response({})
+
+
+def _pvc(name, *, annotated=True, rwo=False):
+    return {
+        "metadata": {"name": name,
+                     "annotations": {"pbs-plus.io/backup": "true"}
+                     if annotated else {}},
+        "spec": {"accessModes": ["ReadWriteOnce"] if rwo
+                 else ["ReadWriteMany"],
+                 "resources": {"requests": {"storage": "1Gi"}}},
+    }
+
+
+@pytest.fixture
+def fake():
+    return FakeKube()
+
+
+async def _run(fake, fn):
+    runner = web.AppRunner(fake.app())
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    async with ClientSession() as http:
+        kube = KubeClient(http, f"http://127.0.0.1:{port}",
+                          namespace="default")
+        op = Operator(kube, OperatorConfig(
+            server_url="srv:8008", bootstrap_url="http://srv:8017",
+            bootstrap_token="t:s"))
+        try:
+            return await fn(op)
+        finally:
+            await runner.cleanup()
+
+
+def test_reconcile_creates_agent_pods(fake):
+    async def fn(op):
+        fake.pvcs["data-a"] = _pvc("data-a")
+        fake.pvcs["data-b"] = _pvc("data-b")
+        fake.pvcs["ignored"] = _pvc("ignored", annotated=False)
+        res = await op.reconcile()
+        assert sorted(res.created_pods) == ["pbs-agent-data-a",
+                                           "pbs-agent-data-b"]
+        assert "pbs-agent-ignored" not in fake.pods
+        pod = fake.pods["pbs-agent-data-a"]
+        args = pod["spec"]["containers"][0]["args"]
+        assert "--hostname" in args and "pvc-data-a" in args
+        vols = {v["name"]: v for v in pod["spec"]["volumes"]}
+        assert vols["data"]["persistentVolumeClaim"]["claimName"] == "data-a"
+        # second reconcile: pod running → skipped, no duplicates
+        res2 = await op.reconcile()
+        assert res2.created_pods == [] and len(res2.skipped) == 2
+    asyncio.run(_run(fake, fn))
+
+
+def test_reconcile_rwo_snapshot_flow(fake):
+    async def fn(op):
+        fake.pvcs["pgdata"] = _pvc("pgdata", rwo=True)
+        fake.snap_ready = False
+        res = await op.reconcile()
+        # snapshot created but not ready → no pod yet
+        assert res.created_snapshots == ["pbs-snap-pgdata"]
+        assert res.created_pods == []
+        fake.snap_ready = True
+        res2 = await op.reconcile()
+        assert res2.created_pods == ["pbs-agent-pgdata"]
+        assert "pbs-clone-pgdata" in fake.pvcs
+        pod = fake.pods["pbs-agent-pgdata"]
+        vols = {v["name"]: v for v in pod["spec"]["volumes"]}
+        assert vols["data"]["persistentVolumeClaim"]["claimName"] == \
+            "pbs-clone-pgdata"
+    asyncio.run(_run(fake, fn))
+
+
+def test_reconcile_cleans_finished_pods(fake):
+    async def fn(op):
+        fake.pvcs["pgdata"] = _pvc("pgdata", rwo=True)
+        await op.reconcile()
+        fake.snap_ready = True
+        await op.reconcile()
+        # agent pod finished its backup
+        fake.pods["pbs-agent-pgdata"]["status"]["phase"] = "Succeeded"
+        res = await op.reconcile()
+        assert res.cleaned == ["pbs-agent-pgdata"]
+        assert "pbs-agent-pgdata" not in fake.pods
+        assert "pbs-clone-pgdata" not in fake.pvcs       # clone cleaned
+        assert "pbs-snap-pgdata" not in fake.snaps       # snapshot cleaned
+    asyncio.run(_run(fake, fn))
+
+
+def test_operator_128_pvc_fan_in(fake):
+    """BASELINE.json config #4 shape: 128 annotated PVCs → 128 agent pods."""
+    async def fn(op):
+        for i in range(128):
+            fake.pvcs[f"pvc-{i:03d}"] = _pvc(f"pvc-{i:03d}")
+        res = await op.reconcile()
+        assert len(res.created_pods) == 128
+        assert len(fake.pods) == 128
+    asyncio.run(_run(fake, fn))

@@ -52,6 +52,25 @@ def test_candidate_mask_with_history():
     assert np.array_equal(got[1], whole[half:])
 
 
+def test_pallas_kernel_matches_cpu():
+    """The fused Pallas rolling-hash kernel (interpret mode on CPU) is
+    bit-identical to the CPU chunker's candidate set."""
+    from pbs_plus_tpu.ops.pallas_rolling_hash import candidate_mask_pallas
+    data = np.frombuffer(_data(50_000, seed=21), dtype=np.uint8)
+    got_mask = np.asarray(candidate_mask_pallas(jnp.asarray(data), P))
+    got = (np.nonzero(got_mask)[0] + 1).astype(np.int64)
+    want = candidates(data, P, force_numpy=True)
+    assert np.array_equal(got, want)
+    # batched form + tile-boundary coverage (stream > several tiles)
+    data2 = np.frombuffer(_data(40_000, seed=22), dtype=np.uint8)
+    batch = np.stack([data[:40_000], data2])
+    bm = np.asarray(candidate_mask_pallas(jnp.asarray(batch), P))
+    for i, row in enumerate(batch):
+        want_i = candidates(row, P, force_numpy=True)
+        got_i = (np.nonzero(bm[i])[0] + 1).astype(np.int64)
+        assert np.array_equal(got_i, want_i), i
+
+
 def test_device_cuts_match_cpu_cuts():
     data = _data(300_000, seed=3)
     assert chunk_stream_device(data, P) == [e for _, e in chunk_bounds(data, P)]

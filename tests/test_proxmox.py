@@ -1,0 +1,47 @@
+"""UPID + task-log file tests (reference analogs: upid.go tests,
+tasklog coverage)."""
+
+import pytest
+
+from pbs_plus_tpu.proxmox import TaskLogDir, WorkerTask, new_upid, parse_upid
+
+
+def test_upid_roundtrip():
+    u = new_upid("backup", "store:vm/100")
+    s = str(u)
+    assert s.startswith("UPID:") and s.endswith(":")
+    p = parse_upid(s)
+    assert p == u
+    assert p.worker_id == "store:vm/100"     # percent-encoding roundtrip
+
+
+def test_upid_parse_real_format():
+    # a PBS-shaped UPID string parses
+    s = "UPID:pbs1:00001A2B:0003E8F1:00000042:65A0B1C2:backup:ds1%3Avm%2F100:root@pam:"
+    u = parse_upid(s)
+    assert u.node == "pbs1" and u.worker_type == "backup"
+    assert u.worker_id == "ds1:vm/100"
+    assert str(u) == s
+    for bad in ["UPID:x", "", "UPID:n:zz:1:1:1:t:w:a:", str(u)[:-1]]:
+        with pytest.raises(ValueError):
+            parse_upid(bad)
+
+
+def test_worker_task_lifecycle(tmp_path):
+    logs = TaskLogDir(str(tmp_path))
+    t = WorkerTask(logs, "backup", "job1")
+    assert logs.list_active() == [str(t.upid)]
+    t.log("starting")
+    t.warn("minor issue")
+    status = t.finish()
+    assert status == "WARNINGS: 1"
+    assert logs.list_active() == []
+    assert logs.read_status(t.upid) == "WARNINGS: 1"
+    body = t.read_log()
+    assert "starting" in body and "TASK WARNINGS: 1" in body
+
+    t2 = WorkerTask(logs, "restore", "r1")
+    assert t2.finish("disk exploded") == "ERROR: disk exploded"
+    assert logs.read_status(t2.upid) == "ERROR: disk exploded"
+    t3 = WorkerTask(logs, "verify", "v1")
+    assert t3.finish() == "OK"

@@ -1,0 +1,77 @@
+"""Updater/binswap/watchdog tests (reference analogs: binswap tests,
+updater watchdog coverage — SURVEY §2.4)."""
+
+import json
+import os
+import time
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+
+from pbs_plus_tpu.agent.updater import (
+    BinSwap, SwapState, Watchdog, verify_signature,
+)
+
+
+def _keypair():
+    key = ec.generate_private_key(ec.SECP256R1())
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo)
+    return key, pub
+
+
+def test_signature_verify():
+    key, pub = _keypair()
+    data = b"new agent binary"
+    sig = key.sign(data, ec.ECDSA(hashes.SHA256()))
+    assert verify_signature(data, sig, pub)
+    assert not verify_signature(data + b"x", sig, pub)
+    assert not verify_signature(data, sig[:-2] + b"xx", pub)
+    _, other_pub = _keypair()
+    assert not verify_signature(data, sig, other_pub)
+
+
+def test_stage_swap_commit(tmp_path):
+    live = tmp_path / "agent.bin"
+    live.write_bytes(b"v1")
+    swap = BinSwap(SwapState(str(live), str(tmp_path / "upd")))
+    swap.stage(b"v2", "2.0")
+    assert live.read_bytes() == b"v1"          # staged, not yet live
+    swap.swap()
+    assert live.read_bytes() == b"v2"
+    assert (tmp_path / "upd" / "previous.bin").read_bytes() == b"v1"
+    wd = Watchdog(swap)
+    assert wd.on_boot() == "grace"
+    wd.mark_healthy()
+    assert not os.path.exists(tmp_path / "upd" / "previous.bin")
+    assert not os.path.exists(tmp_path / "upd" / "pending-update.json")
+    assert wd.on_boot() == "no-pending"
+
+
+def test_watchdog_rollback_on_expired_grace(tmp_path):
+    live = tmp_path / "agent.bin"
+    live.write_bytes(b"v1")
+    swap = BinSwap(SwapState(str(live), str(tmp_path / "upd")))
+    swap.stage(b"v2-broken", "2.0")
+    swap.swap()
+    # simulate: never marked healthy, grace elapsed
+    m = json.load(open(tmp_path / "upd" / "pending-update.json"))
+    m["swapped_at"] = time.time() - 3600
+    json.dump(m, open(tmp_path / "upd" / "pending-update.json", "w"))
+    wd = Watchdog(swap, grace_s=600)
+    assert wd.on_boot() == "rolled-back"
+    assert live.read_bytes() == b"v1"
+
+
+def test_watchdog_rollback_on_crash_loop(tmp_path):
+    live = tmp_path / "agent.bin"
+    live.write_bytes(b"v1")
+    swap = BinSwap(SwapState(str(live), str(tmp_path / "upd")))
+    swap.stage(b"v2-crashy", "2.0")
+    swap.swap()
+    wd = Watchdog(swap, grace_s=3600)
+    assert wd.on_boot() == "grace"      # boot 1
+    assert wd.on_boot() == "grace"      # boot 2 (crashed, restarted)
+    assert wd.on_boot() == "rolled-back"  # boot 3 → crash loop
+    assert live.read_bytes() == b"v1"
