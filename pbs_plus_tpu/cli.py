@@ -128,12 +128,26 @@ def _cmd_mount(args: argparse.Namespace) -> int:
         bid = args.backup_id or (previous.backup_id if previous else "mount")
         engine = CommitEngine(fs, store, backup_id=bid, previous=previous)
         ctl = MountControl(engine, args.socket)
-        await ctl.start()
-        print(f"mounted {'(init mode)' if not args.snapshot else args.snapshot}"
-              f"; control socket {args.socket}", flush=True)
+        fuse = None
         try:
+            await ctl.start()
+            if args.mountpoint:
+                from .mount.fusefs import FuseMount
+                try:
+                    fuse = FuseMount(fs, args.mountpoint)
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, fuse.mount)
+                except (OSError, TimeoutError, RuntimeError) as e:
+                    raise SystemExit(f"kernel FUSE mount failed: {e}")
+                print(f"kernel mount at {args.mountpoint}", flush=True)
+            print(f"mounted "
+                  f"{'(init mode)' if not args.snapshot else args.snapshot}"
+                  f"; control socket {args.socket}", flush=True)
             await asyncio.Event().wait()
         finally:
+            if fuse is not None:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, fuse.unmount)
             await ctl.stop()
     try:
         asyncio.run(main())
@@ -219,6 +233,8 @@ def main(argv: list[str] | None = None) -> int:
     m.add_argument("--socket", required=True)
     m.add_argument("--backup-id", default="")
     m.add_argument("--chunk-avg", type=int, default=4 << 20)
+    m.add_argument("--mountpoint", default="",
+                   help="also expose the mount via kernel FUSE here")
     m.set_defaults(fn=_cmd_mount)
 
     c = sub.add_parser("commit", help="commit a mounted archive")

@@ -1,0 +1,295 @@
+"""Kernel FUSE frontend for MutableFS (ctypes over libfuse 2.9).
+
+Reference: the go-fuse v2 RawFileSystem frontends (internal/pxarmount/
+mutablefs.go for the archive mount, internal/server/vfs/arpcfs for the
+backup mount).  No Python FUSE binding ships in this image, so this module
+binds libfuse.so.2's high-level API (FUSE_USE_VERSION 26) directly:
+a ``fuse_operations`` struct of C callbacks forwarding to a MutableFS.
+
+Runs single-threaded foreground (``-s -f``) in a dedicated thread; the
+freeze barrier therefore excludes kernel-originated operations during
+commits exactly like embedded use.
+"""
+
+from __future__ import annotations
+
+import ctypes as C
+import errno
+import os
+import stat as statmod
+import threading
+from typing import Optional
+
+from ..pxar.format import KIND_DIR, KIND_FILE, KIND_SYMLINK
+from ..utils.log import L
+from .mutablefs import MutableFS
+
+_libfuse = None
+
+
+def _load_libfuse():
+    global _libfuse
+    if _libfuse is None:
+        _libfuse = C.CDLL("libfuse.so.2", use_errno=True)
+    return _libfuse
+
+
+class _Timespec(C.Structure):
+    _fields_ = [("tv_sec", C.c_long), ("tv_nsec", C.c_long)]
+
+
+class _Stat(C.Structure):           # x86_64 struct stat
+    _fields_ = [
+        ("st_dev", C.c_ulong), ("st_ino", C.c_ulong),
+        ("st_nlink", C.c_ulong), ("st_mode", C.c_uint),
+        ("st_uid", C.c_uint), ("st_gid", C.c_uint), ("__pad0", C.c_uint),
+        ("st_rdev", C.c_ulong), ("st_size", C.c_long),
+        ("st_blksize", C.c_long), ("st_blocks", C.c_long),
+        ("st_atim", _Timespec), ("st_mtim", _Timespec),
+        ("st_ctim", _Timespec), ("__reserved", C.c_long * 3),
+    ]
+
+
+_GETATTR = C.CFUNCTYPE(C.c_int, C.c_char_p, C.POINTER(_Stat))
+_READLINK = C.CFUNCTYPE(C.c_int, C.c_char_p, C.c_char_p, C.c_size_t)
+_MKDIR = C.CFUNCTYPE(C.c_int, C.c_char_p, C.c_uint)
+_UNLINK = C.CFUNCTYPE(C.c_int, C.c_char_p)
+_RMDIR = C.CFUNCTYPE(C.c_int, C.c_char_p)
+_SYMLINK = C.CFUNCTYPE(C.c_int, C.c_char_p, C.c_char_p)
+_RENAME = C.CFUNCTYPE(C.c_int, C.c_char_p, C.c_char_p)
+_CHMOD = C.CFUNCTYPE(C.c_int, C.c_char_p, C.c_uint)
+_CHOWN = C.CFUNCTYPE(C.c_int, C.c_char_p, C.c_uint, C.c_uint)
+_TRUNCATE = C.CFUNCTYPE(C.c_int, C.c_char_p, C.c_long)
+_OPEN = C.CFUNCTYPE(C.c_int, C.c_char_p, C.c_void_p)
+_READ = C.CFUNCTYPE(C.c_int, C.c_char_p, C.POINTER(C.c_char), C.c_size_t,
+                    C.c_long, C.c_void_p)
+_WRITE = C.CFUNCTYPE(C.c_int, C.c_char_p, C.POINTER(C.c_char), C.c_size_t,
+                     C.c_long, C.c_void_p)
+_FILLER = C.CFUNCTYPE(C.c_int, C.c_void_p, C.c_char_p, C.POINTER(_Stat),
+                      C.c_long)
+_READDIR = C.CFUNCTYPE(C.c_int, C.c_char_p, C.c_void_p, _FILLER, C.c_long,
+                       C.c_void_p)
+_CREATE = C.CFUNCTYPE(C.c_int, C.c_char_p, C.c_uint, C.c_void_p)
+_UTIMENS = C.CFUNCTYPE(C.c_int, C.c_char_p, C.POINTER(_Timespec))
+_VOIDP = C.c_void_p
+
+
+class _FuseOps(C.Structure):        # libfuse 2.9 fuse_operations (API 26)
+    _fields_ = [
+        ("getattr", _GETATTR), ("readlink", _READLINK), ("getdir", _VOIDP),
+        ("mknod", _VOIDP), ("mkdir", _MKDIR), ("unlink", _UNLINK),
+        ("rmdir", _RMDIR), ("symlink", _SYMLINK), ("rename", _RENAME),
+        ("link", _VOIDP), ("chmod", _CHMOD), ("chown", _CHOWN),
+        ("truncate", _TRUNCATE), ("utime", _VOIDP), ("open", _OPEN),
+        ("read", _READ), ("write", _WRITE), ("statfs", _VOIDP),
+        ("flush", _VOIDP), ("release", _VOIDP), ("fsync", _VOIDP),
+        ("setxattr", _VOIDP), ("getxattr", _VOIDP), ("listxattr", _VOIDP),
+        ("removexattr", _VOIDP), ("opendir", _VOIDP), ("readdir", _READDIR),
+        ("releasedir", _VOIDP), ("fsyncdir", _VOIDP), ("init", _VOIDP),
+        ("destroy", _VOIDP), ("access", _VOIDP), ("create", _CREATE),
+        ("ftruncate", _VOIDP), ("fgetattr", _VOIDP), ("lock", _VOIDP),
+        ("utimens", _UTIMENS), ("bmap", _VOIDP),
+        ("flags", C.c_uint),
+        ("ioctl", _VOIDP), ("poll", _VOIDP), ("write_buf", _VOIDP),
+        ("read_buf", _VOIDP), ("flock", _VOIDP), ("fallocate", _VOIDP),
+    ]
+
+
+def _errno_of(e: BaseException) -> int:
+    if isinstance(e, FileNotFoundError):
+        return -errno.ENOENT
+    if isinstance(e, FileExistsError):
+        return -errno.EEXIST
+    if isinstance(e, IsADirectoryError):
+        return -errno.EISDIR
+    if isinstance(e, NotADirectoryError):
+        return -errno.ENOTDIR
+    if isinstance(e, PermissionError):
+        return -errno.EACCES
+    if isinstance(e, OSError) and e.errno:
+        return -e.errno
+    return -errno.EIO
+
+
+def _guard(fn):
+    def wrapper(*args):
+        try:
+            return fn(*args)
+        except BaseException as e:       # noqa: BLE001 — C boundary
+            if not isinstance(e, (OSError, ValueError)):
+                L.exception("fuse op %s crashed", fn.__name__)
+            return _errno_of(e)
+    return wrapper
+
+
+class FuseMount:
+    """Mount a MutableFS at ``mountpoint`` via kernel FUSE."""
+
+    def __init__(self, fs: MutableFS, mountpoint: str):
+        self.fs = fs
+        self.mountpoint = os.path.abspath(mountpoint)
+        self._thread: Optional[threading.Thread] = None
+        self._ops = self._make_ops()     # keep callbacks referenced!
+
+    # -- op implementations -------------------------------------------------
+    def _fill_stat(self, st: _Stat, e) -> None:
+        C.memset(C.byref(st), 0, C.sizeof(_Stat))
+        kind_bits = {KIND_DIR: statmod.S_IFDIR, KIND_FILE: statmod.S_IFREG,
+                     KIND_SYMLINK: statmod.S_IFLNK}.get(e.kind,
+                                                        statmod.S_IFREG)
+        st.st_mode = kind_bits | (e.mode & 0o7777)
+        st.st_nlink = 2 if e.kind == KIND_DIR else 1
+        st.st_uid, st.st_gid = e.uid, e.gid
+        st.st_size = len(e.link_target) if e.kind == KIND_SYMLINK else e.size
+        st.st_blksize = 4096
+        st.st_blocks = (e.size + 511) // 512
+        sec, nsec = divmod(e.mtime_ns, 1_000_000_000)
+        for field in (st.st_atim, st.st_mtim, st.st_ctim):
+            field.tv_sec, field.tv_nsec = sec, nsec
+
+    def _make_ops(self) -> _FuseOps:
+        fs = self.fs
+
+        @_guard
+        def op_getattr(path: bytes, stbuf):
+            e = fs.getattr(path.decode())
+            self._fill_stat(stbuf.contents, e)
+            return 0
+
+        @_guard
+        def op_readdir(path: bytes, buf, filler, offset, fi):
+            filler(buf, b".", None, 0)
+            filler(buf, b"..", None, 0)
+            for e in fs.readdir(path.decode()):
+                filler(buf, e.name.encode(), None, 0)
+            return 0
+
+        @_guard
+        def op_read(path: bytes, buf, size, offset, fi):
+            data = fs.read(path.decode(), offset, size)
+            C.memmove(buf, data, len(data))
+            return len(data)
+
+        @_guard
+        def op_write(path: bytes, buf, size, offset, fi):
+            data = C.string_at(buf, size)
+            return fs.write(path.decode(), data, offset)
+
+        @_guard
+        def op_open(path: bytes, fi):
+            fs.getattr(path.decode())
+            return 0
+
+        @_guard
+        def op_create(path: bytes, mode, fi):
+            fs.create(path.decode(), mode & 0o7777)
+            return 0
+
+        @_guard
+        def op_mkdir(path: bytes, mode):
+            fs.mkdir(path.decode(), mode & 0o7777)
+            return 0
+
+        @_guard
+        def op_unlink(path: bytes):
+            fs.unlink(path.decode())
+            return 0
+
+        @_guard
+        def op_rmdir(path: bytes):
+            fs.rmdir(path.decode())
+            return 0
+
+        @_guard
+        def op_rename(src: bytes, dst: bytes):
+            fs.rename(src.decode(), dst.decode())
+            return 0
+
+        @_guard
+        def op_symlink(target: bytes, path: bytes):
+            fs.symlink(path.decode(), target.decode())
+            return 0
+
+        @_guard
+        def op_readlink(path: bytes, buf, size):
+            t = fs.readlink(path.decode()).encode()[:size - 1]
+            C.memmove(buf, t + b"\0", len(t) + 1)
+            return 0
+
+        @_guard
+        def op_truncate(path: bytes, length):
+            fs.truncate(path.decode(), length)
+            return 0
+
+        @_guard
+        def op_chmod(path: bytes, mode):
+            fs.chmod(path.decode(), mode & 0o7777)
+            return 0
+
+        @_guard
+        def op_chown(path: bytes, uid, gid):
+            fs.chown(path.decode(), uid, gid)
+            return 0
+
+        @_guard
+        def op_utimens(path: bytes, times):
+            if times:
+                mt = times[1]
+                fs.utimens(path.decode(),
+                           mt.tv_sec * 1_000_000_000 + mt.tv_nsec)
+            return 0
+
+        ops = _FuseOps()
+        ops.getattr = _GETATTR(op_getattr)
+        ops.readdir = _READDIR(op_readdir)
+        ops.read = _READ(op_read)
+        ops.write = _WRITE(op_write)
+        ops.open = _OPEN(op_open)
+        ops.create = _CREATE(op_create)
+        ops.mkdir = _MKDIR(op_mkdir)
+        ops.unlink = _UNLINK(op_unlink)
+        ops.rmdir = _RMDIR(op_rmdir)
+        ops.rename = _RENAME(op_rename)
+        ops.symlink = _SYMLINK(op_symlink)
+        ops.readlink = _READLINK(op_readlink)
+        ops.truncate = _TRUNCATE(op_truncate)
+        ops.chmod = _CHMOD(op_chmod)
+        ops.chown = _CHOWN(op_chown)
+        ops.utimens = _UTIMENS(op_utimens)
+        return ops
+
+    # -- lifecycle ----------------------------------------------------------
+    def mount(self, *, allow_other: bool = False) -> None:
+        lib = _load_libfuse()
+        os.makedirs(self.mountpoint, exist_ok=True)
+        args = [b"pbs-plus-tpu", b"-f", b"-s", self.mountpoint.encode()]
+        if allow_other:
+            args += [b"-o", b"allow_other"]
+        argv = (C.c_char_p * len(args))(*args)
+
+        def run():
+            rc = lib.fuse_main_real(len(args), argv, C.byref(self._ops),
+                                    C.sizeof(self._ops), None)
+            if rc != 0:
+                L.error("fuse_main exited with %d", rc)
+
+        self._thread = threading.Thread(target=run, name="fuse-main",
+                                        daemon=True)
+        self._thread.start()
+        # wait for the kernel mount to appear
+        import time
+        for _ in range(100):
+            if os.path.ismount(self.mountpoint):
+                return
+            if not self._thread.is_alive():
+                raise RuntimeError("fuse_main exited during mount")
+            time.sleep(0.05)
+        raise TimeoutError("FUSE mount did not appear")
+
+    def unmount(self, *, timeout: float = 10.0) -> None:
+        import subprocess
+        subprocess.run(["fusermount", "-u", "-z", self.mountpoint],
+                       capture_output=True, timeout=timeout)
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None

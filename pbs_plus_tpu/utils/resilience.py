@@ -1,0 +1,92 @@
+"""Resilience helpers: circuit breaker + retry with backoff.
+
+Reference: internal/server/resilience.go:17-109 (CircuitBreaker, WithRetry)
+and the agent's exponential backoff discipline (SURVEY §5.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Awaitable, Callable, TypeVar
+
+from .log import L
+
+T = TypeVar("T")
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+
+class CircuitOpenError(RuntimeError):
+    pass
+
+
+class CircuitBreaker:
+    """Trips after ``failure_threshold`` consecutive failures; half-opens
+    after ``reset_timeout_s`` to probe with a single call."""
+
+    def __init__(self, *, failure_threshold: int = 5,
+                 reset_timeout_s: float = 30.0, name: str = ""):
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self.name = name
+        self._failures = 0
+        self._state = CLOSED
+        self._opened_at = 0.0
+
+    @property
+    def state(self) -> str:
+        if self._state == OPEN and \
+                time.monotonic() - self._opened_at >= self.reset_timeout_s:
+            return HALF_OPEN
+        return self._state
+
+    def _record_success(self) -> None:
+        self._failures = 0
+        self._state = CLOSED
+
+    def _record_failure(self) -> None:
+        self._failures += 1
+        if self._failures >= self.failure_threshold or self.state == HALF_OPEN:
+            self._state = OPEN
+            self._opened_at = time.monotonic()
+            L.warning("circuit %s opened after %d failures",
+                      self.name or "?", self._failures)
+
+    async def call(self, fn: Callable[[], Awaitable[T]]) -> T:
+        st = self.state
+        if st == OPEN:
+            raise CircuitOpenError(
+                f"circuit {self.name or '?'} open "
+                f"({self._failures} consecutive failures)")
+        try:
+            out = await fn()
+        except Exception:
+            self._record_failure()
+            raise
+        self._record_success()
+        return out
+
+
+async def with_retry(fn: Callable[[], Awaitable[T]], *, attempts: int = 3,
+                     base_delay_s: float = 0.5, max_delay_s: float = 30.0,
+                     jitter: float = 0.2,
+                     retry_on: tuple[type[BaseException], ...] = (Exception,),
+                     ) -> T:
+    """Exponential backoff with jitter (reference: WithRetry; the agent's
+    500ms→30s ×2 ±20% discipline)."""
+    delay = base_delay_s
+    last: BaseException | None = None
+    for attempt in range(attempts):
+        try:
+            return await fn()
+        except retry_on as e:
+            last = e
+            if attempt == attempts - 1:
+                break
+            sleep = min(delay, max_delay_s) * (1 + random.uniform(-jitter, jitter))
+            await asyncio.sleep(max(0.0, sleep))
+            delay *= 2
+    assert last is not None
+    raise last

@@ -1,0 +1,97 @@
+"""Aux subsystem tests: resilience, memlimit, agent registry."""
+
+import asyncio
+import os
+
+import pytest
+
+from pbs_plus_tpu.agent.registry import Registry, normalize_pem
+from pbs_plus_tpu.utils import memlimit
+from pbs_plus_tpu.utils.resilience import (
+    CircuitBreaker, CircuitOpenError, with_retry,
+)
+
+
+def test_circuit_breaker_trips_and_recovers():
+    async def main():
+        cb = CircuitBreaker(failure_threshold=3, reset_timeout_s=0.2)
+        calls = {"n": 0}
+
+        async def boom():
+            calls["n"] += 1
+            raise IOError("down")
+
+        for _ in range(3):
+            with pytest.raises(IOError):
+                await cb.call(boom)
+        assert cb.state == "open"
+        with pytest.raises(CircuitOpenError):
+            await cb.call(boom)
+        assert calls["n"] == 3                  # open circuit short-circuits
+        await asyncio.sleep(0.25)
+        assert cb.state == "half-open"
+
+        async def ok():
+            return 42
+        assert await cb.call(ok) == 42
+        assert cb.state == "closed"
+    asyncio.run(main())
+
+
+def test_with_retry_backoff():
+    async def main():
+        attempts = {"n": 0}
+
+        async def flaky():
+            attempts["n"] += 1
+            if attempts["n"] < 3:
+                raise ConnectionError("flap")
+            return "ok"
+
+        out = await with_retry(flaky, attempts=5, base_delay_s=0.01)
+        assert out == "ok" and attempts["n"] == 3
+
+        async def always():
+            raise ValueError("never")
+        with pytest.raises(ValueError):
+            await with_retry(always, attempts=2, base_delay_s=0.01)
+    asyncio.run(main())
+
+
+def test_memlimit_effective():
+    limit = memlimit.effective_limit()
+    assert 0 < limit < (1 << 50)
+    total = memlimit._system_total()
+    assert limit <= total
+
+
+def test_registry_secrets_and_seed(tmp_path):
+    reg = Registry(str(tmp_path / "agent" / "config.json"))
+    reg.set("server_url", "https://pbs:8017")
+    reg.set_secret("bootstrap_secret", b"s3cr3t")
+    assert reg.get("server_url") == "https://pbs:8017"
+    assert reg.get_secret("bootstrap_secret") == b"s3cr3t"
+    # secrets unreadable via plain get; sealed on disk
+    with pytest.raises(ValueError):
+        reg.get("bootstrap_secret")
+    raw = open(tmp_path / "agent" / "config.json").read()
+    assert "s3cr3t" not in raw and "sealed:" in raw
+    # reopen with the same key file: still unsealable
+    reg2 = Registry(str(tmp_path / "agent" / "config.json"))
+    assert reg2.get_secret("bootstrap_secret") == b"s3cr3t"
+    # env seeding never overwrites
+    n = reg2.seed_from_env(environ={
+        "PBS_PLUS_INIT_SERVER_URL": "https://other:1",
+        "PBS_PLUS_INIT_API_SECRET": "tok",
+        "IRRELEVANT": "x"})
+    assert n == 1
+    assert reg2.get("server_url") == "https://pbs:8017"   # kept
+    assert reg2.get_secret("api_secret") == b"tok"
+    reg2.delete("server_url")
+    assert reg2.get("server_url") is None
+
+
+def test_normalize_pem():
+    a = "-----BEGIN X-----\nAAA\nBBB\n-----END X-----\n"
+    b = "  -----BEGIN X-----  \r\n\n AAA \nBBB\n-----END X-----"
+    assert normalize_pem(a) == normalize_pem(b)
