@@ -26,18 +26,32 @@ class Registry:
     def __init__(self, path: str, *, key_path: str | None = None):
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lock_path = path + ".lock"
         self._key = crypto.load_or_create_key(
             key_path or os.path.join(os.path.dirname(path), "registry.key"))
 
-    # -- raw io with flock -------------------------------------------------
+    # -- inter-process locking --------------------------------------------
+    # a dedicated lockfile guards the whole read-modify-write cycle, so
+    # concurrent daemon/CLI writers never lose updates (the reference's
+    # flock discipline, registry_unix.go)
+    def _locked(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            fd = os.open(self._lock_path, os.O_CREAT | os.O_RDWR, 0o600)
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX)
+                yield
+            finally:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+                os.close(fd)
+        return cm()
+
     def _load(self) -> dict[str, Any]:
         try:
             with open(self.path) as f:
-                fcntl.flock(f, fcntl.LOCK_SH)
-                try:
-                    return json.load(f)
-                finally:
-                    fcntl.flock(f, fcntl.LOCK_UN)
+                return json.load(f)
         except FileNotFoundError:
             return {}
         except json.JSONDecodeError:
@@ -46,33 +60,35 @@ class Registry:
     def _store(self, data: dict[str, Any]) -> None:
         tmp = f"{self.path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            fcntl.flock(f, fcntl.LOCK_EX)
             json.dump(data, f, indent=1, sort_keys=True)
             f.flush()
             os.fsync(f.fileno())
-            fcntl.flock(f, fcntl.LOCK_UN)
         os.replace(tmp, self.path)
 
     # -- typed access ------------------------------------------------------
     def get(self, key: str, default: Any = None) -> Any:
-        v = self._load().get(key, default)
+        with self._locked():
+            v = self._load().get(key, default)
         if isinstance(v, str) and v.startswith(SECRET_PREFIX):
             raise ValueError(f"{key} is a secret; use get_secret")
         return v
 
     def set(self, key: str, value: Any) -> None:
-        d = self._load()
-        d[key] = value
-        self._store(d)
+        with self._locked():
+            d = self._load()
+            d[key] = value
+            self._store(d)
 
     def set_secret(self, key: str, value: bytes) -> None:
         sealed = crypto.seal(self._key, value, aad=key.encode())
-        d = self._load()
-        d[key] = SECRET_PREFIX + sealed.hex()
-        self._store(d)
+        with self._locked():
+            d = self._load()
+            d[key] = SECRET_PREFIX + sealed.hex()
+            self._store(d)
 
     def get_secret(self, key: str) -> Optional[bytes]:
-        v = self._load().get(key)
+        with self._locked():
+            v = self._load().get(key)
         if v is None:
             return None
         if not (isinstance(v, str) and v.startswith(SECRET_PREFIX)):
@@ -81,16 +97,22 @@ class Registry:
                              aad=key.encode())
 
     def delete(self, key: str) -> None:
-        d = self._load()
-        if d.pop(key, None) is not None:
-            self._store(d)
+        with self._locked():
+            d = self._load()
+            if d.pop(key, None) is not None:
+                self._store(d)
 
     def keys(self) -> list[str]:
-        return sorted(self._load())
+        with self._locked():
+            return sorted(self._load())
 
     # -- env seeding (reference: PBS_PLUS_INIT_* at first start) ----------
     def seed_from_env(self, *, environ: dict[str, str] | None = None) -> int:
         env = environ if environ is not None else dict(os.environ)
+        with self._locked():
+            return self._seed_locked(env)
+
+    def _seed_locked(self, env: dict[str, str]) -> int:
         d = self._load()
         n = 0
         for k, v in env.items():

@@ -52,6 +52,7 @@ class BackupSession:
             previous=self._prev_reader,
             payload_params=store.params,
             chunker_factory=chunker_factory,
+            batch_hasher=store.batch_hasher,
         )
         self._final_dir = store.datastore.snapshot_dir(ref)
         # unique staging dir: concurrent same-second sessions must never
@@ -118,10 +119,12 @@ class LocalStore:
     backupproxy.NewLocalStore)."""
 
     def __init__(self, base_dir: str, params: ChunkerParams, *,
-                 chunker_factory: ChunkerFactory = _default_chunker_factory):
+                 chunker_factory: ChunkerFactory = _default_chunker_factory,
+                 batch_hasher=None):
         self.datastore = Datastore(base_dir)
         self.params = params
         self._chunker_factory = chunker_factory
+        self.batch_hasher = batch_hasher
 
     def start_session(self, *, backup_type: str, backup_id: str,
                       backup_time: float | None = None,

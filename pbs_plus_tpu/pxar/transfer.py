@@ -59,13 +59,23 @@ class WriterStats:
             setattr(self, f, getattr(self, f) + getattr(other, f))
 
 
+BatchHasher = Callable[[list[bytes]], list[bytes]]
+_HASH_BATCH_BYTES = 64 << 20
+_HASH_BATCH_COUNT = 512
+
+
 class _ChunkedStream:
     """CDC-chunked stream writer over a ChunkStore: ``write`` feeds the
     chunker, ``append_ref`` splices an existing chunk, ``finish`` returns
-    the DynamicIndex records."""
+    the DynamicIndex records.
+
+    ``batch_hasher`` (e.g. ops.sha256.sha256_chunks) defers digests so
+    many chunks hash in one device dispatch — the TPU fingerprint path;
+    None = per-chunk hashlib (CPU default)."""
 
     def __init__(self, store: ChunkStore, params: ChunkerParams,
-                 chunker_factory: ChunkerFactory = _default_chunker_factory):
+                 chunker_factory: ChunkerFactory = _default_chunker_factory,
+                 batch_hasher: BatchHasher | None = None):
         self.store = store
         self.params = params
         self._factory = chunker_factory
@@ -76,6 +86,9 @@ class _ChunkedStream:
         self.offset = 0             # total stream bytes accepted
         self.records: list[tuple[int, bytes]] = []   # (end_offset, digest)
         self.stats = WriterStats()
+        self._hasher = batch_hasher
+        self._pending: list[tuple[int, bytes]] = []  # (record idx, chunk)
+        self._pending_bytes = 0
 
     def write(self, data: bytes) -> None:
         if not data:
@@ -97,12 +110,35 @@ class _ChunkedStream:
         chunk = bytes(self._buf[:n])
         del self._buf[:n]
         self._buf_base = end
-        digest = hashlib.sha256(chunk).digest()
+        if self._hasher is None:
+            digest = hashlib.sha256(chunk).digest()
+            self._insert(digest, chunk)
+            self.records.append((end, digest))
+        else:
+            self.records.append((end, b""))
+            self._pending.append((len(self.records) - 1, chunk))
+            self._pending_bytes += len(chunk)
+            if (self._pending_bytes >= _HASH_BATCH_BYTES
+                    or len(self._pending) >= _HASH_BATCH_COUNT):
+                self._flush_hashes()
+
+    def _insert(self, digest: bytes, chunk: bytes) -> None:
         if self.store.insert(digest, chunk, verify=False):
             self.stats.new_chunks += 1
         else:
             self.stats.known_chunks += 1
-        self.records.append((end, digest))
+
+    def _flush_hashes(self) -> None:
+        if not self._pending:
+            return
+        assert self._hasher is not None
+        digests = self._hasher([c for _, c in self._pending])
+        for (idx, chunk), digest in zip(self._pending, digests):
+            end, _ = self.records[idx]
+            self.records[idx] = (end, digest)
+            self._insert(digest, chunk)
+        self._pending.clear()
+        self._pending_bytes = 0
 
     def flush_chunker(self) -> None:
         """Force a cut at the current offset and restart the chunker."""
@@ -130,6 +166,7 @@ class _ChunkedStream:
     def finish(self) -> list[tuple[int, bytes]]:
         if self._buf:
             self.flush_chunker()
+        self._flush_hashes()
         return self.records
 
 
@@ -142,13 +179,15 @@ class SessionWriter:
     def __init__(self, store: ChunkStore, *,
                  payload_params: ChunkerParams,
                  meta_params: ChunkerParams | None = None,
-                 chunker_factory: ChunkerFactory = _default_chunker_factory):
+                 chunker_factory: ChunkerFactory = _default_chunker_factory,
+                 batch_hasher: BatchHasher | None = None):
         self.store = store
         self.payload_params = payload_params
         self.meta_params = meta_params or ChunkerParams(
             avg_size=max(1024, min(payload_params.avg_size, 128 << 10)))
         self.meta = _ChunkedStream(store, self.meta_params, chunker_factory)
-        self.payload = _ChunkedStream(store, payload_params, chunker_factory)
+        self.payload = _ChunkedStream(store, payload_params, chunker_factory,
+                                      batch_hasher=batch_hasher)
         self._last_path: str | None = None
         self._entries = 0
         self._finished = False
@@ -236,9 +275,12 @@ class DedupWriter(SessionWriter):
     def __init__(self, store: ChunkStore, *, previous: "SplitReader | None",
                  payload_params: ChunkerParams,
                  meta_params: ChunkerParams | None = None,
-                 chunker_factory: ChunkerFactory = _default_chunker_factory):
+                 chunker_factory: ChunkerFactory = _default_chunker_factory,
+                 batch_hasher: BatchHasher | None = None):
         super().__init__(store, payload_params=payload_params,
-                         meta_params=meta_params, chunker_factory=chunker_factory)
+                         meta_params=meta_params,
+                         chunker_factory=chunker_factory,
+                         batch_hasher=batch_hasher)
         self.previous = previous
         # pending coalesced old-payload range [A, B) and the new-stream
         # offset N0 where it will land

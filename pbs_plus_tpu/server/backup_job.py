@@ -56,6 +56,16 @@ def validate_chunker_kind(kind: str) -> None:
                      "(want cpu | tpu | sidecar:<host:port>)")
 
 
+def make_batch_hasher(kind: str):
+    """Batched digest backend matching the chunker backend: the tpu path
+    hashes emitted chunks in device batches (ops/sha256); cpu/sidecar use
+    the writer's inline hashlib path."""
+    if kind == "tpu":
+        from ..ops.sha256 import sha256_chunks
+        return sha256_chunks
+    return None
+
+
 def make_chunker_factory(kind: str):
     """The one-line config change (BASELINE.json):
     chunker = cpu | tpu | sidecar:<host:port>."""

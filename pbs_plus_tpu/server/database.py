@@ -446,6 +446,11 @@ class Database:
                 "SELECT * FROM task_log WHERE upid=?", (upid,)).fetchone()
         return dict(r) if r else None
 
+    def list_running_tasks(self) -> list[dict]:
+        with self._lock:
+            return [dict(r) for r in self._conn.execute(
+                "SELECT * FROM task_log WHERE status=?", (STATUS_RUNNING,))]
+
     def list_tasks(self, *, job_id: str | None = None,
                    limit: int = 100) -> list[dict]:
         q = "SELECT * FROM task_log"

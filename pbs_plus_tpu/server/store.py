@@ -23,15 +23,17 @@ from ..utils import conf, crypto
 from ..utils.log import L
 from ..utils.mtls import CertManager
 from . import database
-from .backup_job import make_chunker_factory, run_backup_job
+from .backup_job import (make_batch_hasher, make_chunker_factory,
+                         run_backup_job)
 from .jobs import Job, JobsManager
 from .scheduler import Scheduler
 
 
 def make_upid(kind: str, job_id: str) -> str:
-    """PBS-style unique process id for task logs (reference:
-    internal/proxmox/upid.go:23-141 — same capability, our own format)."""
-    return f"UPID:pbs-plus-tpu:{int(time.time()):08X}:{uuid.uuid4().hex[:8]}:{kind}:{job_id}"
+    """PBS-compatible unique process id for task logs (proxmox/upid.py —
+    reference: internal/proxmox/upid.go:23-141)."""
+    from ..proxmox import new_upid
+    return str(new_upid(kind, job_id))
 
 
 @dataclass
@@ -67,7 +69,8 @@ class Server:
         params = ChunkerParams(avg_size=config.chunk_avg)
         self.datastore = LocalStore(
             config.datastore_dir, params,
-            chunker_factory=make_chunker_factory(config.chunker))
+            chunker_factory=make_chunker_factory(config.chunker),
+            batch_hasher=make_batch_hasher(config.chunker))
         self.scheduler = Scheduler(
             self.db, self.jobs,
             enqueue_backup=self._enqueue_backup_row,
@@ -136,9 +139,23 @@ class Server:
         return port
 
     async def start(self) -> None:
+        self._cleanup_orphaned_tasks()
         port = await self.start_arpc()
         self.config.arpc_port = port
         self._tasks.append(asyncio.create_task(self.scheduler.run()))
+
+    def _cleanup_orphaned_tasks(self) -> None:
+        """Tasks still 'running' at startup died with the previous process —
+        convert them to error tasks (reference: cleanupQueuedBackups,
+        internal/server/bootstrap.go:136-171)."""
+        n = 0
+        for t in self.db.list_running_tasks():
+            self.db.append_task_log(
+                t["upid"], "error: interrupted by server restart")
+            self.db.finish_task(t["upid"], database.STATUS_ERROR)
+            n += 1
+        if n:
+            self.log.warning("converted %d orphaned tasks to errors", n)
 
     async def stop(self) -> None:
         self.scheduler.stop()
@@ -211,7 +228,8 @@ class Server:
             store = LocalStore(
                 self.config.datastore_dir,
                 ChunkerParams(avg_size=self.config.chunk_avg),
-                chunker_factory=make_chunker_factory(row.chunker))
+                chunker_factory=make_chunker_factory(row.chunker),
+                batch_hasher=make_batch_hasher(row.chunker))
 
         async def execute():
             async with self.jobs.startup_mu:   # serialize session startups

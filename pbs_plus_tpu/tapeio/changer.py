@@ -100,5 +100,9 @@ class MediaChanger:
         if s is None:
             raise ChangerError(f"no medium with tag {volume_tag!r}")
         if s.kind == "drive":
-            return                       # already loaded
+            if s.index == drive:
+                return                   # already loaded where requested
+            raise ChangerError(
+                f"medium {volume_tag!r} is loaded in drive {s.index}, "
+                f"not drive {drive}; unload it first")
         self.load(s.index, drive)

@@ -47,6 +47,22 @@ def convert_mtf_to_snapshot(fp: BinaryIO, session, *,
     res.entries += 1
     emitted_dirs: set[str] = set()
 
+    # Collect + sort first: tape media order follows the original backup
+    # tool's traversal, but the archive writer requires strict DFS order.
+    # Content reads are ranged into the media file/BKF image (random access
+    # is fine there; a streaming physical tape would spool to disk first).
+    collected: list[MTFEntry] = []
+    entry_iter = reader.entries()
+    while True:
+        try:
+            collected.append(next(entry_iter))
+        except StopIteration:
+            break
+        except Exception as e:
+            res.errors.append(f"media: {e}")
+            break
+    collected.sort(key=lambda e: tuple(e.path.split("/")))
+
     def ensure_dirs(path: str) -> None:
         parts = path.split("/")[:-1]
         for i in range(1, len(parts) + 1):
@@ -56,17 +72,7 @@ def convert_mtf_to_snapshot(fp: BinaryIO, session, *,
                 w.write_entry(Entry(path=d, kind=KIND_DIR, mode=0o755))
                 res.entries += 1
 
-    entry_iter = reader.entries()
-    while True:
-        try:
-            entry = next(entry_iter)
-        except StopIteration:
-            break
-        except Exception as e:
-            # truncated/garbled media: keep everything converted so far,
-            # surface the failure (the reference errors the tape job)
-            res.errors.append(f"media: {e}")
-            break
+    for entry in collected:
         if entry.kind == "dir":
             ensure_dirs(entry.path + "/x")   # emits entry.path + parents once
             continue
@@ -92,16 +98,20 @@ def convert_mtf_to_snapshot(fp: BinaryIO, session, *,
 
         t = threading.Thread(target=pump, daemon=True)
         t.start()
+        ok = True
         try:
             w.write_entry_reader(
                 Entry(path=entry.path, kind=KIND_FILE, mode=0o644),
                 SpoolReader(spool))
         except BaseException as e:
+            ok = False
             res.errors.append(f"{entry.path}: {e}")
         t.join()
-        res.entries += 1
-        res.files += 1
-        res.bytes += entry.size
+        spool.cleanup()
+        if ok:
+            res.entries += 1
+            res.files += 1
+            res.bytes += entry.size
         if progress is not None:
             dt = max(time.time() - t0, 1e-6)
             progress({"files": res.files, "bytes": res.bytes,

@@ -39,7 +39,7 @@ class Spool:
         self._block = block
         self._cv = threading.Condition()
         self._spill_dir = spill_dir
-        self._spill_seq = 0
+        self._disk_paths: set[str] = set()
         self._closed = False
         self.stats = {"bytes": 0, "spilled": 0}
 
@@ -62,10 +62,10 @@ class Spool:
     def _spill(self, chunk: bytes) -> None:
         d = self._spill_dir or tempfile.gettempdir()
         os.makedirs(d, exist_ok=True)
-        self._spill_seq += 1
-        p = os.path.join(d, f"spool-{os.getpid()}-{self._spill_seq:06d}.blk")
-        with open(p, "wb") as f:
+        fd, p = tempfile.mkstemp(prefix="pbs-spool-", suffix=".blk", dir=d)
+        with os.fdopen(fd, "wb") as f:
             f.write(chunk)
+        self._disk_paths.add(p)
         self._q.put(_Item(disk_path=p))
         self.stats["bytes"] += len(chunk)
         self.stats["spilled"] += len(chunk)
@@ -77,6 +77,15 @@ class Spool:
         if not self._closed:
             self._closed = True
             self._q.put(_Item(eof=True))
+
+    def cleanup(self) -> None:
+        """Remove spill files the consumer never read (abandoned stream)."""
+        for p in list(self._disk_paths):
+            self._disk_paths.discard(p)
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
     # -- consumer ----------------------------------------------------------
     def blocks(self) -> Iterator[bytes]:
@@ -91,6 +100,7 @@ class Spool:
                     with open(item.disk_path, "rb") as f:
                         yield f.read()
                 finally:
+                    self._disk_paths.discard(item.disk_path)
                     try:
                         os.unlink(item.disk_path)
                     except OSError:

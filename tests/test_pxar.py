@@ -269,6 +269,32 @@ def test_abort_leaves_no_snapshot(tmp_path, tree):
         s.finish()
 
 
+def test_batched_hasher_archives_identical(tmp_path, tree):
+    """batch_hasher (the TPU digest path, here the device-batched sha256 on
+    the CPU backend) yields byte-identical archives to inline hashlib."""
+    from pbs_plus_tpu.ops.sha256 import sha256_chunks
+
+    s_def = LocalStore(str(tmp_path / "a"), P)
+    s1 = s_def.start_session(backup_type="host", backup_id="x")
+    backup_tree(s1, tree)
+    m1 = s1.finish()
+
+    s_bat = LocalStore(str(tmp_path / "b"), P, batch_hasher=sha256_chunks)
+    s2 = s_bat.start_session(backup_type="host", backup_id="x")
+    backup_tree(s2, tree)
+    m2 = s2.finish()
+
+    assert m1["payload_chunks"] == m2["payload_chunks"]
+    assert m1["payload_size"] == m2["payload_size"]
+    r1, r2 = s_def.open_snapshot(s1.ref), s_bat.open_snapshot(s2.ref)
+    recs1 = list(r1.payload_index.records())
+    recs2 = list(r2.payload_index.records())
+    assert recs1 == recs2                      # same cuts, same digests
+    for e in r2.entries():
+        if e.is_file and e.size:
+            assert r2.read_file(e) == r1.read_file(r1.lookup(e.path))
+
+
 def test_gc_sweep_preserves_live_chunks(tmp_path, tree):
     import time
     store = LocalStore(str(tmp_path / "ds"), P)
