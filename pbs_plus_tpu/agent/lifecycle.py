@@ -73,6 +73,13 @@ class AgentLifecycle:
         r.handle("cleanup_restore", self._cleanup)
         r.handle("filetree", self._filetree)
         r.handle("verify_start", self._verify_start)
+        r.handle("drives", self._drives)
+
+    async def _drives(self, req, ctx):
+        from .drives import enumerate_drives
+        ds = await asyncio.get_running_loop().run_in_executor(
+            None, enumerate_drives)
+        return {"drives": ds}
 
     async def _ping(self, req, ctx):
         return {"pong": True, "hostname": self.config.hostname}

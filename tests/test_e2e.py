@@ -160,6 +160,47 @@ def test_backup_restore_roundtrip(env, tmp_path):
     asyncio.run(main())
 
 
+def test_drives_and_snapshot_mount_api(env, tmp_path):
+    """Drives over the control plane + the snapshot mount service
+    (reference: api/mount_handlers + drive updates)."""
+    async def main():
+        server, agent, agent_task = await env()
+        from pbs_plus_tpu.arpc import Session
+        sess = server.agents.get("agent-e2e")
+        drives = (await Session(sess.conn).call("drives", {})).data["drives"]
+        assert drives and all("mountpoint" in d for d in drives)
+        assert any(d["mountpoint"] == "/" for d in drives)
+
+        # make a snapshot to mount
+        src = tmp_path / "src3"
+        src.mkdir()
+        (src / "f.txt").write_text("mounted content")
+        server.db.upsert_backup_job(database.BackupJobRow(
+            id="m1", target="agent-e2e", source_path=str(src)))
+        server.enqueue_backup("m1")
+        await server.jobs.wait("backup:m1", timeout=60)
+        snap = server.db.get_backup_job("m1").last_snapshot
+
+        from pbs_plus_tpu.server.mount_service import MountService
+        ms = MountService(server)
+        fuse_ok = os.path.exists("/dev/fuse")
+        m = await ms.mount(snap, fuse=fuse_ok)
+        try:
+            assert ms.list()[0]["alive"]
+            if fuse_ok:
+                assert open(os.path.join(m.mountpoint, "f.txt")).read() == \
+                    "mounted content"
+        finally:
+            assert await ms.unmount(m.mount_id)
+        assert ms.list() == []
+        if fuse_ok:
+            assert not os.path.ismount(m.mountpoint)
+        await agent.stop()
+        agent_task.cancel()
+        await server.stop()
+    asyncio.run(main())
+
+
 def test_backup_fails_cleanly_when_agent_offline(env, tmp_path):
     async def main():
         server, agent, agent_task = await env()
