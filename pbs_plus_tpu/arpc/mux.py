@@ -274,16 +274,18 @@ class MuxConnection:
             st._on_rst()
         self._streams.clear()
         await self._accept_q.put(None)
+        # stop companion loops promptly (a dead conn must not keep its
+        # keepalive task alive for up to a full interval — leak discipline)
+        for t in self._tasks:
+            if t is not asyncio.current_task():
+                t.cancel()
         try:
             self.writer.close()
         except Exception:
             pass
 
     async def close(self) -> None:
-        await self._shutdown("closed locally")
-        for t in self._tasks:
-            if t is not asyncio.current_task():
-                t.cancel()
+        await self._shutdown("closed locally")   # cancels companion tasks
         for t in self._tasks:
             if t is not asyncio.current_task():
                 try:

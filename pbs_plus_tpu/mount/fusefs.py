@@ -287,9 +287,24 @@ class FuseMount:
         raise TimeoutError("FUSE mount did not appear")
 
     def unmount(self, *, timeout: float = 10.0) -> None:
-        import subprocess
-        subprocess.run(["fusermount", "-u", "-z", self.mountpoint],
-                       capture_output=True, timeout=timeout)
+        lazy_unmount(self.mountpoint, timeout=timeout)
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
+
+
+def lazy_unmount(mountpoint: str, *, timeout: float = 10.0) -> bool:
+    """Best-effort lazy unmount via fusermount/fusermount3/umount -l.
+    Returns True when the mountpoint is no longer a mount."""
+    import shutil as _sh
+    import subprocess as _sp
+    for tool, args in (("fusermount", ["-u", "-z"]),
+                       ("fusermount3", ["-u", "-z"]),
+                       ("umount", ["-l"])):
+        if _sh.which(tool) is None:
+            continue
+        _sp.run([tool, *args, mountpoint], capture_output=True,
+                timeout=timeout)
+        if not os.path.ismount(mountpoint):
+            return True
+    return not os.path.ismount(mountpoint)

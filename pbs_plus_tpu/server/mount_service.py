@@ -4,7 +4,8 @@ Reference: internal/server/web/api/mount_handlers.go:97-424 +
 internal/server/systemd_mount.go:15-105 — the UI's "mount snapshot"
 button starts a transient systemd unit running pxar-mount; unmount stops
 it.  Here each mount is a supervised ``python -m pbs_plus_tpu mount``
-subprocess (systemd-run is used when available for cgroup hygiene).
+subprocess; ``cleanup_stale_mounts`` reaps leftovers from a crashed
+server at startup (the reference's cleanupStaleMounts, bootstrap.go:68).
 """
 
 from __future__ import annotations
@@ -17,6 +18,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..mount.fusefs import lazy_unmount
 from ..utils.log import L
 
 
@@ -62,33 +64,28 @@ class MountService:
             stdout=asyncio.subprocess.DEVNULL,
             stderr=asyncio.subprocess.DEVNULL)
         m = ActiveMount(mid, snapshot, mountpoint, socket, proc)
+        # register BEFORE the readiness wait so unmount_all/stop can always
+        # reach an in-flight mount
+        self.mounts[mid] = m
         # ready = control socket present AND (if requested) the kernel
         # mount visible
         def ready() -> bool:
             if not os.path.exists(socket):
                 return False
             return (not fuse) or os.path.ismount(mountpoint)
-        for _ in range(150):
-            if ready():
-                break
-            if proc.returncode is not None:
-                raise RuntimeError(
-                    f"mount process exited early ({proc.returncode})")
-            await asyncio.sleep(0.1)
-        else:
-            proc.terminate()
-            try:
-                await asyncio.wait_for(proc.wait(), 10)
-            except asyncio.TimeoutError:
-                proc.kill()
-            if os.path.ismount(mountpoint) and shutil.which("fusermount"):
-                fz = await asyncio.create_subprocess_exec(
-                    "fusermount", "-u", "-z", mountpoint,
-                    stdout=asyncio.subprocess.DEVNULL,
-                    stderr=asyncio.subprocess.DEVNULL)
-                await fz.wait()
-            raise TimeoutError("mount did not become ready")
-        self.mounts[mid] = m
+        try:
+            for _ in range(150):
+                if ready():
+                    break
+                if proc.returncode is not None:
+                    raise RuntimeError(
+                        f"mount process exited early ({proc.returncode})")
+                await asyncio.sleep(0.1)
+            else:
+                raise TimeoutError("mount did not become ready")
+        except BaseException:
+            await self.unmount(mid)
+            raise
         L.info("snapshot %s mounted as %s", snapshot, mid)
         return m
 
@@ -103,17 +100,36 @@ class MountService:
             except asyncio.TimeoutError:
                 m.proc.kill()
         # belt-and-braces: lazy-unmount if the kernel mount lingers
-        if os.path.ismount(m.mountpoint) and shutil.which("fusermount"):
-            proc = await asyncio.create_subprocess_exec(
-                "fusermount", "-u", "-z", m.mountpoint,
-                stdout=asyncio.subprocess.DEVNULL,
-                stderr=asyncio.subprocess.DEVNULL)
-            await proc.wait()
+        if os.path.ismount(m.mountpoint):
+            ok = await asyncio.get_running_loop().run_in_executor(
+                None, lazy_unmount, m.mountpoint)
+            if not ok:
+                L.warning("mount %s still attached at %s after unmount "
+                          "attempts", m.mount_id, m.mountpoint)
         return True
 
     async def unmount_all(self) -> None:
         for mid in list(self.mounts):
             await self.unmount(mid)
+
+    def cleanup_stale_mounts(self) -> int:
+        """Reap mounts left by a crashed server (reference:
+        cleanupStaleMounts — umount -lf basepath/*)."""
+        n = 0
+        try:
+            entries = os.listdir(self.base)
+        except OSError:
+            return 0
+        for mid in entries:
+            mdir = os.path.join(self.base, mid)
+            mp = os.path.join(mdir, "mnt")
+            if os.path.ismount(mp):
+                lazy_unmount(mp)
+                n += 1
+            shutil.rmtree(mdir, ignore_errors=True)
+        if n:
+            L.warning("cleaned %d stale snapshot mounts", n)
+        return n
 
     def list(self) -> list[dict]:
         return [{"mount_id": m.mount_id, "snapshot": m.snapshot,

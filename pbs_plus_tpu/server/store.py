@@ -141,6 +141,8 @@ class Server:
 
     async def start(self) -> None:
         self._cleanup_orphaned_tasks()
+        from .mount_service import MountService
+        MountService(self).cleanup_stale_mounts()
         port = await self.start_arpc()
         self.config.arpc_port = port
         self._tasks.append(asyncio.create_task(self.scheduler.run()))

@@ -1,0 +1,116 @@
+"""Leak discipline (reference: the aRPC goroutine-leak suite TestLeak_*,
+internal/arpc/arpc_test.go:729-1186): after full lifecycle cycles, no
+asyncio tasks or threads survive."""
+
+import asyncio
+import threading
+
+import pytest
+
+from pbs_plus_tpu.agent.lifecycle import AgentConfig, AgentLifecycle
+from pbs_plus_tpu.arpc import Session, TlsClientConfig
+from pbs_plus_tpu.server import database
+from pbs_plus_tpu.server.store import Server, ServerConfig
+from pbs_plus_tpu.utils import mtls
+
+
+def test_no_task_or_thread_leaks_after_full_cycle(tmp_path):
+    """Server + agent + backup job + restore-ish traffic, then shutdown:
+    the loop must end with zero pending tasks; thread count returns to
+    baseline (executor workers are reused, not leaked per cycle)."""
+    threads_before = threading.active_count()
+    leftovers: list[str] = []
+
+    async def main():
+        cfg = ServerConfig(state_dir=str(tmp_path / "s"),
+                           cert_dir=str(tmp_path / "c"),
+                           datastore_dir=str(tmp_path / "d"),
+                           chunk_avg=1 << 16, max_concurrent=2)
+        server = Server(cfg)
+        await server.start()
+        tid, sec = server.issue_bootstrap_token()
+        key = mtls.generate_private_key()
+        cert = server.bootstrap_agent("leaky", mtls.make_csr(key, "leaky"),
+                                      tid, sec)
+        (tmp_path / "a.pem").write_bytes(cert)
+        (tmp_path / "a.key").write_bytes(mtls.key_pem(key))
+        agent = AgentLifecycle(AgentConfig(
+            "leaky", "127.0.0.1", cfg.arpc_port,
+            TlsClientConfig(str(tmp_path / "a.pem"), str(tmp_path / "a.key"),
+                            server.certs.ca_cert_path)))
+        at = asyncio.create_task(agent.run())
+        await server.agents.wait_session("leaky", timeout=10)
+
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "f.bin").write_bytes(b"x" * 200_000)
+        server.db.upsert_backup_job(database.BackupJobRow(
+            id="lk", target="leaky", source_path=str(src)))
+        for _ in range(3):                      # repeated job cycles
+            server.enqueue_backup("lk")
+            await server.jobs.wait("backup:lk", timeout=30)
+        sess = server.agents.get("leaky")
+        for _ in range(10):                     # control-plane chatter
+            await Session(sess.conn).call("ping")
+
+        await agent.stop()
+        at.cancel()
+        try:
+            await at
+        except (asyncio.CancelledError, Exception):
+            pass
+        await server.stop()
+        await asyncio.sleep(0.3)                # let teardown callbacks run
+        for t in asyncio.all_tasks():
+            if t is not asyncio.current_task() and not t.done():
+                leftovers.append(repr(t))
+
+    asyncio.run(main())
+    assert leftovers == [], f"leaked tasks: {leftovers}"
+    # default-executor workers persist by design; no unbounded growth
+    assert threading.active_count() <= threads_before + 6
+
+
+def test_mux_connection_leaves_no_tasks(tmp_path):
+    """A raw connect/call/close cycle leaves nothing running."""
+    from pbs_plus_tpu.arpc import Router, TlsServerConfig, connect_to_server, serve
+
+    cm = mtls.CertManager(str(tmp_path))
+    cm.load_or_create_ca()
+    cm.ensure_server_identity("srv")
+    cert, key = cm.issue("cli")
+    (tmp_path / "c.pem").write_bytes(cert)
+    (tmp_path / "c.key").write_bytes(key)
+    leftovers: list[str] = []
+
+    async def main():
+        router = Router()
+        router.handle("echo", lambda req, ctx: req.payload)
+
+        async def on_conn(conn, peer, headers):
+            await router.serve_connection(conn)
+
+        srv = await serve("127.0.0.1", 0,
+                          TlsServerConfig(cm.server_cert_path,
+                                          cm.server_key_path,
+                                          cm.ca_cert_path),
+                          on_connection=on_conn)
+        port = srv.sockets[0].getsockname()[1]
+        for _ in range(5):
+            conn = await connect_to_server(
+                "127.0.0.1", port,
+                TlsClientConfig(str(tmp_path / "c.pem"),
+                                str(tmp_path / "c.key"),
+                                cm.ca_cert_path))
+            s = Session(conn)
+            assert (await s.call("echo", 1)).data == 1
+            await conn.close()
+        srv.close()
+        await asyncio.wait_for(srv.wait_closed(), 5)
+        await asyncio.sleep(0.3)
+        for t in asyncio.all_tasks():
+            if t is not asyncio.current_task() and not t.done():
+                leftovers.append(repr(t))
+
+    asyncio.run(main())
+    assert leftovers == [], f"leaked tasks: {leftovers}"
