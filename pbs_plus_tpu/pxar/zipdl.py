@@ -1,0 +1,66 @@
+"""Zip download of archive subtrees.
+
+Reference: internal/pxar/zip.go — the UI's "download as zip" for a
+directory inside a snapshot.  Streams entries from a SplitReader into a
+zip (stored or deflated), preserving mtimes and modes.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import zipfile
+from typing import BinaryIO
+
+from .format import Entry, KIND_DIR, KIND_FILE, KIND_HARDLINK, KIND_SYMLINK
+from .transfer import SplitReader
+
+
+def zip_subtree(reader: SplitReader, subpath: str = "", *,
+                out: BinaryIO | None = None,
+                compression: int = zipfile.ZIP_DEFLATED) -> BinaryIO:
+    """Write ``subpath`` (and everything below) into a zip stream."""
+    out = out or io.BytesIO()
+    sub = subpath.strip("/")
+    root = reader.lookup(sub)
+    if root is None:
+        raise FileNotFoundError(subpath or "/")
+    with zipfile.ZipFile(out, "w", compression=compression) as zf:
+        def emit(e: Entry) -> None:
+            rel = e.path[len(sub):].lstrip("/") if sub else e.path
+            if not rel and e.is_dir:
+                # the subtree root itself is implicit — emit its children
+                for child in reader.read_dir(e.path):
+                    emit(child)
+                return
+            if not rel:
+                rel = os.path.basename(e.path)   # zipping a single file
+            mtime = max(0, e.mtime_ns) // 1_000_000_000
+            import time as _t
+            date = _t.localtime(mtime)[:6]
+            if date[0] < 1980:
+                date = (1980, 1, 1, 0, 0, 0)
+            if e.is_dir:
+                info = zipfile.ZipInfo(rel + "/", date_time=date)
+                info.external_attr = ((0o40000 | (e.mode & 0o7777)) << 16)
+                zf.writestr(info, b"")
+                for child in reader.read_dir(e.path):
+                    emit(child)
+            elif e.kind == KIND_FILE:
+                info = zipfile.ZipInfo(rel, date_time=date)
+                info.external_attr = ((0o100000 | (e.mode & 0o7777)) << 16)
+                zf.writestr(info, reader.read_file(e))
+            elif e.kind == KIND_SYMLINK:
+                info = zipfile.ZipInfo(rel, date_time=date)
+                info.external_attr = ((0o120000 | 0o777) << 16)
+                zf.writestr(info, e.link_target)
+            elif e.kind == KIND_HARDLINK:
+                # zip has no hardlinks: duplicate the target's content
+                target = reader.lookup(e.link_target)
+                info = zipfile.ZipInfo(rel, date_time=date)
+                info.external_attr = ((0o100000 | (e.mode & 0o7777)) << 16)
+                zf.writestr(info, reader.read_file(target)
+                            if target is not None and target.is_file else b"")
+        emit(root)
+    out.seek(0)
+    return out

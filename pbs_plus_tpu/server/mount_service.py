@@ -124,7 +124,10 @@ class MountService:
             mdir = os.path.join(self.base, mid)
             mp = os.path.join(mdir, "mnt")
             if os.path.ismount(mp):
-                lazy_unmount(mp)
+                if not lazy_unmount(mp):
+                    L.warning("stale mount %s could not be detached; "
+                              "leaving its state dir in place", mp)
+                    continue
                 n += 1
             shutil.rmtree(mdir, ignore_errors=True)
         if n:

@@ -142,7 +142,10 @@ class Server:
     async def start(self) -> None:
         self._cleanup_orphaned_tasks()
         from .mount_service import MountService
-        MountService(self).cleanup_stale_mounts()
+        self.mount_service = MountService(self)
+        # stale-mount reaping shells out (fusermount) — keep it off the loop
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.mount_service.cleanup_stale_mounts)
         port = await self.start_arpc()
         self.config.arpc_port = port
         self._tasks.append(asyncio.create_task(self.scheduler.run()))

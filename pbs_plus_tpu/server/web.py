@@ -346,6 +346,58 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
         resp = await Session(sess.conn).call("filetree", {"path": path})
         return web.json_response({"data": resp.data["entries"]})
 
+    # -- zip subtree download ---------------------------------------------
+    async def snapshot_zip(request):
+        snap = request.query.get("snapshot", "")
+        path = request.query.get("path", "")
+        from ..pxar.datastore import SnapshotRef
+        from ..pxar.transfer import SplitReader
+        from ..pxar.zipdl import zip_subtree
+        ZIP_MAX_BYTES = 1 << 30      # cap logical payload per download
+
+        def build():
+            ref = SnapshotRef(*snap.strip("/").split("/"))
+            reader = SplitReader.open_snapshot(server.datastore.datastore, ref)
+            sub = path.strip("/")
+            total = sum(e.size for e in reader.entries()
+                        if e.is_file and (not sub or e.path == sub
+                                          or e.path.startswith(sub + "/")))
+            if total > ZIP_MAX_BYTES:
+                raise OverflowError(
+                    f"subtree is {total} bytes (> {ZIP_MAX_BYTES}); use a "
+                    f"restore job instead")
+            return zip_subtree(reader, path), ref
+        try:
+            buf, ref = await asyncio.get_running_loop().run_in_executor(
+                None, build)
+        except (FileNotFoundError, TypeError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=404)
+        except OverflowError as e:
+            return web.json_response({"error": str(e)}, status=413)
+        import re as _re
+        name = _re.sub(r"[^A-Za-z0-9._-]+", "_",
+                       path.strip("/") or ref.backup_id) + ".zip"
+        return web.Response(
+            body=buf.getvalue(), content_type="application/zip",
+            headers={"Content-Disposition": f'attachment; filename="{name}"'})
+
+    # -- debug (reference: net/http/pprof on the API mux) ------------------
+    async def debug_tasks(request):
+        out = []
+        for t in asyncio.all_tasks():
+            out.append({"name": t.get_name(), "done": t.done(),
+                        "coro": str(t.get_coro())[:120]})
+        return web.json_response({"data": out})
+
+    async def debug_stats(request):
+        import threading
+        return web.json_response({
+            "jobs": server.jobs.stats,
+            "agents": len(server.agents.sessions()),
+            "threads": threading.active_count(),
+            "tasks": len(asyncio.all_tasks()),
+        })
+
     # -- snapshot mounts ---------------------------------------------------
     def _mount_service():
         if getattr(server, "mount_service", None) is None:
@@ -423,6 +475,9 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
     app.router.add_post("/api2/json/d2d/exclusion", exclusion_add)
     app.router.add_post("/api2/json/d2d/token", token_create)
     app.router.add_get("/api2/json/d2d/filetree", filetree)
+    app.router.add_get("/api2/json/d2d/snapshot-zip", snapshot_zip)
+    app.router.add_get("/plus/debug/tasks", debug_tasks)
+    app.router.add_get("/plus/debug/stats", debug_stats)
     app.router.add_post("/api2/json/d2d/mount", mount_create)
     app.router.add_get("/api2/json/d2d/mount", mount_list)
     app.router.add_delete("/api2/json/d2d/mount/{mid}", mount_delete)

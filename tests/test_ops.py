@@ -174,3 +174,15 @@ def test_minhash_estimates_jaccard():
     true_j = 1000 / 3000
     assert abs(est - true_j) < 0.12
     assert minhash_similarity(sig_a, sig_a) == 1.0
+
+
+def test_sha256_unroll_parity():
+    """Digests identical across block-unroll factors (the TPU tuning knob)."""
+    from pbs_plus_tpu.ops.sha256 import sha256_stream_chunks
+    data = _data(120_000, seed=8)
+    bounds = [(0, 55), (55, 7000), (7000, 66_000), (66_000, 120_000)]
+    base = sha256_stream_chunks(data, bounds, unroll=1)
+    for unroll in (2, 4, 16):
+        assert sha256_stream_chunks(data, bounds, unroll=unroll) == base
+    want = [hashlib.sha256(data[s:e]).digest() for s, e in bounds]
+    assert base == want
