@@ -77,6 +77,17 @@ async def request_id(request: web.Request, handler):
     return resp
 
 
+def _secret_candidates(sec: str) -> list[bytes]:
+    """Token secrets travel hex-encoded (as minted/printed); accept raw
+    ascii secrets too.  Shared by the auth middleware and bootstrap."""
+    out = [sec.encode()]
+    try:
+        out.insert(0, bytes.fromhex(sec))
+    except ValueError:
+        pass
+    return out
+
+
 class RateLimiter:
     def __init__(self, rate: float = 50.0, burst: int = 100):
         self.rate, self.burst = rate, burst
@@ -122,8 +133,9 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
             if ":" in tok:
                 tid, sec = tok.split(":", 1)
                 try:
-                    authorized = server.db.check_token(tid, sec.encode(),
-                                                       kind="api")
+                    authorized = any(
+                        server.db.check_token(tid, c, kind="api")
+                        for c in _secret_candidates(sec))
                 except Exception:
                     authorized = False
         if not authorized:
@@ -154,17 +166,18 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
     async def agent_bootstrap(request):
         body = await request.json()
         raw = body.get("token_secret", "")
-        try:
-            secret = bytes.fromhex(raw)      # tokens travel hex-encoded
-        except ValueError:
-            secret = raw.encode()
-        try:
-            cert = server.bootstrap_agent(
-                body["hostname"], body["csr"].encode(),
-                body["token_id"], secret,
-                drives=body.get("drives"))
-        except PermissionError as e:
-            return web.json_response({"error": str(e)}, status=403)
+        last_err: Exception = PermissionError("invalid bootstrap token")
+        for secret in _secret_candidates(raw):
+            try:
+                cert = server.bootstrap_agent(
+                    body["hostname"], body["csr"].encode(),
+                    body["token_id"], secret,
+                    drives=body.get("drives"))
+                break
+            except PermissionError as e:
+                last_err = e
+        else:
+            return web.json_response({"error": str(last_err)}, status=403)
         return web.json_response({
             "cert": cert.decode(),
             "ca": open(server.certs.ca_cert_path).read(),
