@@ -89,11 +89,29 @@ class MuxStream:
             raise MuxError(f"stream {self.sid} closed")
         view = memoryview(data)
         while view:
+            # re-checked every chunk, not only when blocked on credit: a
+            # mid-stream peer RST with window remaining must fail the
+            # write, not let it "succeed" into a void
+            if self._rx_reset:
+                raise MuxError(f"stream {self.sid} reset by peer")
+            if self.conn.closed:
+                raise MuxError("connection closed")
             while self._tx_credit <= 0:
                 self._tx_event.clear()
+                # a peer RST or connection shutdown never grants more
+                # credit — without these checks a writer blocked on an
+                # exhausted window hangs forever (advisor finding r1)
+                if self._rx_reset:
+                    raise MuxError(f"stream {self.sid} reset by peer")
+                if self.conn.closed:
+                    raise MuxError("connection closed")
                 await self._tx_event.wait()
                 if self._closed:
                     raise MuxError(f"stream {self.sid} closed")
+                if self._rx_reset:
+                    raise MuxError(f"stream {self.sid} reset by peer")
+                if self.conn.closed:
+                    raise MuxError("connection closed")
             n = min(len(view), MAX_DATA_FRAME, self._tx_credit)
             self._tx_credit -= n
             await self.conn._send_frame(DATA, self.sid, bytes(view[:n]))

@@ -293,11 +293,40 @@ class FuseMount:
             self._thread = None
 
 
+def is_mounted(mountpoint: str) -> bool:
+    """True while ``mountpoint`` appears in /proc/self/mounts.
+
+    ``os.path.ismount`` lstat()s the path, which raises ENOTCONN on a
+    FUSE mount whose daemon died — and ismount swallows that into False,
+    making a *disconnected* mount look unmounted.  The kernel mount
+    table is the ground truth (the reference treats stale mounts as a
+    first-class failure mode: internal/server/bootstrap.go:173-196)."""
+    try:
+        real = os.path.realpath(mountpoint)
+        with open("/proc/self/mounts", "rb") as f:
+            table = f.read().decode("utf-8", "surrogateescape")
+    except OSError:
+        return os.path.ismount(mountpoint)
+    # fields: dev mountpoint fstype opts ... ; octal-escaped spaces
+    for line in table.splitlines():
+        parts = line.split(" ")
+        if len(parts) < 2:
+            continue
+        mp = parts[1].replace("\\040", " ").replace("\\011", "\t")
+        if mp == real or mp == mountpoint:
+            return True
+    return False
+
+
 def lazy_unmount(mountpoint: str, *, timeout: float = 10.0) -> bool:
     """Best-effort lazy unmount via fusermount/fusermount3/umount -l.
-    Returns True when the mountpoint is no longer a mount."""
+    Returns True when the mountpoint is no longer in the mount table
+    (checked via /proc/self/mounts — robust against the disconnected-
+    FUSE state where os.path.ismount lies, see is_mounted)."""
     import shutil as _sh
     import subprocess as _sp
+    if not is_mounted(mountpoint):
+        return True
     for tool, args in (("fusermount", ["-u", "-z"]),
                        ("fusermount3", ["-u", "-z"]),
                        ("umount", ["-l"])):
@@ -305,6 +334,6 @@ def lazy_unmount(mountpoint: str, *, timeout: float = 10.0) -> bool:
             continue
         _sp.run([tool, *args, mountpoint], capture_output=True,
                 timeout=timeout)
-        if not os.path.ismount(mountpoint):
+        if not is_mounted(mountpoint):
             return True
-    return not os.path.ismount(mountpoint)
+    return not is_mounted(mountpoint)

@@ -23,7 +23,10 @@ from dataclasses import dataclass
 
 from ..chunker import ChunkerParams
 from ..utils.log import L
-from .datastore import Datastore, SnapshotRef, format_backup_time, parse_backup_type
+from .datastore import (
+    _SAFE_COMPONENT, Datastore, SnapshotRef, format_backup_time,
+    parse_backup_type,
+)
 from .transfer import (
     ChunkerFactory, DedupWriter, SplitReader, _default_chunker_factory,
     write_manifest,
@@ -136,6 +139,11 @@ class LocalStore:
         /root/reference/internal/pxarmount/commit_orchestrate.go: same-second
         commits bump timestamp)."""
         parse_backup_type(backup_type)
+        # mint-time guard: the id becomes a datastore path component and a
+        # later parse_snapshot_ref must accept it — reject traversal and
+        # argv-unsafe ids HERE so no unreachable snapshot can be created
+        if not _SAFE_COMPONENT.match(backup_id) or len(backup_id) > 256:
+            raise ValueError(f"invalid backup id {backup_id!r}")
         if isinstance(previous, PreviousBackupRef):
             previous = previous.ref
         if previous is None and auto_previous:

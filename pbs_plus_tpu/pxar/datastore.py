@@ -23,6 +23,7 @@ import datetime as _dt
 import hashlib
 import json
 import os
+import re
 import struct
 import threading
 from dataclasses import dataclass
@@ -43,6 +44,26 @@ def parse_backup_type(s: str) -> str:
     if s not in BACKUP_TYPES:
         raise ValueError(f"invalid backup type {s!r} (want one of {BACKUP_TYPES})")
     return s
+
+
+_SAFE_COMPONENT = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._:-]*$")
+
+
+def parse_snapshot_ref(s: str) -> "SnapshotRef":
+    """Parse + validate a ``type/id/time`` snapshot reference from
+    untrusted input (API token holders).  Each component must be a single
+    safe path segment — '', '.', '..', '/' and shell-metacharacter-bearing
+    strings are rejected before anything reaches os.path.join or a mount
+    subprocess argv (advisor finding r1), and the type must be one of
+    BACKUP_TYPES."""
+    parts = s.strip("/").split("/")
+    if len(parts) != 3:
+        raise ValueError(f"bad snapshot ref {s!r} (want type/id/time)")
+    for p in parts:
+        if not _SAFE_COMPONENT.match(p) or len(p) > 256:
+            raise ValueError(f"bad snapshot ref component {p!r}")
+    parse_backup_type(parts[0])
+    return SnapshotRef(*parts)
 
 
 def format_backup_time(t: float | _dt.datetime) -> str:

@@ -100,6 +100,10 @@ class _QueuePumpReader:
         self._q = q
         self._buf = b""
         self._eof = False
+        # set by the writer thread when it dies: the async producer checks
+        # it before each fq.put so a >64 MB file can't wedge the job on a
+        # dead consumer (advisor finding r1)
+        self.dead = False
 
     def read(self, n: int = -1) -> bytes:
         while not self._buf and not self._eof:
@@ -225,10 +229,13 @@ class RemoteTreeBackup:
             self.result.errors.append(f"{rel}: open: {e}")
             return
         fq: queue.Queue = queue.Queue(maxsize=QUEUE_DEPTH)
-        await self._put(("file", entry, _QueuePumpReader(fq)))
+        reader = _QueuePumpReader(fq)
+        await self._put(("file", entry, reader))
         off = 0
         try:
             while True:
+                if reader.dead:      # writer died; its drain empties fq
+                    break
                 block = await self.fs.read_at(handle, off, READ_BLOCK)
                 if not block:
                     break
@@ -250,8 +257,26 @@ class RemoteTreeBackup:
                 pass
         self.result.files += 1
 
+    @staticmethod
+    def _drain_reader(reader) -> None:
+        """Unblock the async producer of a dropped/aborted file: mark the
+        reader dead (producer stops reading ahead) and consume its block
+        queue until the producer's closing sentinel so any in-flight
+        fq.put is released (advisor finding r1: the S3 writer drained its
+        file queue on error; this path previously did not)."""
+        if reader is None or reader._eof:
+            # _eof ⇒ the producer's closing sentinel was already consumed
+            # (nothing more will arrive; a blocking get would never return)
+            return
+        reader.dead = True
+        while True:
+            item = reader._q.get()
+            if item is _SENTINEL or isinstance(item, BaseException):
+                return
+
     def _writer_loop(self) -> None:
         w = self.session.writer
+        current = None
         try:
             while True:
                 item = self._wq.get()
@@ -263,14 +288,20 @@ class RemoteTreeBackup:
                 if tag == "entry":
                     w.write_entry(entry)
                 else:
+                    current = reader
                     w.write_entry_reader(entry, reader)
+                    current = None
         except BaseException as e:
             self._writer_exc = e
-            # drain so the producer never blocks on a dead consumer
+            # drain so no producer ever blocks on a dead consumer: the
+            # in-flight file first, then every dropped item in _wq
+            self._drain_reader(current)
             while True:
                 item = self._wq.get()
                 if item is _SENTINEL or isinstance(item, BaseException):
                     return
+                if isinstance(item, tuple) and item[0] == "file":
+                    self._drain_reader(item[2])
 
 
 async def run_backup_job(row: database.BackupJobRow, *,

@@ -18,7 +18,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..mount.fusefs import lazy_unmount
+from ..mount.fusefs import is_mounted, lazy_unmount
 from ..utils.log import L
 
 
@@ -90,22 +90,36 @@ class MountService:
         return m
 
     async def unmount(self, mount_id: str) -> bool:
+        """Guaranteed teardown: detach the kernel mount FIRST (while the
+        FUSE daemon is still alive a fusermount -uz detaches cleanly and
+        ends its fuse_main loop), then stop the subprocess, then verify
+        against /proc/self/mounts — os.path.ismount cannot be trusted on
+        a disconnected FUSE mount (ENOTCONN → False).  Finally the mount
+        state dir is removed so the server's state tree stays removable
+        (the reference's stale-mount discipline, bootstrap.go:173-196)."""
         m = self.mounts.pop(mount_id, None)
         if m is None:
             return False
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, lazy_unmount, m.mountpoint)
         if m.proc is not None and m.proc.returncode is None:
             m.proc.terminate()
             try:
                 await asyncio.wait_for(m.proc.wait(), 10)
             except asyncio.TimeoutError:
                 m.proc.kill()
-        # belt-and-braces: lazy-unmount if the kernel mount lingers
-        if os.path.ismount(m.mountpoint):
-            ok = await asyncio.get_running_loop().run_in_executor(
-                None, lazy_unmount, m.mountpoint)
-            if not ok:
-                L.warning("mount %s still attached at %s after unmount "
-                          "attempts", m.mount_id, m.mountpoint)
+                try:
+                    await asyncio.wait_for(m.proc.wait(), 5)
+                except asyncio.TimeoutError:
+                    pass
+        # the daemon is gone now; if the mount survived (e.g. the child
+        # was SIGKILLed before its own cleanup ran) detach it lazily
+        ok = await loop.run_in_executor(None, lazy_unmount, m.mountpoint)
+        if not ok:
+            L.warning("mount %s still attached at %s after unmount "
+                      "attempts", m.mount_id, m.mountpoint)
+        if ok:
+            shutil.rmtree(os.path.dirname(m.mountpoint), ignore_errors=True)
         return True
 
     async def unmount_all(self) -> None:
@@ -123,7 +137,7 @@ class MountService:
         for mid in entries:
             mdir = os.path.join(self.base, mid)
             mp = os.path.join(mdir, "mnt")
-            if os.path.ismount(mp):
+            if is_mounted(mp):
                 if not lazy_unmount(mp):
                     L.warning("stale mount %s could not be detached; "
                               "leaving its state dir in place", mp)

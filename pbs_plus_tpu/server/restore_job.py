@@ -13,18 +13,11 @@ import asyncio
 import uuid
 
 from ..arpc import Router, Session
-from ..pxar.datastore import SnapshotRef
+from ..pxar.datastore import parse_snapshot_ref
 from ..pxar.remote import RemoteArchiveServer
 from ..pxar.transfer import SplitReader
 from ..utils.log import L
 from . import database
-
-
-def parse_snapshot_ref(s: str) -> SnapshotRef:
-    parts = s.strip("/").split("/")
-    if len(parts) != 3:
-        raise ValueError(f"bad snapshot ref {s!r} (want type/id/time)")
-    return SnapshotRef(*parts)
 
 
 async def run_restore_job(server, rid: str, *, target: str, snapshot: str,

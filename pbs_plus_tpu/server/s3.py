@@ -12,6 +12,7 @@ The client is a self-contained AWS SigV4 implementation over aiohttp
 
 from __future__ import annotations
 
+import asyncio
 import datetime as dt
 import hashlib
 import hmac
@@ -171,32 +172,41 @@ async def backup_s3_tree(client: S3Client, session, *,
                 w.write_entry(Entry(path=d, kind=KIND_DIR, mode=0o755))
                 emitted_dirs.add(d)
                 n += 1
-        # stream the object through a pump queue (async fetch, sync writer)
+        # stream the object through a pump queue (async fetch, sync writer).
+        # All queue ops from the event-loop side go through the executor: a
+        # blocking fq.put/t.join on the loop thread would freeze keepalives,
+        # the web API, and every other job (advisor finding r1).
         fq: _q.Queue = _q.Queue(maxsize=4)
         exc: list[BaseException] = []
+        reader = _QueuePumpReader(fq)
+        loop = asyncio.get_running_loop()
 
         def writer_thread(entry=Entry(path=rel, kind=KIND_FILE, mode=0o644)):
             try:
-                w.write_entry_reader(entry, _QueuePumpReader(fq))
+                w.write_entry_reader(entry, reader)
             except BaseException as e:
                 exc.append(e)
-                while fq.get() is not _SENTINEL:   # drain to unblock producer
-                    pass
+                reader.dead = True      # producer stops fetching
+                if not reader._eof:     # sentinel not yet consumed
+                    while fq.get() is not _SENTINEL:   # unblock producer
+                        pass
 
         t = threading.Thread(target=writer_thread, daemon=True)
         t.start()
         off = 0
         try:
             while off < size:
+                if reader.dead:
+                    break
                 block = await client.get_range(key, off, min(8 << 20,
                                                              size - off))
                 if not block:
                     break
-                fq.put(block)
+                await loop.run_in_executor(None, fq.put, block)
                 off += len(block)
         finally:
-            fq.put(_SENTINEL)
-            t.join()
+            await loop.run_in_executor(None, fq.put, _SENTINEL)
+            await loop.run_in_executor(None, t.join)
         if exc:
             raise exc[0]
         n += 1

@@ -278,8 +278,16 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
 
     async def target_upsert(request):
         b = await request.json()
-        server.db.upsert_target(b["name"], b.get("kind", "agent"),
-                                hostname=b.get("hostname", b["name"]),
+        from ..pxar.datastore import _SAFE_COMPONENT
+        name = b.get("name", "")
+        # the target name becomes the default backup id, i.e. a datastore
+        # path component — validate at mint time so every snapshot created
+        # from it stays reachable through parse_snapshot_ref
+        if not _SAFE_COMPONENT.match(name) or len(name) > 256:
+            return web.json_response(
+                {"error": f"invalid target name {name!r}"}, status=400)
+        server.db.upsert_target(name, b.get("kind", "agent"),
+                                hostname=b.get("hostname", name),
                                 root_path=b.get("root_path", ""),
                                 config=b.get("config"))
         return web.json_response({"ok": True})
@@ -287,7 +295,12 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
     # -- restore -----------------------------------------------------------
     async def restore_start(request):
         b = await request.json()
+        from ..pxar.datastore import parse_snapshot_ref
         from .restore_job import enqueue_restore
+        try:
+            parse_snapshot_ref(b["snapshot"])   # reject traversal/bad type
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
         rid = enqueue_restore(server, target=b["target"],
                               snapshot=b["snapshot"],
                               destination=b["destination"],
@@ -363,13 +376,13 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
     async def snapshot_zip(request):
         snap = request.query.get("snapshot", "")
         path = request.query.get("path", "")
-        from ..pxar.datastore import SnapshotRef
+        from ..pxar.datastore import parse_snapshot_ref
         from ..pxar.transfer import SplitReader
         from ..pxar.zipdl import zip_subtree
         ZIP_MAX_BYTES = 1 << 30      # cap logical payload per download
 
         def build():
-            ref = SnapshotRef(*snap.strip("/").split("/"))
+            ref = parse_snapshot_ref(snap)   # rejects traversal components
             reader = SplitReader.open_snapshot(server.datastore.datastore, ref)
             sub = path.strip("/")
             total = sum(e.size for e in reader.entries()
@@ -420,6 +433,13 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
 
     async def mount_create(request):
         b = await request.json()
+        from ..pxar.datastore import parse_snapshot_ref
+        try:
+            # validated before the ref string reaches the mount
+            # subprocess argv (advisor finding r1)
+            parse_snapshot_ref(b.get("snapshot", ""))
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
         try:
             m = await _mount_service().mount(b["snapshot"],
                                              fuse=bool(b.get("fuse", True)))

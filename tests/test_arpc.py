@@ -284,3 +284,52 @@ def test_no_thread_leaks(pki):
         await srv.wait_closed()
     run_async(main())
     assert threading.active_count() <= before + 1
+
+
+def test_mux_write_unblocks_on_peer_rst():
+    """A writer blocked on exhausted tx credit must fail fast when the
+    peer resets the stream or the connection dies — not hang forever
+    (advisor finding r1: raw-stream pumps when the peer dies mid-transfer)."""
+    from pbs_plus_tpu.arpc.mux import INITIAL_CREDIT, MuxConnection, MuxError
+
+    async def main():
+        accepted = asyncio.Queue()
+
+        async def on_conn(reader, writer):
+            conn = MuxConnection(reader, writer, is_client=False,
+                                 keepalive_s=0)
+            conn.start()
+            await accepted.put(conn)
+
+        srv = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        r, w = await asyncio.open_connection("127.0.0.1", port)
+        client = MuxConnection(r, w, is_client=True, keepalive_s=0)
+        client.start()
+        server_conn = await accepted.get()
+
+        st = await client.open_stream()
+        # exhaust the window: the peer never reads, so no grants come back
+        writer_task = asyncio.create_task(
+            st.write(b"\0" * (INITIAL_CREDIT * 2)))
+        peer_st = await server_conn.accept_stream()
+        await asyncio.sleep(0.2)          # let the writer hit the wall
+        assert not writer_task.done()     # blocked on credit, as designed
+        await peer_st.reset()
+        with pytest.raises(MuxError):
+            await asyncio.wait_for(writer_task, 5)
+
+        # same for a full connection shutdown
+        st2 = await client.open_stream()
+        writer_task2 = asyncio.create_task(
+            st2.write(b"\0" * (INITIAL_CREDIT * 2)))
+        await asyncio.sleep(0.2)
+        assert not writer_task2.done()
+        await server_conn.close()
+        with pytest.raises(MuxError):
+            await asyncio.wait_for(writer_task2, 5)
+
+        await client.close()
+        srv.close()
+        await srv.wait_closed()
+    run_async(main())

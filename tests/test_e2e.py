@@ -201,6 +201,47 @@ def test_drives_and_snapshot_mount_api(env, tmp_path):
     asyncio.run(main())
 
 
+def test_mount_teardown_survives_sigkilled_child(env, tmp_path):
+    """A SIGKILLed mount child leaves a *disconnected* FUSE mount:
+    os.path.ismount lies (ENOTCONN → False) but the kernel mount table
+    still lists it.  unmount() must detach it anyway and leave the whole
+    state dir removable (reference stale-mount discipline,
+    internal/server/bootstrap.go:173-196)."""
+    if not os.path.exists("/dev/fuse"):
+        pytest.skip("no /dev/fuse")
+
+    async def main():
+        import shutil
+        from pbs_plus_tpu.mount.fusefs import is_mounted
+        from pbs_plus_tpu.server.mount_service import MountService
+
+        server, agent, agent_task = await env()
+        src = tmp_path / "src-kill"
+        src.mkdir()
+        (src / "f.txt").write_text("kill me")
+        server.db.upsert_backup_job(database.BackupJobRow(
+            id="mk", target="agent-e2e", source_path=str(src)))
+        server.enqueue_backup("mk")
+        await server.jobs.wait("backup:mk", timeout=60)
+        snap = server.db.get_backup_job("mk").last_snapshot
+
+        ms = MountService(server)
+        m = await ms.mount(snap, fuse=True)
+        # hard-kill the child: no cleanup runs, the mount goes ENOTCONN
+        m.proc.kill()
+        await m.proc.wait()
+        assert is_mounted(m.mountpoint), "kernel mount should survive kill"
+        assert await ms.unmount(m.mount_id)
+        assert not is_mounted(m.mountpoint)
+        # the entire mount base must now be removable (pytest rm_rf parity)
+        shutil.rmtree(ms.base)
+        assert not os.path.exists(ms.base)
+        await agent.stop()
+        agent_task.cancel()
+        await server.stop()
+    asyncio.run(main())
+
+
 def test_backup_fails_cleanly_when_agent_offline(env, tmp_path):
     async def main():
         server, agent, agent_task = await env()
