@@ -84,34 +84,32 @@ class MuxStream:
                                         struct.pack("<I", grant))
 
     # -- write ------------------------------------------------------------
-    async def write(self, data: bytes) -> None:
+    def _check_writable(self) -> None:
+        """Raise if no more data can ever be sent: peer RST, local
+        close/reset, or connection death.  Any of these while a writer is
+        blocked on exhausted credit would otherwise hang it forever
+        (advisor finding r1) — all of their setters also set _tx_event so
+        blocked writers wake and re-check."""
+        if self._rx_reset:
+            raise MuxError(f"stream {self.sid} reset by peer")
         if self._closed:
             raise MuxError(f"stream {self.sid} closed")
+        if self.conn.closed:
+            raise MuxError("connection closed")
+
+    async def write(self, data: bytes) -> None:
+        self._check_writable()
         view = memoryview(data)
         while view:
             # re-checked every chunk, not only when blocked on credit: a
             # mid-stream peer RST with window remaining must fail the
             # write, not let it "succeed" into a void
-            if self._rx_reset:
-                raise MuxError(f"stream {self.sid} reset by peer")
-            if self.conn.closed:
-                raise MuxError("connection closed")
+            self._check_writable()
             while self._tx_credit <= 0:
                 self._tx_event.clear()
-                # a peer RST or connection shutdown never grants more
-                # credit — without these checks a writer blocked on an
-                # exhausted window hangs forever (advisor finding r1)
-                if self._rx_reset:
-                    raise MuxError(f"stream {self.sid} reset by peer")
-                if self.conn.closed:
-                    raise MuxError("connection closed")
+                self._check_writable()
                 await self._tx_event.wait()
-                if self._closed:
-                    raise MuxError(f"stream {self.sid} closed")
-                if self._rx_reset:
-                    raise MuxError(f"stream {self.sid} reset by peer")
-                if self.conn.closed:
-                    raise MuxError("connection closed")
+                self._check_writable()
             n = min(len(view), MAX_DATA_FRAME, self._tx_credit)
             self._tx_credit -= n
             await self.conn._send_frame(DATA, self.sid, bytes(view[:n]))
@@ -122,6 +120,7 @@ class MuxStream:
         """Half-close (FIN); reads continue until peer FIN."""
         if not self._closed:
             self._closed = True
+            self._tx_event.set()          # wake writers blocked on credit
             if not self.conn.closed:
                 try:
                     await self.conn._send_frame(FIN, self.sid, b"")
@@ -130,6 +129,7 @@ class MuxStream:
 
     async def reset(self) -> None:
         self._closed = True
+        self._tx_event.set()              # wake writers blocked on credit
         if not self.conn.closed:
             try:
                 await self.conn._send_frame(RST, self.sid, b"")

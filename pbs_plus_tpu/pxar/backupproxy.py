@@ -23,9 +23,9 @@ from dataclasses import dataclass
 
 from ..chunker import ChunkerParams
 from ..utils.log import L
+from ..utils import validate
 from .datastore import (
-    _SAFE_COMPONENT, Datastore, SnapshotRef, format_backup_time,
-    parse_backup_type,
+    Datastore, SnapshotRef, format_backup_time, parse_backup_type,
 )
 from .transfer import (
     ChunkerFactory, DedupWriter, SplitReader, _default_chunker_factory,
@@ -142,8 +142,7 @@ class LocalStore:
         # mint-time guard: the id becomes a datastore path component and a
         # later parse_snapshot_ref must accept it — reject traversal and
         # argv-unsafe ids HERE so no unreachable snapshot can be created
-        if not _SAFE_COMPONENT.match(backup_id) or len(backup_id) > 256:
-            raise ValueError(f"invalid backup id {backup_id!r}")
+        validate.snapshot_component(backup_id)
         if isinstance(previous, PreviousBackupRef):
             previous = previous.ref
         if previous is None and auto_previous:

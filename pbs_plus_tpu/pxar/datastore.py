@@ -32,6 +32,8 @@ from typing import Iterator
 import numpy as np
 import zstandard
 
+from ..utils import validate
+
 DIDX_MAGIC = b"TPXD"
 DIDX_VERSION = 1
 _HDR = struct.Struct("<4sHH16sQQ")
@@ -46,22 +48,19 @@ def parse_backup_type(s: str) -> str:
     return s
 
 
-_SAFE_COMPONENT = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._:-]*$")
-
-
 def parse_snapshot_ref(s: str) -> "SnapshotRef":
     """Parse + validate a ``type/id/time`` snapshot reference from
     untrusted input (API token holders).  Each component must be a single
     safe path segment — '', '.', '..', '/' and shell-metacharacter-bearing
     strings are rejected before anything reaches os.path.join or a mount
     subprocess argv (advisor finding r1), and the type must be one of
-    BACKUP_TYPES."""
+    BACKUP_TYPES.  The same validator guards mint time (start_session,
+    target create) so no unreachable snapshot can exist."""
     parts = s.strip("/").split("/")
     if len(parts) != 3:
         raise ValueError(f"bad snapshot ref {s!r} (want type/id/time)")
     for p in parts:
-        if not _SAFE_COMPONENT.match(p) or len(p) > 256:
-            raise ValueError(f"bad snapshot ref component {p!r}")
+        validate.snapshot_component(p)
     parse_backup_type(parts[0])
     return SnapshotRef(*parts)
 

@@ -278,14 +278,15 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
 
     async def target_upsert(request):
         b = await request.json()
-        from ..pxar.datastore import _SAFE_COMPONENT
+        from ..utils import validate
         name = b.get("name", "")
         # the target name becomes the default backup id, i.e. a datastore
         # path component — validate at mint time so every snapshot created
         # from it stays reachable through parse_snapshot_ref
-        if not _SAFE_COMPONENT.match(name) or len(name) > 256:
-            return web.json_response(
-                {"error": f"invalid target name {name!r}"}, status=400)
+        try:
+            validate.snapshot_component(name)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
         server.db.upsert_target(name, b.get("kind", "agent"),
                                 hostname=b.get("hostname", name),
                                 root_path=b.get("root_path", ""),

@@ -4,7 +4,11 @@ from __future__ import annotations
 
 import re
 
-_NAME_RE = re.compile(r"^[A-Za-z0-9_][A-Za-z0-9._\-]*$")
+# one alphabet for every name that can become a datastore path component
+# (job id → backup id → snapshot dir): leading char alphanumeric, then
+# alphanumerics plus ._:- (':' for rfc3339 timestamps).  Keeping a single
+# regex here prevents mint-time vs parse-time divergence (review r2).
+_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._:\-]*$")
 _HOSTNAME_RE = re.compile(
     r"^(?=.{1,253}$)([a-zA-Z0-9](?:[a-zA-Z0-9\-]{0,61}[a-zA-Z0-9])?\.)*"
     r"[a-zA-Z0-9](?:[a-zA-Z0-9\-]{0,61}[a-zA-Z0-9])?$"
@@ -30,6 +34,14 @@ def hostname(value: str) -> str:
 def datastore_name(value: str) -> str:
     if not value or len(value) > 128 or not _NAME_RE.match(value):
         raise ValidationError(f"invalid datastore name {value!r}")
+    return value
+
+
+def snapshot_component(value: str) -> str:
+    """A single snapshot-path segment (backup id, target name, rfc3339
+    time): must be safe as a path component AND as subprocess argv."""
+    if not value or len(value) > 256 or not _NAME_RE.match(value):
+        raise ValidationError(f"invalid name component {value!r}")
     return value
 
 
