@@ -23,7 +23,6 @@ import datetime as _dt
 import hashlib
 import json
 import os
-import re
 import struct
 import threading
 from dataclasses import dataclass

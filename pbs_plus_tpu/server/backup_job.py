@@ -342,8 +342,11 @@ async def run_backup_job(row: database.BackupJobRow, *,
         job_sess_info = await agents.wait_session(client_id, timeout=60)
         fs = AgentFSClient(Session(job_sess_info.conn))
 
-        session = store.start_session(
-            backup_type="host", backup_id=row.backup_id or row.target)
+        # start_session can do network I/O (PBSStore: TLS connect, session
+        # establish, previous-index downloads) — keep it off the event loop
+        session = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: store.start_session(
+                backup_type="host", backup_id=row.backup_id or row.target))
         try:
             pump = RemoteTreeBackup(
                 fs, session,

@@ -47,6 +47,15 @@ class ServerConfig:
     chunker: str = "cpu"                    # default backend; per-job override
     max_concurrent: int | None = None
     hostname: str = "pbs-plus-tpu-server"
+    # optional PBS push target: backup jobs with store="pbs" upload into a
+    # live Proxmox Backup Server instead of the local datastore
+    # (reference: backupproxy.NewPBSStore,
+    # /root/reference/internal/pxarmount/commit_orchestrate.go:137-149)
+    pbs_url: str = ""
+    pbs_datastore: str = ""
+    pbs_token: str = ""
+    pbs_namespace: str = ""
+    pbs_fingerprint: str = ""
 
 
 class Server:
@@ -232,7 +241,23 @@ class Server:
         result_box: dict = {}
 
         store = self.datastore
-        if row.chunker and row.chunker != self.config.chunker:
+        if row.store == "pbs":
+            if not self.config.pbs_url:
+                raise RuntimeError(
+                    f"job {row.id!r} wants store='pbs' but no PBS push "
+                    f"target is configured (ServerConfig.pbs_url)")
+            from ..pxar.pbsstore import PBSConfig, PBSStore
+            kind = row.chunker or self.config.chunker
+            store = PBSStore(
+                PBSConfig(base_url=self.config.pbs_url,
+                          datastore=self.config.pbs_datastore,
+                          auth_token=self.config.pbs_token,
+                          namespace=self.config.pbs_namespace,
+                          fingerprint=self.config.pbs_fingerprint),
+                ChunkerParams(avg_size=self.config.chunk_avg),
+                chunker_factory=make_chunker_factory(kind),
+                batch_hasher=make_batch_hasher(kind))
+        elif row.chunker and row.chunker != self.config.chunker:
             store = LocalStore(
                 self.config.datastore_dir,
                 ChunkerParams(avg_size=self.config.chunk_avg),

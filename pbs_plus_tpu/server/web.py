@@ -226,6 +226,7 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
             "backup_id": j.backup_id, "schedule": j.schedule,
             "retry": j.retry, "retry_interval_s": j.retry_interval_s,
             "exclusions": j.exclusions, "chunker": j.chunker,
+            "store": j.store,
             "enabled": j.enabled, "last_run_at": j.last_run_at,
             "last_status": j.last_status, "last_error": j.last_error,
             "last_snapshot": j.last_snapshot,
@@ -242,10 +243,20 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
         from .backup_job import validate_chunker_kind
         chunker = b.get("chunker", server.config.chunker)
         validate_chunker_kind(chunker)  # reject unknown backends up front
+        store_kind = b.get("store", "")
+        if store_kind not in ("", "local", "pbs"):
+            return web.json_response(
+                {"error": f"unknown store {store_kind!r} "
+                          "(want local | pbs)"}, status=400)
+        if store_kind == "pbs" and not server.config.pbs_url:
+            return web.json_response(
+                {"error": "store='pbs' but no PBS push target configured "
+                          "(ServerConfig.pbs_url)"}, status=400)
         row = database.BackupJobRow(
             id=validate.job_id(b["id"]), target=b["target"],
             source_path=b["source_path"],
-            backup_id=validate.job_id(b["backup_id"])
+            store="pbs" if store_kind == "pbs" else "",
+            backup_id=validate.snapshot_component(b["backup_id"])
             if b.get("backup_id") else "",
             schedule=b.get("schedule", ""), retry=int(b.get("retry", 0)),
             retry_interval_s=int(b.get("retry_interval_s", 60)),

@@ -4,11 +4,14 @@ from __future__ import annotations
 
 import re
 
-# one alphabet for every name that can become a datastore path component
-# (job id → backup id → snapshot dir): leading char alphanumeric, then
-# alphanumerics plus ._:- (':' for rfc3339 timestamps).  Keeping a single
-# regex here prevents mint-time vs parse-time divergence (review r2).
-_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._:\-]*$")
+# job ids / datastore names: DB + UPID keys, never path components —
+# leading underscore stays valid (grandfathered; review r2)
+_NAME_RE = re.compile(r"^[A-Za-z0-9_][A-Za-z0-9._:\-]*$")
+# names that become datastore path components (backup id, target name,
+# rfc3339 time): leading char alphanumeric, then alphanumerics plus
+# ._:- — one alphabet for mint AND parse time so no unreachable
+# snapshot can be created (review r2)
+_COMPONENT_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._:\-]*$")
 _HOSTNAME_RE = re.compile(
     r"^(?=.{1,253}$)([a-zA-Z0-9](?:[a-zA-Z0-9\-]{0,61}[a-zA-Z0-9])?\.)*"
     r"[a-zA-Z0-9](?:[a-zA-Z0-9\-]{0,61}[a-zA-Z0-9])?$"
@@ -40,7 +43,7 @@ def datastore_name(value: str) -> str:
 def snapshot_component(value: str) -> str:
     """A single snapshot-path segment (backup id, target name, rfc3339
     time): must be safe as a path component AND as subprocess argv."""
-    if not value or len(value) > 256 or not _NAME_RE.match(value):
+    if not value or len(value) > 256 or not _COMPONENT_RE.match(value):
         raise ValidationError(f"invalid name component {value!r}")
     return value
 

@@ -1,0 +1,259 @@
+"""In-process mock of the PBS backup-writer HTTP API — the executable
+wire contract for pbs_plus_tpu.pxar.pbsstore (reference capability:
+the live PBS datastore the reference's backupproxy.NewPBSStore pushes
+into, /root/reference/internal/pxarmount/commit_orchestrate.go:127-163).
+
+Verifies what a real server verifies: auth token, upgrade header, valid
+wid on chunk upload, digest/size integrity per chunk, index csum on
+close, all-writers-closed on finish.  Sessions are keyed by client
+address (the protocol binds a session to its connection)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import zstandard
+
+from pbs_plus_tpu.pxar.pbsstore import index_csum, index_to_bytes
+from pbs_plus_tpu.pxar.datastore import DynamicIndex
+
+import numpy as np
+
+
+class MockPBS:
+    def __init__(self, token: str = "root@pam!tpu:secret"):
+        self.token = token
+        self.chunks: dict[str, bytes] = {}        # digest hex → raw bytes
+        self.snapshots: dict[str, dict] = {}      # "type/id/time" → state
+        self.sessions: dict = {}                  # client addr → session
+        self.request_log: list[str] = []          # wire golden trace
+        self.lock = threading.Lock()
+        self._dctx = zstandard.ZstdDecompressor()
+
+        mock = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):            # quiet
+                pass
+
+            # -- helpers ---------------------------------------------------
+            def _q(self):
+                u = urllib.parse.urlparse(self.path)
+                return u.path, dict(urllib.parse.parse_qsl(u.query))
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n) if n else b""
+
+            def _send(self, status: int, payload=None):
+                binary = isinstance(payload, (bytes, bytearray))
+                body = bytes(payload) if binary \
+                    else json.dumps({"data": payload}).encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Content-Type",
+                                 "application/octet-stream" if binary
+                                 else "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _fail(self, status: int, msg: str):
+                body = json.dumps({"errors": msg}).encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _session(self):
+                return mock.sessions.get(self.client_address)
+
+            # -- dispatch --------------------------------------------------
+            def _handle(self, method: str):
+                path, q = self._q()
+                with mock.lock:
+                    mock.request_log.append(f"{method} {path}" + (
+                        f"?{urllib.parse.urlencode(sorted(q.items()))}"
+                        if q else ""))
+                auth = self.headers.get("Authorization", "")
+                if auth != f"PBSAPIToken={mock.token}":
+                    return self._fail(401, "permission check failed")
+
+                if method == "GET" and path == "/api2/json/backup":
+                    if self.headers.get("Upgrade") != \
+                            "proxmox-backup-protocol-v1":
+                        return self._fail(400, "invalid upgrade protocol")
+                    for k in ("store", "backup-type", "backup-id",
+                              "backup-time"):
+                        if k not in q:
+                            return self._fail(400, f"missing {k}")
+                    with mock.lock:
+                        mock.sessions[self.client_address] = {
+                            "params": q, "wids": {}, "next_wid": 1,
+                            "blobs": {}, "finished": False}
+                    return self._send(200, {"msg": "session established"})
+
+                sess = self._session()
+                if sess is None:
+                    return self._fail(400, "no backup session on this "
+                                           "connection")
+
+                if method == "POST" and path == "/dynamic_index":
+                    b = json.loads(self._body() or b"{}")
+                    name = b.get("archive-name", "")
+                    if not name:
+                        return self._fail(400, "missing archive-name")
+                    with mock.lock:
+                        wid = sess["next_wid"]
+                        sess["next_wid"] += 1
+                        sess["wids"][wid] = {"name": name, "records": [],
+                                             "closed": False}
+                    return self._send(200, wid)
+
+                if method == "POST" and path == "/dynamic_chunk":
+                    try:
+                        wid = int(q["wid"])
+                        digest = q["digest"]
+                        size = int(q["size"])
+                        enc_size = int(q["encoded-size"])
+                    except (KeyError, ValueError):
+                        return self._fail(400, "bad chunk params")
+                    if wid not in sess["wids"]:
+                        return self._fail(400, f"unknown wid {wid}")
+                    enc = self._body()
+                    if len(enc) != enc_size:
+                        return self._fail(400, "encoded-size mismatch")
+                    raw = mock._dctx.decompress(enc, max_output_size=64 << 20)
+                    if len(raw) != size:
+                        return self._fail(400, "size mismatch")
+                    if hashlib.sha256(raw).hexdigest() != digest:
+                        return self._fail(400, "digest mismatch")
+                    with mock.lock:
+                        mock.chunks[digest] = raw
+                    return self._send(200, None)
+
+                if method == "PUT" and path == "/dynamic_index":
+                    b = json.loads(self._body())
+                    wid = int(b["wid"])
+                    w = sess["wids"].get(wid)
+                    if w is None or w["closed"]:
+                        return self._fail(400, f"bad wid {wid}")
+                    digs, offs = b["digest-list"], b["offset-list"]
+                    if len(digs) != len(offs):
+                        return self._fail(400, "list length mismatch")
+                    for d, o in zip(digs, offs):
+                        if d not in mock.chunks:
+                            return self._fail(400, f"unknown chunk {d}")
+                        w["records"].append((int(o), bytes.fromhex(d)))
+                    return self._send(200, None)
+
+                if method == "POST" and path == "/dynamic_close":
+                    b = json.loads(self._body())
+                    wid = int(b["wid"])
+                    w = sess["wids"].get(wid)
+                    if w is None or w["closed"]:
+                        return self._fail(400, f"bad wid {wid}")
+                    recs = w["records"]
+                    if int(b["chunk-count"]) != len(recs):
+                        return self._fail(400, "chunk-count mismatch")
+                    want_size = int(recs[-1][0]) if recs else 0
+                    if int(b["size"]) != want_size:
+                        return self._fail(400, "size mismatch")
+                    if b["csum"] != index_csum(recs).hex():
+                        return self._fail(400, "csum mismatch")
+                    w["closed"] = True
+                    return self._send(200, None)
+
+                if method == "POST" and path == "/blob":
+                    name = q.get("file-name", "")
+                    body = self._body()
+                    if int(q.get("encoded-size", -1)) != len(body):
+                        return self._fail(400, "encoded-size mismatch")
+                    sess["blobs"][name] = body
+                    return self._send(200, None)
+
+                if method == "GET" and path == "/previous":
+                    name = q.get("archive-name", "")
+                    p = sess["params"]
+                    group = [r for r in mock.snapshots
+                             if r.startswith(f"{p['backup-type']}/"
+                                             f"{p['backup-id']}/")]
+                    if not group:
+                        return self._fail(404, "no previous backup")
+                    prev = mock.snapshots[max(group)]
+                    if name in prev["indexes"]:
+                        idx = DynamicIndex(
+                            np.array([e for e, _ in prev["indexes"][name]],
+                                     dtype=np.uint64),
+                            np.frombuffer(
+                                b"".join(d for _, d in
+                                         prev["indexes"][name]),
+                                dtype=np.uint8).reshape(-1, 32)
+                            if prev["indexes"][name] else
+                            np.empty((0, 32), dtype=np.uint8))
+                        return self._send(200, index_to_bytes(idx))
+                    if name in prev["blobs"]:
+                        return self._send(200, prev["blobs"][name])
+                    return self._fail(404, f"unknown archive {name}")
+
+                if method == "POST" and path == "/finish":
+                    if not sess["wids"]:
+                        return self._fail(400, "nothing uploaded")
+                    for w in sess["wids"].values():
+                        if not w["closed"]:
+                            return self._fail(400,
+                                              f"writer {w['name']} not "
+                                              f"closed")
+                    p = sess["params"]
+                    import datetime as dt
+                    ts = dt.datetime.fromtimestamp(
+                        int(p["backup-time"]),
+                        dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+                    ref = f"{p['backup-type']}/{p['backup-id']}/{ts}"
+                    with mock.lock:
+                        mock.snapshots[ref] = {
+                            "indexes": {w["name"]: w["records"]
+                                        for w in sess["wids"].values()},
+                            "blobs": dict(sess["blobs"]),
+                            "ns": p.get("ns", ""),
+                        }
+                    sess["finished"] = True
+                    return self._send(200, None)
+
+                return self._fail(404, f"unknown endpoint {method} {path}")
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def read_stream(self, ref: str, index_name: str) -> bytes:
+        """Reconstruct a stream from its index records + chunk store."""
+        out = bytearray()
+        for _, digest in self.snapshots[ref]["indexes"][index_name]:
+            out += self.chunks[digest.hex()]
+        return bytes(out)
+
+    def close(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        self.thread.join(5)

@@ -201,6 +201,50 @@ def test_drives_and_snapshot_mount_api(env, tmp_path):
     asyncio.run(main())
 
 
+def test_backup_job_pushes_to_pbs(env, tmp_path):
+    """store="pbs" routes a backup job's upload into a live PBS (mock) —
+    the reference's deployment story (backupproxy.NewPBSStore)."""
+    async def main():
+        from mock_pbs import MockPBS
+        server, agent, agent_task = await env()
+        pbs = MockPBS()
+        try:
+            server.config.pbs_url = pbs.base_url
+            server.config.pbs_datastore = "tank"
+            server.config.pbs_token = pbs.token
+
+            src = tmp_path / "src-pbs"
+            src.mkdir()
+            rng = np.random.default_rng(3)
+            (src / "a.bin").write_bytes(
+                rng.integers(0, 256, 300_000, dtype=np.uint8).tobytes())
+            (src / "b.txt").write_text("push me\n" * 100)
+            server.db.upsert_backup_job(database.BackupJobRow(
+                id="p1", target="agent-e2e", source_path=str(src),
+                store="pbs"))
+            server.enqueue_backup("p1")
+            await server.jobs.wait("backup:p1", timeout=60)
+            row = server.db.get_backup_job("p1")
+            assert row.last_status == database.STATUS_SUCCESS, row.last_error
+
+            assert len(pbs.snapshots) == 1
+            ref = next(iter(pbs.snapshots))
+            from pbs_plus_tpu.pxar.datastore import Datastore
+            payload = pbs.read_stream(ref, Datastore.PAYLOAD_IDX)
+            # archive DFS order: a.bin then b.txt
+            want = (src / "a.bin").read_bytes() + \
+                (src / "b.txt").read_bytes()
+            assert payload == want
+            # nothing landed in the local datastore
+            assert server.datastore.datastore.list_snapshots() == []
+        finally:
+            pbs.close()
+        await agent.stop()
+        agent_task.cancel()
+        await server.stop()
+    asyncio.run(main())
+
+
 def test_mount_teardown_survives_sigkilled_child(env, tmp_path):
     """A SIGKILLed mount child leaves a *disconnected* FUSE mount:
     os.path.ismount lies (ENOTCONN → False) but the kernel mount table
