@@ -58,7 +58,7 @@ def _cpu_baseline(mib: int = 256) -> dict:
     return {"mib_s": mib / dt, "chunks": len(cuts), "seconds": dt}
 
 
-RELAY_PORTS = (8082, 8083, 8087, 8092)    # axon tunnel listener ports
+from pbs_plus_tpu.utils.jaxdev import probe_relay  # shared tunnel probe
 
 
 def _probe_accelerator() -> tuple[bool, dict]:
@@ -76,7 +76,6 @@ def _probe_accelerator() -> tuple[bool, dict]:
 
     Device init is probed in a subprocess with escalating timeouts
     because a dead tunnel hangs PJRT client creation indefinitely."""
-    import socket
     import subprocess
 
     diag: dict = {
@@ -90,18 +89,8 @@ def _probe_accelerator() -> tuple[bool, dict]:
         diag["note"] = "JAX_PLATFORMS=cpu pinned in env; accelerator disabled"
         return False, diag
 
-    any_port_open = False
-    for port in RELAY_PORTS:
-        s = socket.socket()
-        s.settimeout(2)
-        try:
-            s.connect(("127.0.0.1", port))
-            diag["relay_ports"][port] = "open"
-            any_port_open = True
-        except OSError as e:
-            diag["relay_ports"][port] = f"{type(e).__name__}: {e}"
-        finally:
-            s.close()
+    diag["relay_ports"] = probe_relay()
+    any_port_open = any(v == "open" for v in diag["relay_ports"].values())
     if not any_port_open and diag["env"]["PALLAS_AXON_POOL_IPS"]:
         diag["note"] = ("accelerator tunnel down: no relay port accepts "
                         "connections (device init would hang); this is an "

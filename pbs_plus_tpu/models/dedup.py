@@ -157,6 +157,10 @@ class TpuChunker:
     BASELINE.json).  Buffers segment bytes host-side; candidate evaluation
     is device-batched per feed."""
 
+    # device-dispatch counter across all instances: integration tests
+    # assert the TPU path actually ran when chunker="tpu" is configured
+    device_dispatches = 0
+
     def __init__(self, params: ChunkerParams):
         self.params = params
         self._tables = device_tables(params)
@@ -168,6 +172,7 @@ class TpuChunker:
         self._finalized = False
 
     def _candidates(self, data: np.ndarray) -> np.ndarray:
+        TpuChunker.device_dispatches += 1
         S = len(data)
         S_pad = max(1 << 14, 1 << int(S - 1).bit_length()) if S else 1 << 14
         buf = np.zeros((1, S_pad), dtype=np.uint8)

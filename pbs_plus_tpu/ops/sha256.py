@@ -192,6 +192,9 @@ def _digests_to_bytes(d: np.ndarray) -> list[bytes]:
     return [w.astype(">u4").tobytes() for w in d]
 
 
+_dispatch_count = 0      # device-batch dispatches (integration-test probe)
+
+
 def sha256_stream_chunks(stream, bounds: list[tuple[int, int]], *,
                          max_batch: int = 4096,
                          unroll: int | None = None) -> list[bytes]:
@@ -201,6 +204,8 @@ def sha256_stream_chunks(stream, bounds: list[tuple[int, int]], *,
     """
     if not bounds:
         return []
+    global _dispatch_count
+    _dispatch_count += 1
     if isinstance(stream, (bytes, bytearray, memoryview)):
         stream = np.frombuffer(stream, dtype=np.uint8)
     starts = np.array([s for s, _ in bounds], dtype=np.int32)

@@ -61,8 +61,15 @@ def make_batch_hasher(kind: str):
     hashes emitted chunks in device batches (ops/sha256); cpu/sidecar use
     the writer's inline hashlib path."""
     if kind == "tpu":
-        from ..ops.sha256 import sha256_chunks
-        return sha256_chunks
+        def hasher(chunks):
+            # guard runs lazily on the writer thread (first call probes
+            # the accelerator tunnel; never on the event loop, never a
+            # hang on a dead tunnel)
+            from ..utils.jaxdev import ensure_backend
+            ensure_backend()
+            from ..ops.sha256 import sha256_chunks
+            return sha256_chunks(chunks)
+        return hasher
     return None
 
 
@@ -70,8 +77,15 @@ def make_chunker_factory(kind: str):
     """The one-line config change (BASELINE.json):
     chunker = cpu | tpu | sidecar:<host:port>."""
     if kind == "tpu":
-        from ..models.dedup import TpuChunker
-        return lambda p: TpuChunker(p)
+        def factory(p):
+            # invoked inside start_session, which job code runs off the
+            # event loop — the first-call tunnel probe and jax import
+            # never stall the server loop
+            from ..utils.jaxdev import ensure_backend
+            ensure_backend()
+            from ..models.dedup import TpuChunker
+            return TpuChunker(p)
+        return factory
     if kind.startswith("sidecar:"):
         from ..sidecar.client import SidecarChunker, SidecarClient
         client = SidecarClient(kind.split(":", 1)[1])

@@ -54,6 +54,8 @@ class DedupService:
         self.params = params or ChunkerParams(avg_size=4 << 20)
         if use_tpu is None:
             try:
+                from ..utils.jaxdev import ensure_backend
+                ensure_backend()       # never hang on a dead accelerator
                 import jax
                 use_tpu = jax.default_backend() != "cpu"
             except Exception:
