@@ -77,6 +77,7 @@ class AgentsManager:
         self._sessions: dict[str, ClientSession] = {}
         self._expected_ids: set[str] = set()         # Expect() one-shots
         self._waiters: dict[str, list[asyncio.Future]] = {}
+        self._disc_watchers: dict[int, list[asyncio.Future]] = {}
         self._buckets: dict[str, _TokenBucket] = {}
         self._rate, self._burst = rate, burst
         self._is_expected = is_expected
@@ -134,6 +135,32 @@ class AgentsManager:
             cur = self._sessions.get(sess.client_id)
             if cur is sess:
                 del self._sessions[sess.client_id]
+            watchers = self._disc_watchers.pop(id(sess), [])
+        for f in watchers:
+            if not f.done():
+                f.set_result(sess)
+
+    def watch_disconnect(self, sess: ClientSession) -> asyncio.Future:
+        """Future resolved when this exact session unregisters (its
+        connection died or was evicted).  Crashed-job detection: a backup
+        races its pump against this future, so an agent child crash fails
+        the job in milliseconds even if the control session is still up
+        (reference pattern: internal/server/vfs/arpcfs/fs.go:119-148 —
+        primary up, job session severed → hard error)."""
+        fut = asyncio.get_running_loop().create_future()
+        if sess.conn.closed:
+            fut.set_result(sess)
+            return fut
+        self._disc_watchers.setdefault(id(sess), []).append(fut)
+        return fut
+
+    def unwatch_disconnect(self, sess: ClientSession,
+                           fut: asyncio.Future) -> None:
+        ws = self._disc_watchers.get(id(sess))
+        if ws and fut in ws:
+            ws.remove(fut)
+            if not ws:
+                del self._disc_watchers[id(sess)]
 
     def get(self, client_id: str) -> Optional[ClientSession]:
         s = self._sessions.get(client_id)

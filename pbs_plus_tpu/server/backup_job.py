@@ -46,6 +46,20 @@ READ_BLOCK = 8 << 20          # agentfs read granularity
 QUEUE_DEPTH = 8               # prefetched blocks in flight
 
 _SENTINEL = object()
+_ABORTED = object()
+
+
+def _get_abortable(q: "queue.Queue", abort: "threading.Event | None"):
+    """Blocking queue get that returns _ABORTED instead of waiting
+    forever once ``abort`` is set (producers cancelled mid-flight never
+    send their sentinels).  The single polling idiom for every
+    writer-side wait in this module."""
+    while True:
+        try:
+            return q.get(timeout=0.25)
+        except queue.Empty:
+            if abort is not None and abort.is_set():
+                return _ABORTED
 
 
 def validate_chunker_kind(kind: str) -> None:
@@ -110,8 +124,9 @@ class _QueuePumpReader:
     """File-like .read(n) fed by a thread-safe queue of blocks (async
     producer / sync writer-thread consumer)."""
 
-    def __init__(self, q: "queue.Queue"):
+    def __init__(self, q: "queue.Queue", abort: "threading.Event | None" = None):
         self._q = q
+        self._abort = abort
         self._buf = b""
         self._eof = False
         # set by the writer thread when it dies: the async producer checks
@@ -121,7 +136,12 @@ class _QueuePumpReader:
 
     def read(self, n: int = -1) -> bytes:
         while not self._buf and not self._eof:
-            item = self._q.get()
+            item = _get_abortable(self._q, self._abort)
+            if item is _ABORTED:
+                # producer was cancelled mid-file; no sentinel will
+                # ever come — fail the writer instead of hanging
+                self._eof = True
+                raise RuntimeError("backup aborted mid-file")
             if item is _SENTINEL:
                 self._eof = True
                 break
@@ -155,6 +175,9 @@ class RemoteTreeBackup:
         self._wq: queue.Queue = queue.Queue(maxsize=QUEUE_DEPTH)
         self._writer_exc: BaseException | None = None
         self._seen_inodes: dict[tuple[int, int], str] = {}
+        # set when run() is cancelled (job kill): the writer thread must
+        # exit without waiting for sentinels a dead producer never sends
+        self._abort = threading.Event()
 
     def _excluded(self, rel: str) -> bool:
         for pat in self.exclusions:
@@ -193,12 +216,30 @@ class RemoteTreeBackup:
             await self._put(e if isinstance(e, Exception) else RuntimeError(str(e)))
             raise
         finally:
-            await self._put(_SENTINEL)
-            await asyncio.get_running_loop().run_in_executor(
-                None, writer_thread.join)
+            # the sync abort flag ALWAYS lands, even if the awaits below
+            # are interrupted by task cancellation — the writer thread
+            # then self-drains and exits instead of blocking forever
+            self._abort.set()
+            closer = asyncio.ensure_future(self._close_writer(writer_thread))
+            try:
+                await asyncio.shield(closer)
+            except asyncio.CancelledError:
+                # finish the join before propagating so no caller ever
+                # observes run() "done" with the writer still streaming
+                if not closer.done():
+                    try:
+                        await closer
+                    except (asyncio.CancelledError, Exception):
+                        pass
+                raise
         if self._writer_exc is not None:
             raise self._writer_exc
         return self.result
+
+    async def _close_writer(self, writer_thread: threading.Thread) -> None:
+        await self._put(_SENTINEL)
+        await asyncio.get_running_loop().run_in_executor(
+            None, writer_thread.join)
 
     async def _put(self, item) -> None:
         await asyncio.get_running_loop().run_in_executor(
@@ -243,7 +284,7 @@ class RemoteTreeBackup:
             self.result.errors.append(f"{rel}: open: {e}")
             return
         fq: queue.Queue = queue.Queue(maxsize=QUEUE_DEPTH)
-        reader = _QueuePumpReader(fq)
+        reader = _QueuePumpReader(fq, self._abort)
         await self._put(("file", entry, reader))
         off = 0
         try:
@@ -271,29 +312,55 @@ class RemoteTreeBackup:
                 pass
         self.result.files += 1
 
-    @staticmethod
-    def _drain_reader(reader) -> None:
+    def _drain_reader(self, reader) -> None:
         """Unblock the async producer of a dropped/aborted file: mark the
         reader dead (producer stops reading ahead) and consume its block
         queue until the producer's closing sentinel so any in-flight
         fq.put is released (advisor finding r1: the S3 writer drained its
-        file queue on error; this path previously did not)."""
+        file queue on error; this path previously did not).  Under abort
+        (producer cancelled) the sentinel may never come — bounded
+        timeout-gets instead of waiting forever."""
         if reader is None or reader._eof:
             # _eof ⇒ the producer's closing sentinel was already consumed
             # (nothing more will arrive; a blocking get would never return)
             return
         reader.dead = True
         while True:
-            item = reader._q.get()
-            if item is _SENTINEL or isinstance(item, BaseException):
+            item = _get_abortable(reader._q, self._abort)
+            if item is _ABORTED or item is _SENTINEL or \
+                    isinstance(item, BaseException):
                 return
+
+    def _nowait_drain_all(self, current) -> None:
+        """Abort path: free every blocked executor-thread put without
+        waiting for producers that were cancelled mid-flight."""
+        def drain_q(q: "queue.Queue") -> None:
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    return
+        if current is not None:
+            current.dead = True
+            drain_q(current._q)
+        while True:
+            try:
+                item = self._wq.get_nowait()
+            except queue.Empty:
+                return
+            if isinstance(item, tuple) and item[0] == "file":
+                item[2].dead = True
+                drain_q(item[2]._q)
 
     def _writer_loop(self) -> None:
         w = self.session.writer
         current = None
         try:
             while True:
-                item = self._wq.get()
+                item = _get_abortable(self._wq, self._abort)
+                if item is _ABORTED:
+                    self._nowait_drain_all(current)
+                    return
                 if item is _SENTINEL:
                     return
                 if isinstance(item, BaseException):
@@ -311,7 +378,10 @@ class RemoteTreeBackup:
             # in-flight file first, then every dropped item in _wq
             self._drain_reader(current)
             while True:
-                item = self._wq.get()
+                item = _get_abortable(self._wq, self._abort)
+                if item is _ABORTED:
+                    self._nowait_drain_all(None)
+                    return
                 if item is _SENTINEL or isinstance(item, BaseException):
                     return
                 if isinstance(item, tuple) and item[0] == "file":
@@ -366,7 +436,31 @@ async def run_backup_job(row: database.BackupJobRow, *,
                 fs, session,
                 exclusions=row.exclusions + db.list_exclusions(row.id),
                 job_log=log)
-            result = await pump.run()
+            # crashed-job detection: race the pump against the job
+            # session's disconnect (reference: arpcfs crashed-agent
+            # pattern — control plane up, job session severed)
+            disc = agents.watch_disconnect(job_sess_info)
+            pump_task = asyncio.ensure_future(pump.run())
+            try:
+                await asyncio.wait({pump_task, disc},
+                                   return_when=asyncio.FIRST_COMPLETED)
+                if not pump_task.done():
+                    pump_task.cancel()
+                    await asyncio.gather(pump_task, return_exceptions=True)
+                    raise RuntimeError(
+                        "agent job session lost mid-backup "
+                        f"({job_sess_info.client_id})")
+                result = await pump_task
+            finally:
+                agents.unwatch_disconnect(job_sess_info, disc)
+                if not disc.done():
+                    disc.cancel()
+                # outer cancellation (job kill, server stop) must not
+                # orphan the pump: its writer would keep streaming into
+                # a session about to be aborted
+                if not pump_task.done():
+                    pump_task.cancel()
+                    await asyncio.gather(pump_task, return_exceptions=True)
             manifest = await asyncio.get_running_loop().run_in_executor(
                 None, session.finish,
                 {"job": row.id, "errors": pump.result.errors[:100]})

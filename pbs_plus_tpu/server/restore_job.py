@@ -51,9 +51,31 @@ async def run_restore_job(server, rid: str, *, target: str, snapshot: str,
             "restore", {"job_id": rid, "destination": destination},
             timeout=60)
         sess = await agents.wait_session(client_id, timeout=60)
-        # the agent drives; we wait for its session to close (or "done")
-        while not sess.conn.closed and not remote.done:
-            await asyncio.sleep(0.2)
+        # the agent drives; we wait for its "done" or its session death.
+        # A severed session without "done" is a crashed restore — never
+        # record success for it (crashed-job detection, reference:
+        # internal/server/vfs/arpcfs/fs.go:119-148)
+        disc = agents.watch_disconnect(sess)
+        try:
+            while not sess.conn.closed and not remote.done:
+                done_set, _ = await asyncio.wait(
+                    {disc}, timeout=0.2,
+                    return_when=asyncio.FIRST_COMPLETED)
+                if done_set:
+                    break
+        finally:
+            agents.unwatch_disconnect(sess, disc)
+            if not disc.done():
+                disc.cancel()
+        if not remote.done:
+            # grace for the in-flight "done" handler racing the close
+            for _ in range(10):
+                await asyncio.sleep(0.05)
+                if remote.done:
+                    break
+        if not remote.done:
+            raise RuntimeError(
+                f"agent restore session lost before completion ({client_id})")
         db.update_restore(rid, database.STATUS_SUCCESS)
         log.info("restore served: done=%s", remote.done)
         return {"done": remote.done}
