@@ -16,6 +16,7 @@ supports subprocess isolation via ``python -m pbs_plus_tpu.agent.cli``.
 from __future__ import annotations
 
 import asyncio
+import os
 import random
 from dataclasses import dataclass, field
 from typing import Optional
@@ -35,9 +36,10 @@ BACKOFF_MAX_S = 30.0
 class ActiveJob:
     job_id: str
     kind: str                    # backup | restore
-    conn: MuxConnection
+    conn: MuxConnection | None
     snapshot: Snapshot | None
     task: asyncio.Task | None = None
+    proc: "asyncio.subprocess.Process | None" = None   # subprocess isolation
 
 
 @dataclass
@@ -46,6 +48,9 @@ class AgentConfig:
     server_host: str
     server_port: int
     tls: TlsClientConfig
+    # "subprocess" = fork-per-job (reference: cli.Entry re-exec,
+    # internal/agent/cli/entry.go:14-88); "task" = in-process asyncio
+    job_isolation: str = "task"
 
 
 class AgentLifecycle:
@@ -112,6 +117,16 @@ class AgentLifecycle:
         source = req.payload["source"]
         if job_id in self.jobs:
             return {"ok": True, "already": True}
+        if self.config.job_isolation == "subprocess":
+            from .jobproc import spawn_job_child
+            proc = await spawn_job_child("backup", job_id, self.config,
+                                         source=source)
+            job = ActiveJob(job_id, "backup", None, None, proc=proc)
+            job.task = asyncio.create_task(self._reap_child(job))
+            self.jobs[job_id] = job
+            self.log.info("backup job child spawned (pid %d)", proc.pid)
+            return {"ok": True, "snapshot_method": "child",
+                    "pid": proc.pid}
         snap = await asyncio.get_running_loop().run_in_executor(
             None, self.snapshots.create, source)
         try:
@@ -140,6 +155,14 @@ class AgentLifecycle:
         dest = req.payload["destination"]
         if job_id in self.jobs:
             return {"ok": True, "already": True}
+        if self.config.job_isolation == "subprocess":
+            from .jobproc import spawn_job_child
+            proc = await spawn_job_child("restore", job_id, self.config,
+                                         destination=dest)
+            job = ActiveJob(job_id, "restore", None, None, proc=proc)
+            job.task = asyncio.create_task(self._reap_child(job))
+            self.jobs[job_id] = job
+            return {"ok": True, "pid": proc.pid}
         conn = await connect_to_server(
             self.config.server_host, self.config.server_port,
             self.config.tls, headers={HDR_RESTORE_ID: job_id})
@@ -171,16 +194,53 @@ class AgentLifecycle:
             self.jobs.pop(job.job_id, None)
             self.log.info("backup job session closed")
 
+    @staticmethod
+    def _remove_handoff(proc) -> None:
+        """A child killed before consuming its one-time handoff leaves it
+        behind — remove it so no job parameters linger on disk.  Called
+        from every teardown path (reaper AND cleanup RPC)."""
+        path = getattr(proc, "handoff_path", "")
+        if path and os.path.exists(path):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    async def _reap_child(self, job: ActiveJob) -> None:
+        """Wait for a job child to exit; keep the job table accurate."""
+        assert job.proc is not None
+        rc = await job.proc.wait()
+        self.jobs.pop(job.job_id, None)
+        self._remove_handoff(job.proc)
+        self.log.info("job child %s exited rc=%s", job.job_id, rc)
+
     async def _cleanup(self, req, ctx):
-        """Kill a job session (reference: sync/backup.go:69-100)."""
+        """Kill a job session (reference: sync/backup.go:69-100 — the
+        parent terminates the forked child; the child's own teardown
+        releases its snapshot)."""
         job_id = req.payload["job_id"]
         job = self.jobs.pop(job_id, None)
         if job is not None:
-            await job.conn.close()
-            if job.task:
+            if job.proc is not None and job.proc.returncode is None:
+                job.proc.terminate()
                 try:
-                    await asyncio.wait_for(job.task, 10)
-                except (asyncio.TimeoutError, Exception):
+                    await asyncio.wait_for(job.proc.wait(), 10)
+                except asyncio.TimeoutError:
+                    job.proc.kill()
+            if job.proc is not None:
+                self._remove_handoff(job.proc)
+            if job.conn is not None:
+                await job.conn.close()
+            if job.task:
+                job.task.cancel()
+                try:
+                    # gather absorbs the task's own CancelledError so the
+                    # handler still returns its RPC response; our OWN
+                    # cancellation (wait_for raising) still propagates
+                    await asyncio.wait_for(
+                        asyncio.gather(job.task, return_exceptions=True),
+                        10)
+                except asyncio.TimeoutError:
                     pass
         return {"ok": True, "found": job is not None}
 
@@ -239,8 +299,15 @@ class AgentLifecycle:
         await self.router.serve_connection(self.conn)
 
     async def stop(self) -> None:
+        """Stop the daemon.  Subprocess jobs are NOT killed — they own
+        their snapshots and data sessions, finish serving, and clean up
+        themselves (reference: child survives the service, snapshot
+        lifetime tied to the job)."""
         self._stop.set()
         for job in list(self.jobs.values()):
-            await job.conn.close()
+            if job.conn is not None:
+                await job.conn.close()
+            if job.task is not None and job.proc is not None:
+                job.task.cancel()       # stop reaping; child lives on
         if self.conn is not None:
             await self.conn.close()

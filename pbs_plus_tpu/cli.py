@@ -98,13 +98,19 @@ def _cmd_agent(args: argparse.Namespace) -> int:
         host, port = args.server.rsplit(":", 1)
         agent = AgentLifecycle(AgentConfig(
             hostname=args.hostname, server_host=host, server_port=int(port),
-            tls=TlsClientConfig(cert_p, key_p, ca_p)))
+            tls=TlsClientConfig(cert_p, key_p, ca_p),
+            job_isolation=args.job_isolation))
         await agent.run()
     try:
         asyncio.run(main())
     except KeyboardInterrupt:
         pass
     return 0
+
+
+def _cmd_agent_job(args: argparse.Namespace) -> int:
+    from .agent.jobproc import run_child
+    return run_child(args.config)
 
 
 def _cmd_mount(args: argparse.Namespace) -> int:
@@ -231,7 +237,17 @@ def main(argv: list[str] | None = None) -> int:
     a.add_argument("--bootstrap-url", default="",
                    help="http(s)://server:web-port for first-time bootstrap")
     a.add_argument("--bootstrap-token", default="", help="token_id:secret_hex")
+    a.add_argument("--job-isolation", choices=["task", "subprocess"],
+                   default="subprocess",
+                   help="run jobs as forked child processes (default) or "
+                        "in-process asyncio tasks")
     a.set_defaults(fn=_cmd_agent)
+
+    aj = sub.add_parser("agent-job",
+                        help="(internal) forked job child entrypoint")
+    aj.add_argument("--config", required=True,
+                    help="one-time handoff file from the agent daemon")
+    aj.set_defaults(fn=_cmd_agent_job)
 
     m = sub.add_parser("mount", help="serve a mutable archive mount")
     m.add_argument("--store", required=True)

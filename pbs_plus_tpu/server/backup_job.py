@@ -474,6 +474,15 @@ async def run_backup_job(row: database.BackupJobRow, *,
             raise
     finally:
         agents.unexpect(client_id)
+        # the server owns the client end of the job data session — close
+        # it so a fork-isolated agent child sees EOF and can wind down
+        # even when the daemon (and its "cleanup" RPC) is gone
+        try:
+            sess_info = agents.get(client_id)
+            if sess_info is not None:
+                await sess_info.conn.close()
+        except Exception:
+            pass
         # tear down the agent-side job session (reference: "cleanup" RPC)
         try:
             await control_sess.call("cleanup", {"job_id": job_id}, timeout=15)
