@@ -179,18 +179,34 @@ def test_kill_restore_session_is_error_not_success(tmp_path):
             dest = tmp_path / "rdest"
             server.db.create_restore("rx", "agent-x", snap, str(dest))
 
+            # hold the agent's engine briefly so the server has picked up
+            # the session before the kill lands (otherwise the abort can
+            # race wait_session and turn into a 60 s timeout instead)
+            from pbs_plus_tpu.agent import restore as agent_restore
+            orig_run = agent_restore.RestoreEngine.run
+
+            async def slow_run(self):
+                await asyncio.sleep(0.5)
+                return await orig_run(self)
+            agent_restore.RestoreEngine.run = slow_run
+
             async def killer():
                 for _ in range(400):
                     for s in server.agents.sessions():
                         if s.client_id.endswith("|restore"):
-                            s.conn.writer.transport.abort()   # mid-transfer
+                            await asyncio.sleep(0.1)
+                            s.conn.writer.transport.abort()
                             return
                     await asyncio.sleep(0.01)
 
             kt = asyncio.create_task(killer())
-            with pytest.raises(RuntimeError, match="lost"):
-                await run_restore_job(server, "rx", target="agent-x",
-                                      snapshot=snap, destination=str(dest))
+            try:
+                with pytest.raises(RuntimeError, match="lost"):
+                    await run_restore_job(server, "rx", target="agent-x",
+                                          snapshot=snap,
+                                          destination=str(dest))
+            finally:
+                agent_restore.RestoreEngine.run = orig_run
             await kt
             assert server.db.get_restore("rx")["status"] == \
                 database.STATUS_ERROR
