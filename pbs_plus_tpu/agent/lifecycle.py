@@ -51,6 +51,9 @@ class AgentConfig:
     # "subprocess" = fork-per-job (reference: cli.Entry re-exec,
     # internal/agent/cli/entry.go:14-88); "task" = in-process asyncio
     job_isolation: str = "task"
+    # periodic volume-inventory push over the control session
+    # (reference: cmd/agent/main_unix.go:118-148); 0 disables
+    drive_update_interval_s: float = 300.0
 
 
 class AgentLifecycle:
@@ -276,7 +279,24 @@ class AgentLifecycle:
                     self.config.tls)
                 self.log.info("control session connected")
                 backoff = BACKOFF_MIN_S
-                await self.router.serve_connection(self.conn)
+                pusher = None
+                if self.config.drive_update_interval_s > 0:
+                    pusher = asyncio.create_task(
+                        self._drive_pusher(self.conn))
+                try:
+                    await self.router.serve_connection(self.conn)
+                finally:
+                    if pusher is not None:
+                        pusher.cancel()
+                        try:
+                            await pusher
+                        except asyncio.CancelledError:
+                            # only swallow the pusher's own cancellation;
+                            # OUR task being cancelled must propagate
+                            if asyncio.current_task().cancelling():
+                                raise
+                        except Exception:
+                            pass
                 self.log.warning("control session lost: %s",
                                  self.conn.close_reason)
             except asyncio.CancelledError:
@@ -291,6 +311,20 @@ class AgentLifecycle:
                 await asyncio.wait_for(self._stop.wait(), sleep)
             except asyncio.TimeoutError:
                 pass
+
+    async def _drive_pusher(self, conn: MuxConnection) -> None:
+        """Push the volume inventory right after connect, then on the
+        configured interval, while this control session lives."""
+        from .drives import enumerate_drives
+        sess = Session(conn)
+        while not conn.closed:
+            try:
+                ds = await asyncio.get_running_loop().run_in_executor(
+                    None, enumerate_drives)
+                await sess.call("drive_update", {"drives": ds}, timeout=30)
+            except Exception as e:
+                self.log.warning("drive update failed: %s", e)
+            await asyncio.sleep(self.config.drive_update_interval_s)
 
     async def connect_once(self) -> None:
         """Single connect + serve (tests / foreground)."""

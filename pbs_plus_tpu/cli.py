@@ -27,9 +27,11 @@ def _cmd_server(args: argparse.Namespace) -> int:
             datastore_dir=args.datastore, arpc_host=args.host,
             arpc_port=args.arpc_port, chunker=args.chunker,
             chunk_avg=args.chunk_avg))
+        from .server.notify_templates import TemplateSet
+        templates = TemplateSet(os.path.join(args.state_dir, "templates"))
         sink = file_spool_sink(os.path.join(args.state_dir, "notify-spool"))
-        server.notifications = BatchTracker(sink=sink)
-        scanner = AlertScanner(server, sink)
+        server.notifications = BatchTracker(sink=sink, templates=templates)
+        scanner = AlertScanner(server, sink, templates=templates)
         await server.start()
         runner, web_port = await start_web(
             server, host=args.host, port=args.web_port,

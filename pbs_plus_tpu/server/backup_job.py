@@ -392,7 +392,8 @@ async def run_backup_job(row: database.BackupJobRow, *,
                          db: database.Database,
                          agents: AgentsManager,
                          store: LocalStore,
-                         job_suffix: str | None = None) -> BackupResult:
+                         job_suffix: str | None = None,
+                         on_pump=None) -> BackupResult:
     """End-to-end agent backup: ask the agent to open a job session, walk
     its agentfs, stream into a datastore session, publish the snapshot."""
     job_id = job_suffix or f"{row.id}-{uuid.uuid4().hex[:8]}"
@@ -436,6 +437,8 @@ async def run_backup_job(row: database.BackupJobRow, *,
                 fs, session,
                 exclusions=row.exclusions + db.list_exclusions(row.id),
                 job_log=log)
+            if on_pump is not None:
+                on_pump(pump.result)     # live-progress metrics hook
             # crashed-job detection: race the pump against the job
             # session's disconnect (reference: arpcfs crashed-agent
             # pattern — control plane up, job session severed)

@@ -324,6 +324,35 @@ class Database:
             return [dict(r) for r in
                     self._conn.execute("SELECT * FROM agent_hosts")]
 
+    def update_agent_drives(self, hostname: str, drives: list) -> None:
+        """Refresh the volume inventory pushed periodically by the agent
+        (reference: cmd/agent/main_unix.go:118-148 drive updates)."""
+        with self._lock, self._conn:
+            self._conn.execute(
+                "UPDATE agent_hosts SET drives=? WHERE hostname=?",
+                (json.dumps(drives), hostname))
+
+    def file_size(self) -> int:
+        """On-disk size of the database file (metrics)."""
+        with self._lock:
+            try:
+                row = self._conn.execute("PRAGMA database_list").fetchone()
+                return os.path.getsize(row["file"]) if row and row["file"] \
+                    else 0
+            except (sqlite3.Error, OSError):
+                return 0
+
+    def status_counts(self, table: str) -> dict[str, int]:
+        """{status: count} for a job table (metrics)."""
+        if table not in ("restore_jobs", "task_log", "backup_jobs"):
+            raise ValueError(f"no status counts for {table!r}")
+        col = "last_status" if table == "backup_jobs" else "status"
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT {col} AS k, COUNT(*) AS n FROM {table} "
+                f"GROUP BY {col}").fetchall()
+        return {str(r["k"]): int(r["n"]) for r in rows if r["k"]}
+
     def delete_agent_host(self, hostname: str) -> None:
         with self._lock, self._conn:
             self._conn.execute("DELETE FROM agent_hosts WHERE hostname=?",

@@ -7,11 +7,15 @@ Text exposition format rendered directly (no client library needed).
 
 from __future__ import annotations
 
+import json
+import os
 import time
 from typing import TYPE_CHECKING
 
 if TYPE_CHECKING:
     from .store import Server
+
+_DATASTORE_SCAN_TTL = 15.0      # cache the chunk-dir walk between scrapes
 
 
 def _esc(v: str) -> str:
@@ -21,6 +25,28 @@ def _esc(v: str) -> str:
 class MetricsRegistry:
     def __init__(self, server: "Server"):
         self.server = server
+        self._ds_scan: tuple[float, int, int] = (0.0, 0, 0)
+
+    def _datastore_usage(self) -> tuple[int, int]:
+        """(chunk_count, chunk_disk_bytes), cached — walking the chunk
+        dir on every scrape would hammer large datastores."""
+        now = time.monotonic()
+        t, n, b = self._ds_scan
+        if now - t < _DATASTORE_SCAN_TTL:
+            return n, b
+        n = b = 0
+        base = self.server.datastore.datastore.chunks.base
+        for dirpath, _dirs, files in os.walk(base):
+            for f in files:
+                if f.endswith(".tmp"):
+                    continue
+                try:
+                    b += os.path.getsize(os.path.join(dirpath, f))
+                    n += 1
+                except OSError:
+                    pass
+        self._ds_scan = (now, n, b)
+        return n, b
 
     def render(self) -> str:
         s = self.server
@@ -74,5 +100,137 @@ class MetricsRegistry:
               [({"group": g}, float(n)) for g, n in per_group.items()])
         gauge("pbs_plus_snapshot_bytes", "Logical bytes per backup group",
               [({"group": g}, float(n)) for g, n in size_per_group.items()])
+
+        # -- last-run details (reference: per-backup duration/size gauges,
+        #    api/metrics.go:21-344) -----------------------------------------
+        lr = s.last_run_stats
+        gauge("pbs_plus_backup_last_duration_seconds",
+              "Wall-clock duration of the last finished run",
+              [({"job": j}, st["duration"]) for j, st in lr.items()])
+        gauge("pbs_plus_backup_last_bytes",
+              "Bytes streamed by the last finished run",
+              [({"job": j}, float(st["bytes"])) for j, st in lr.items()])
+        gauge("pbs_plus_backup_last_files",
+              "Files streamed by the last finished run",
+              [({"job": j}, float(st["files"])) for j, st in lr.items()])
+        gauge("pbs_plus_backup_last_entries",
+              "Archive entries written by the last finished run",
+              [({"job": j}, float(st["entries"])) for j, st in lr.items()])
+        gauge("pbs_plus_backup_last_error_count",
+              "Per-file errors in the last finished run",
+              [({"job": j}, float(st["errors"])) for j, st in lr.items()])
+
+        # -- live speeds for running jobs (reference: live bytes/files
+        #    speed gauges) ---------------------------------------------------
+        now = time.time()
+        live_bytes, live_files, live_speed = [], [], []
+        for job_id, (t0, res) in list(s.live_progress.items()):
+            if res is None:
+                continue
+            el = max(now - t0, 1e-3)
+            live_bytes.append(({"job": job_id}, float(res.bytes_total)))
+            live_files.append(({"job": job_id}, float(res.files)))
+            live_speed.append(({"job": job_id}, res.bytes_total / el))
+        gauge("pbs_plus_backup_live_bytes",
+              "Bytes streamed so far by a running job", live_bytes)
+        gauge("pbs_plus_backup_live_files",
+              "Files completed so far by a running job", live_files)
+        gauge("pbs_plus_backup_live_speed_bytes_per_second",
+              "Average throughput of a running job", live_speed)
+
+        # -- schedules --------------------------------------------------------
+        import datetime as _dt
+
+        from ..utils import calendar
+        next_runs = []
+        for j in jobs:
+            if j.schedule and j.enabled:
+                try:
+                    # naive LOCAL time, matching the scheduler's own
+                    # reference clock — a tz-aware base here would skew
+                    # the gauge by the host's UTC offset
+                    after = _dt.datetime.fromtimestamp(j.last_run_at or now)
+                    nxt = calendar.compute_next_event(j.schedule, after)
+                    if nxt is not None:
+                        next_runs.append(({"job": j.id}, nxt.timestamp()))
+                except ValueError:
+                    pass
+        gauge("pbs_plus_backup_next_run_timestamp",
+              "Next scheduled run (unix time)", next_runs)
+        gauge("pbs_plus_backup_jobs_configured", "Configured backup jobs",
+              [({}, float(len(jobs)))])
+        gauge("pbs_plus_backup_jobs_by_status", "Backup jobs by last status",
+              [({"status": k}, float(v))
+               for k, v in s.db.status_counts("backup_jobs").items()])
+
+        # -- restores / tasks -------------------------------------------------
+        gauge("pbs_plus_restores_by_status", "Restore jobs by status",
+              [({"status": k}, float(v))
+               for k, v in s.db.status_counts("restore_jobs").items()])
+        gauge("pbs_plus_tasks_by_status", "Task log entries by status",
+              [({"status": k}, float(v))
+               for k, v in s.db.status_counts("task_log").items()])
+
+        # -- agents / targets (reference: per-target volume usage) -----------
+        sess_by_cn = {x.cn: x for x in s.agents.sessions()
+                      if x.client_id == x.cn}
+        hosts = s.db.list_agent_hosts()
+        gauge("pbs_plus_agents_known", "Bootstrapped agent hosts",
+              [({}, float(len(hosts)))])
+        gauge("pbs_plus_agent_connected", "1 while the agent control "
+              "session is up",
+              [({"host": h["hostname"]},
+                1.0 if h["hostname"] in sess_by_cn else 0.0)
+               for h in hosts])
+        gauge("pbs_plus_agent_session_age_seconds",
+              "Age of the live control session",
+              [({"host": cn}, now - x.connected_at)
+               for cn, x in sess_by_cn.items()])
+        vol_total, vol_free = [], []
+        for h in hosts:
+            try:
+                drives = json.loads(h.get("drives") or "[]")
+            except ValueError:
+                continue
+            for d in drives:
+                lbl = {"host": h["hostname"],
+                       "mountpoint": str(d.get("mountpoint", ""))}
+                if "size_bytes" in d:
+                    vol_total.append((lbl, float(d["size_bytes"] or 0)))
+                if "free_bytes" in d:
+                    vol_free.append((lbl, float(d["free_bytes"] or 0)))
+        gauge("pbs_plus_target_volume_size_bytes",
+              "Per-target volume capacity (agent drive inventory)",
+              vol_total)
+        gauge("pbs_plus_target_volume_free_bytes",
+              "Per-target volume free space (agent drive inventory)",
+              vol_free)
+        targets = s.db.list_targets()
+        gauge("pbs_plus_targets_configured", "Configured targets",
+              [({}, float(len(targets)))])
+        gauge("pbs_plus_target_online_timestamp",
+              "Last successful target_status probe (unix time)",
+              [({"target": t["name"]}, float(t.get("online_at") or 0))
+               for t in targets])
+
+        # -- datastore usage / dedup ------------------------------------------
+        chunk_n, chunk_b = self._datastore_usage()
+        logical = float(sum(size_per_group.values()))
+        gauge("pbs_plus_datastore_chunks", "Chunks in the store",
+              [({}, float(chunk_n))])
+        gauge("pbs_plus_datastore_disk_bytes",
+              "Compressed on-disk chunk bytes", [({}, float(chunk_b))])
+        gauge("pbs_plus_datastore_dedup_ratio",
+              "Logical snapshot bytes / on-disk chunk bytes",
+              [({}, logical / chunk_b)] if chunk_b else [])
+
+        # -- mounts / server --------------------------------------------------
+        ms = getattr(s, "mount_service", None)
+        gauge("pbs_plus_mounts_active", "Active snapshot mounts",
+              [({}, float(len(ms.mounts) if ms else 0))])
+        gauge("pbs_plus_uptime_seconds", "Server uptime",
+              [({}, now - s.started_at)])
+        gauge("pbs_plus_db_bytes", "SQLite database size",
+              [({}, float(s.db.file_size()))])
         gauge("pbs_plus_scrape_timestamp", "Scrape time", [({}, time.time())])
         return "\n".join(lines) + "\n"

@@ -22,6 +22,8 @@ from typing import Callable
 
 from ..utils.log import L
 
+from .notify_templates import TemplateSet
+
 Sink = Callable[[str, str, dict], None]     # (severity, title, body)
 
 
@@ -59,6 +61,7 @@ class BatchTracker:
 
     sink: Sink
     window_s: float = 60.0
+    templates: TemplateSet = field(default_factory=TemplateSet)
     _results: list[dict] = field(default_factory=list)
     _flush_task: asyncio.Task | None = None
 
@@ -84,10 +87,13 @@ class BatchTracker:
         bad = [r for r in results if r["status"] not in ("success",)]
         severity = "error" if any(r["status"] == "error" for r in results) \
             else ("warning" if bad else "info")
+        body = {"results": results, "total": len(results),
+                "ok_count": len(results) - len(bad), "bad_count": len(bad)}
+        body["text"] = self.templates.render("batch-summary", body)
         self.sink(severity,
                   f"{len(results)} job(s): "
                   f"{len(results) - len(bad)} ok, {len(bad)} not ok",
-                  {"results": results})
+                  body)
 
 
 class AlertScanner:
@@ -95,12 +101,22 @@ class AlertScanner:
 
     def __init__(self, server, sink: Sink, *, interval_s: float = 3600.0,
                  stale_after_s: float = 2 * 86400.0,
-                 cooldown_s: float = 6 * 3600.0):
+                 cooldown_s: float = 6 * 3600.0,
+                 quiet_days: set[int] | None = None,
+                 quiet_hours: tuple[int, int] | None = None,
+                 templates: TemplateSet | None = None):
+        """``quiet_days`` (0=Mon..6=Sun) and ``quiet_hours`` ([start,end)
+        local hours, may wrap midnight) suppress warning-level alerts —
+        errors always deliver (reference: scanner cooldown/quiet-days,
+        internal/server/notification/scanner.go:17-206)."""
         self.server = server
         self.sink = sink
         self.interval_s = interval_s
         self.stale_after_s = stale_after_s
         self.cooldown_s = cooldown_s
+        self.quiet_days = quiet_days or set()
+        self.quiet_hours = quiet_hours
+        self.templates = templates or TemplateSet()
         self._last_alert: dict[str, float] = {}
         self._stop = asyncio.Event()
 
@@ -110,24 +126,47 @@ class AlertScanner:
         for j in self.server.db.list_backup_jobs(enabled_only=True):
             if j.schedule and (j.last_run_at or 0) < now - self.stale_after_s:
                 alerts.append(("warning", f"backup {j.id} is stale",
-                               {"job": j.id, "last_run_at": j.last_run_at}))
+                               {"template": "alert-stale-backup",
+                                "job": j.id, "last_run": j.last_run_at,
+                                "schedule": j.schedule}))
             if j.last_status == "error":
                 alerts.append(("error", f"backup {j.id} failing",
-                               {"job": j.id, "error": j.last_error}))
+                               {"template": "alert-backup-failing",
+                                "job": j.id, "error": j.last_error}))
         connected = {s.cn for s in self.server.agents.sessions()}
         for t in self.server.db.list_targets():
             if t["kind"] == "agent" and t["hostname"] not in connected:
                 alerts.append(("warning",
                                f"target {t['name']} offline",
-                               {"target": t["name"]}))
+                               {"template": "alert-target-offline",
+                                "target": t["name"]}))
         return alerts
+
+    def _quiet_now(self, now: float) -> bool:
+        lt = time.localtime(now)
+        if lt.tm_wday in self.quiet_days:
+            return True
+        if self.quiet_hours is not None:
+            a, b = self.quiet_hours
+            h = lt.tm_hour
+            return (a <= h < b) if a <= b else (h >= a or h < b)
+        return False
 
     def _emit(self, alerts) -> None:
         now = time.time()
+        quiet = self._quiet_now(now)
         for severity, title, body in alerts:
+            if quiet and severity != "error":
+                continue                 # warnings wait out quiet windows
             if now - self._last_alert.get(title, 0) < self.cooldown_s:
                 continue
             self._last_alert[title] = now
+            tmpl = body.get("template")
+            if tmpl:
+                try:
+                    body = dict(body, text=self.templates.render(tmpl, body))
+                except KeyError:
+                    pass
             self.sink(severity, title, body)
 
     async def run(self) -> None:

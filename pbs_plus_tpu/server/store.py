@@ -96,6 +96,11 @@ class Server:
         self.mount_service = None       # lazily created by the web layer
         self._tasks: list[asyncio.Task] = []
         self.log = L.with_scope(component="server")
+        # observability state (metrics.py): live per-job progress objects
+        # and the last finished run's stats, both in-memory
+        self.started_at = time.time()
+        self.live_progress: dict[str, tuple[float, object]] = {}
+        self.last_run_stats: dict[str, dict] = {}
 
     # -- admission ---------------------------------------------------------
     async def _is_expected_host(self, cn: str, cert_der: bytes) -> bool:
@@ -118,6 +123,33 @@ class Server:
         async def ping(req, ctx):
             return {"pong": True}
         self.router.handle("ping", ping)
+
+        async def drive_update(req, ctx):
+            """Agent-pushed volume inventory (reference: periodic drive
+            updates, cmd/agent/main_unix.go:118-148) — feeds the
+            per-target volume-usage metrics."""
+            cn = getattr(ctx, "cn", "")
+            if not cn:
+                return {"ok": False}
+            drives = req.payload.get("drives", [])
+            if not isinstance(drives, list):
+                return {"ok": False}
+            # sanitize per item: a malformed entry must never be able to
+            # poison the DB row and 500 every later /metrics scrape
+            clean = []
+            for d in drives[:64]:
+                if not isinstance(d, dict):
+                    continue
+                clean.append({
+                    "name": str(d.get("name", ""))[:128],
+                    "mountpoint": str(d.get("mountpoint", ""))[:256],
+                    "fstype": str(d.get("fstype", ""))[:64],
+                    "size_bytes": int(d.get("size_bytes") or 0),
+                    "free_bytes": int(d.get("free_bytes") or 0),
+                })
+            self.db.update_agent_drives(cn, clean)
+            return {"ok": True}
+        self.router.handle("drive_update", drive_update)
 
     # -- aRPC listener -----------------------------------------------------
     async def start_arpc(self) -> int:
@@ -267,9 +299,16 @@ class Server:
         async def execute():
             async with self.jobs.startup_mu:   # serialize session startups
                 pass
+            t0 = time.time()
+            self.live_progress[row.id] = (t0, None)
+
+            def on_pump(result):
+                self.live_progress[row.id] = (t0, result)
             res = await run_backup_job(
-                row, db=self.db, agents=self.agents, store=store)
+                row, db=self.db, agents=self.agents, store=store,
+                on_pump=on_pump)
             result_box["res"] = res
+            result_box["t0"] = t0
             self.db.append_task_log(
                 upid, f"backup complete: {res.entries} entries, "
                       f"{res.bytes_total} bytes -> {res.snapshot}")
@@ -280,6 +319,13 @@ class Server:
             res = result_box.get("res")
             status = (database.STATUS_WARNING
                       if res and res.errors else database.STATUS_SUCCESS)
+            self.live_progress.pop(row.id, None)
+            if res is not None:
+                self.last_run_stats[row.id] = {
+                    "duration": time.time() - result_box.get("t0",
+                                                             time.time()),
+                    "bytes": res.bytes_total, "files": res.files,
+                    "entries": res.entries, "errors": len(res.errors)}
             self.db.finish_task(upid, status)
             self.db.record_backup_result(
                 row.id, status, snapshot=res.snapshot if res else "")
@@ -288,6 +334,7 @@ class Server:
                 self.notifications.record(row.id, status)
 
         async def on_error(exc: BaseException):
+            self.live_progress.pop(row.id, None)
             self.db.append_task_log(upid, f"error: {exc}")
             self.db.finish_task(upid, database.STATUS_ERROR)
             self.db.record_backup_result(row.id, database.STATUS_ERROR,

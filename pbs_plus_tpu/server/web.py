@@ -159,8 +159,11 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
                                      status=503)
 
     async def metrics_handler(request):
-        return web.Response(text=metrics.render(),
-                            content_type="text/plain")
+        # render() does sync DB queries and (on cache expiry) a chunk-dir
+        # walk — keep the whole scrape off the event loop
+        text = await asyncio.get_running_loop().run_in_executor(
+            None, metrics.render)
+        return web.Response(text=text, content_type="text/plain")
 
     # -- agent bootstrap / renew ------------------------------------------
     async def agent_bootstrap(request):

@@ -72,3 +72,67 @@ def test_alert_scanner(tmp_path):
         assert len(events) == n
         await server.stop()
     asyncio.run(main())
+
+
+def test_templates_render():
+    """Template layer (reference: 28 .hbs templates): vars, #if, #each,
+    nesting, and file overrides."""
+    from pbs_plus_tpu.server.notify_templates import TemplateSet, render
+
+    ts = TemplateSet()
+    out = ts.render("backup-success", {
+        "job": "nightly", "snapshot": "host/a/t", "entries": 10,
+        "files": 7, "bytes": 1234, "duration": 2.5})
+    assert "Backup nightly succeeded" in out and "host/a/t" in out
+
+    out = ts.render("batch-summary", {
+        "total": 2, "ok_count": 1, "bad_count": 1,
+        "results": [{"job": "a", "status": "success", "detail": ""},
+                    {"job": "b", "status": "error", "detail": "boom"}]})
+    assert " - a: success\n" in out
+    assert " - b: error (boom)\n" in out          # #if nested in #each
+
+    out = ts.render("verification-report", {
+        "job": "v1", "checked": 5, "corrupt_count": 0, "corrupt": [],
+        "ok": True})
+    assert "verified OK" in out and "CORRUPT" not in out
+
+    assert render("{{a.b}}", {"a": {"b": "deep"}}) == "deep"
+
+
+def test_template_file_override(tmp_path):
+    from pbs_plus_tpu.server.notify_templates import TemplateSet
+    (tmp_path / "backup-error.tmpl").write_text("custom: {{job}} / {{error}}")
+    ts = TemplateSet(str(tmp_path))
+    assert ts.render("backup-error", {"job": "x", "error": "e"}) == \
+        "custom: x / e"
+    # unknown names still raise
+    import pytest
+    with pytest.raises(KeyError):
+        ts.render("nope", {})
+
+
+def test_alert_scanner_quiet_windows(tmp_path):
+    """Warnings are suppressed during quiet days/hours; errors always
+    deliver (reference: scanner cooldown/quiet-days)."""
+    async def main():
+        server = Server(ServerConfig(
+            state_dir=str(tmp_path / "s"), cert_dir=str(tmp_path / "c"),
+            datastore_dir=str(tmp_path / "d"), max_concurrent=2))
+        await server.start()
+        server.db.upsert_backup_job(database.BackupJobRow(
+            id="stale", target="t1", source_path="/", schedule="daily"))
+        server.db.upsert_backup_job(database.BackupJobRow(
+            id="failing", target="t1", source_path="/"))
+        server.db.record_backup_result("failing", database.STATUS_ERROR,
+                                       error="bad")
+        events = []
+        sc = AlertScanner(server, sink=lambda s, t, b: events.append((s, t, b)),
+                          quiet_days={0, 1, 2, 3, 4, 5, 6})   # always quiet
+        sc._emit(sc.scan())
+        sevs = {s for s, _, _ in events}
+        assert sevs == {"error"}          # warnings held back
+        # rendered template text is attached
+        assert any("failing" in b.get("text", "") for _, _, b in events)
+        await server.stop()
+    asyncio.run(main())
