@@ -148,6 +148,16 @@ _MIGRATIONS: list[str] = [
         comment TEXT NOT NULL DEFAULT ''
     );
     """,
+    # 005 — reusable hook scripts
+    """
+    CREATE TABLE scripts (
+        name TEXT PRIMARY KEY,
+        content TEXT NOT NULL,
+        description TEXT NOT NULL DEFAULT '',
+        created_at REAL NOT NULL,
+        updated_at REAL NOT NULL
+    );
+    """,
 ]
 
 
@@ -291,6 +301,10 @@ class Database:
             out.append(d)
         return out
 
+    def delete_target(self, name: str) -> None:
+        with self._lock, self._conn:
+            self._conn.execute("DELETE FROM targets WHERE name=?", (name,))
+
     def touch_target_online(self, name: str) -> None:
         with self._lock, self._conn:
             self._conn.execute(
@@ -395,6 +409,14 @@ class Database:
             self._conn.execute("UPDATE tokens SET revoked=1 WHERE id=?",
                                (token_id,))
 
+    def list_tokens(self) -> list[dict]:
+        """Token metadata only — sealed secrets never leave the DB."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT id, kind, created_at, expires_at, revoked "
+                "FROM tokens").fetchall()
+        return [dict(r) for r in rows]
+
     # -- restores ------------------------------------------------------------
     def create_restore(self, rid: str, target: str, snapshot: str,
                        destination: str, subpath: str = "") -> None:
@@ -448,6 +470,69 @@ class Database:
                    last_report=? WHERE id=?""",
                 (time.time(), status, json.dumps(report), vid))
 
+    # -- hook scripts (reference: Script entity + PBS_PLUS__* env
+    #    protocol, internal/server/jobs/{env,shell}.go) ----------------------
+    def upsert_script(self, name: str, content: str,
+                      description: str = "") -> None:
+        from ..utils import validate
+        validate.job_id(name)
+        with self._lock, self._conn:
+            self._conn.execute(
+                """INSERT INTO scripts (name,content,description,created_at,
+                   updated_at) VALUES (?,?,?,?,?)
+                   ON CONFLICT(name) DO UPDATE SET content=excluded.content,
+                     description=excluded.description,
+                     updated_at=excluded.updated_at""",
+                (name, content, description, time.time(), time.time()))
+
+    def get_script(self, name: str) -> Optional[dict]:
+        with self._lock:
+            r = self._conn.execute(
+                "SELECT * FROM scripts WHERE name=?", (name,)).fetchone()
+        return dict(r) if r else None
+
+    def list_scripts(self) -> list[dict]:
+        with self._lock:
+            return [dict(r) for r in
+                    self._conn.execute("SELECT * FROM scripts")]
+
+    def delete_script(self, name: str) -> None:
+        with self._lock, self._conn:
+            self._conn.execute("DELETE FROM scripts WHERE name=?", (name,))
+
+    # -- alert settings ------------------------------------------------------
+    def get_alert_setting(self, key: str, default: str = "") -> str:
+        with self._lock:
+            r = self._conn.execute(
+                "SELECT value FROM alert_settings WHERE key=?",
+                (key,)).fetchone()
+        return r["value"] if r else default
+
+    def put_alert_setting(self, key: str, value: str) -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                """INSERT INTO alert_settings (key,value) VALUES (?,?)
+                   ON CONFLICT(key) DO UPDATE SET value=excluded.value""",
+                (key, value))
+
+    def list_alert_settings(self) -> dict[str, str]:
+        with self._lock:
+            return {r["key"]: r["value"] for r in self._conn.execute(
+                "SELECT * FROM alert_settings")}
+
+    def list_restores(self, limit: int = 200) -> list[dict]:
+        with self._lock:
+            return [dict(r) for r in self._conn.execute(
+                "SELECT * FROM restore_jobs ORDER BY created_at DESC "
+                "LIMIT ?", (limit,))]
+
+    def get_verification_job(self, vid: str) -> Optional[dict]:
+        with self._lock:
+            r = self._conn.execute(
+                "SELECT * FROM verification_jobs WHERE id=?",
+                (vid,)).fetchone()
+        return dict(r) if r else None
+
     # -- task log (PBS-visible tasks, §2.6) ----------------------------------
     def create_task(self, upid: str, job_id: str, kind: str,
                     detail: str = "") -> None:
@@ -498,6 +583,10 @@ class Database:
             self._conn.execute(
                 "INSERT INTO exclusions (job_id,pattern,comment) VALUES (?,?,?)",
                 (job_id, pattern, comment))
+
+    def delete_exclusion(self, eid: int) -> None:
+        with self._lock, self._conn:
+            self._conn.execute("DELETE FROM exclusions WHERE id=?", (eid,))
 
     def list_exclusions(self, job_id: str = "") -> list[str]:
         """Global exclusions + per-job ones."""

@@ -120,7 +120,34 @@ class AlertScanner:
         self._last_alert: dict[str, float] = {}
         self._stop = asyncio.Event()
 
+    def reload_settings(self) -> None:
+        """Apply operator-set alert settings from the DB (web API:
+        /api2/json/d2d/alert-settings) — keys: quiet_days ("5,6"),
+        quiet_hours ("22-6"), cooldown_s, stale_after_s.  Runs every
+        scan, so a settings change takes effect without a restart."""
+        try:
+            st = self.server.db.list_alert_settings()
+        except Exception:
+            return
+        try:
+            if "quiet_days" in st:
+                self.quiet_days = {int(x) % 7 for x in
+                                   st["quiet_days"].split(",") if x.strip()}
+            if "quiet_hours" in st:
+                if st["quiet_hours"].strip():
+                    a, _, b = st["quiet_hours"].partition("-")
+                    self.quiet_hours = (int(a) % 24, int(b) % 24)
+                else:
+                    self.quiet_hours = None
+            if "cooldown_s" in st:
+                self.cooldown_s = float(st["cooldown_s"])
+            if "stale_after_s" in st:
+                self.stale_after_s = float(st["stale_after_s"])
+        except (ValueError, TypeError) as e:
+            L.warning("bad alert settings ignored: %s", e)
+
     def scan(self) -> list[tuple[str, str, dict]]:
+        self.reload_settings()
         alerts = []
         now = time.time()
         for j in self.server.db.list_backup_jobs(enabled_only=True):

@@ -264,6 +264,20 @@ class Server:
     async def _enqueue_backup_row(self, row: database.BackupJobRow) -> None:
         self.enqueue_backup(row.id)
 
+    async def _post_hook(self, row, status: str, *, snapshot: str = "",
+                         error: str = "") -> None:
+        """Best-effort post-script (reference: runPostScript — a failing
+        post hook never changes the job result)."""
+        from . import hooks
+        try:
+            post = hooks.resolve_script(self.db, row.post_script)
+            if post:
+                await hooks.run_hook(post, hooks.job_env(
+                    row, {"STATUS": status, "SNAPSHOT": snapshot,
+                          "ERROR": error}))
+        except Exception as e:
+            self.log.warning("post-script for %s failed: %s", row.id, e)
+
     def enqueue_backup(self, job_id: str) -> bool:
         row = self.db.get_backup_job(job_id)
         if row is None:
@@ -297,15 +311,32 @@ class Server:
                 batch_hasher=make_batch_hasher(row.chunker))
 
         async def execute():
+            from . import hooks
             async with self.jobs.startup_mu:   # serialize session startups
                 pass
             t0 = time.time()
             self.live_progress[row.id] = (t0, None)
 
+            # pre-script: PBS_PLUS__* env, KEY=VALUE stdout feedback
+            # (reference: runPreScript + override protocol, job.go:459-482)
+            run_row = row
+            pre = hooks.resolve_script(self.db, row.pre_script)
+            if pre:
+                fb = await hooks.run_hook(pre, hooks.job_env(row))
+                if fb:
+                    self.db.append_task_log(upid, f"pre-script: {fb}")
+                import dataclasses
+                run_row = dataclasses.replace(
+                    row,
+                    source_path=fb.get("SOURCE", row.source_path),
+                    exclusions=row.exclusions +
+                    ([fb["EXCLUDE"]] if fb.get("EXCLUDE") else []))
+            result_box["row"] = run_row
+
             def on_pump(result):
                 self.live_progress[row.id] = (t0, result)
             res = await run_backup_job(
-                row, db=self.db, agents=self.agents, store=store,
+                run_row, db=self.db, agents=self.agents, store=store,
                 on_pump=on_pump)
             result_box["res"] = res
             result_box["t0"] = t0
@@ -332,6 +363,8 @@ class Server:
             self.scheduler.on_backup_complete(row.store)
             if self.notifications is not None:
                 self.notifications.record(row.id, status)
+            await self._post_hook(result_box.get("row", row), status,
+                                  snapshot=res.snapshot if res else "")
 
         async def on_error(exc: BaseException):
             self.live_progress.pop(row.id, None)
@@ -342,6 +375,8 @@ class Server:
             if self.notifications is not None:
                 self.notifications.record(row.id, database.STATUS_ERROR,
                                           detail=str(exc))
+            await self._post_hook(result_box.get("row", row),
+                                  database.STATUS_ERROR, error=str(exc))
 
         return self.jobs.enqueue(Job(
             id=f"backup:{row.id}", kind="backup",

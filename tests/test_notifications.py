@@ -136,3 +136,27 @@ def test_alert_scanner_quiet_windows(tmp_path):
         assert any("failing" in b.get("text", "") for _, _, b in events)
         await server.stop()
     asyncio.run(main())
+
+
+def test_alert_settings_from_db_apply(tmp_path):
+    """Operator settings posted via the API reach the scanner on its
+    next scan — no restart needed."""
+    async def main():
+        server = Server(ServerConfig(
+            state_dir=str(tmp_path / "s"), cert_dir=str(tmp_path / "c"),
+            datastore_dir=str(tmp_path / "d"), max_concurrent=2))
+        await server.start()
+        sc = AlertScanner(server, sink=lambda *a: None)
+        server.db.put_alert_setting("quiet_days", "0,6")
+        server.db.put_alert_setting("quiet_hours", "22-6")
+        server.db.put_alert_setting("cooldown_s", "120")
+        sc.scan()
+        assert sc.quiet_days == {0, 6}
+        assert sc.quiet_hours == (22, 6)
+        assert sc.cooldown_s == 120.0
+        # bad values are ignored, prior config kept
+        server.db.put_alert_setting("cooldown_s", "not-a-number")
+        sc.scan()
+        assert sc.cooldown_s == 120.0
+        await server.stop()
+    asyncio.run(main())
