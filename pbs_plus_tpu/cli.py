@@ -115,6 +115,102 @@ def _cmd_agent_job(args: argparse.Namespace) -> int:
     return run_child(args.config)
 
 
+def _cmd_signer(args: argparse.Namespace) -> int:
+    """Sign/verify agent artifacts (reference: cmd/signer — mints the
+    ECDSA/Ed25519 signatures the updater verifies)."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+
+    from .agent.updater import verify_signature
+
+    if args.action == "keygen":
+        key = ed25519.Ed25519PrivateKey.generate()
+        priv = key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption())
+        pub = key.public_key().public_bytes(
+            serialization.Encoding.PEM,
+            serialization.PublicFormat.SubjectPublicKeyInfo)
+        fd = os.open(args.key, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        os.write(fd, priv)
+        os.close(fd)
+        open(f"{args.key}.pub", "wb").write(pub)
+        print(f"wrote {args.key} and {args.key}.pub")
+        return 0
+    if not args.file:
+        print(f"signer {args.action} requires --file", flush=True)
+        return 2
+    data = open(args.file, "rb").read()
+    if args.action == "sign":
+        key = serialization.load_pem_private_key(
+            open(args.key, "rb").read(), password=None)
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import ec
+        if isinstance(key, ed25519.Ed25519PrivateKey):
+            sig = key.sign(data)
+        elif isinstance(key, ec.EllipticCurvePrivateKey):
+            sig = key.sign(data, ec.ECDSA(hashes.SHA256()))
+        else:
+            print("unsupported key type", flush=True)
+            return 2
+        open(f"{args.file}.sig", "wb").write(sig)
+        print(f"wrote {args.file}.sig ({len(sig)} bytes)")
+        return 0
+    # verify
+    sig = open(args.sig or f"{args.file}.sig", "rb").read()
+    ok = verify_signature(data, sig, open(args.key, "rb").read())
+    print("OK" if ok else "BAD SIGNATURE")
+    return 0 if ok else 1
+
+
+def _cmd_mtfprobe(args: argparse.Namespace) -> int:
+    """Tape/BKF diagnostics (reference: cmd/mtfprobe/main.go:13-40)."""
+    from .tapeio.mtf import MTFError, MTFReader
+    with open(args.file, "rb") as f:
+        rdr = MTFReader(f, strict=not args.lenient)
+        n_files = n_dirs = total = 0
+        try:
+            for e in rdr.entries():
+                if args.verbose:
+                    print(f"{e.kind:4s} {e.path}"
+                          + (f"  ({e.size} bytes)" if e.kind == "file"
+                             else ""))
+                if e.kind == "file":
+                    n_files += 1
+                    total += e.size
+                else:
+                    n_dirs += 1
+        except MTFError as e:
+            print(f"MTF error: {e}")
+            return 1
+    print(f"{args.file}: {n_dirs} dirs, {n_files} files, "
+          f"{total} content bytes")
+    return 0
+
+
+def _cmd_job(args: argparse.Namespace) -> int:
+    """One-shot job mutation over the server's unix socket (reference:
+    the --backup-job/--restore-job one-shot mode of cmd/pbs_plus)."""
+    import json as _json
+
+    from .server.jobrpc import call_job_rpc
+
+    if args.action == "backup":
+        req = {"op": "backup_queue", "job_id": args.id}
+    elif args.action == "restore":
+        req = {"op": "restore_queue", "target": args.target,
+               "snapshot": args.snapshot, "destination": args.destination,
+               "subpath": args.subpath}
+    elif args.action == "status":
+        req = {"op": "status", "job_id": args.id}
+    else:
+        req = {"op": "list"}
+    resp = asyncio.run(call_job_rpc(args.socket, req))
+    print(_json.dumps(resp, indent=1))
+    return 0 if resp.get("ok") else 1
+
+
 def _cmd_mount(args: argparse.Namespace) -> int:
     from .chunker import ChunkerParams
     from .mount import ArchiveView, CommitEngine, Journal, MutableFS
@@ -276,6 +372,32 @@ def main(argv: list[str] | None = None) -> int:
 
     b = sub.add_parser("bench", help="run the benchmark")
     b.set_defaults(fn=_cmd_bench)
+
+    j = sub.add_parser("job", help="one-shot job mutation (unix socket)")
+    j.add_argument("action", choices=["backup", "restore", "status", "list"])
+    j.add_argument("--socket", required=True,
+                   help="<state-dir>/job.sock of the running server")
+    j.add_argument("--id", default="", help="backup job id")
+    j.add_argument("--target", default="")
+    j.add_argument("--snapshot", default="")
+    j.add_argument("--destination", default="")
+    j.add_argument("--subpath", default="")
+    j.set_defaults(fn=_cmd_job)
+
+    sg = sub.add_parser("signer", help="sign/verify agent artifacts")
+    sg.add_argument("action", choices=["keygen", "sign", "verify"])
+    sg.add_argument("--key", required=True,
+                    help="private key (sign/keygen) or public key (verify)")
+    sg.add_argument("--file", default="", help="artifact to sign/verify")
+    sg.add_argument("--sig", default="", help="signature path (verify)")
+    sg.set_defaults(fn=_cmd_signer)
+
+    mp = sub.add_parser("mtfprobe", help="MTF/BKF media diagnostics")
+    mp.add_argument("file")
+    mp.add_argument("-v", "--verbose", action="store_true")
+    mp.add_argument("--lenient", action="store_true",
+                    help="tolerate truncation (salvage mode)")
+    mp.set_defaults(fn=_cmd_mtfprobe)
 
     args = p.parse_args(argv)
     return args.fn(args)

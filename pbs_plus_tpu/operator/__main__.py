@@ -19,7 +19,17 @@ async def main() -> None:
             agent_image=os.environ.get("PBS_PLUS_AGENT_IMAGE",
                                        "pbs-plus-tpu:latest"),
         ))
-        await op.run()
+        if os.environ.get("PBS_PLUS_LEADER_ELECT", "1") != "0":
+            from .leader import LeaderElector
+            # identity must be unique per replica — a shared fallback
+            # would let every replica believe it holds the lease
+            ident = os.environ.get("HOSTNAME") or \
+                f"{os.uname().nodename}-{os.urandom(3).hex()}"
+            elector = LeaderElector(
+                kube, lease_name="pbs-plus-tpu-operator", identity=ident)
+            await asyncio.gather(elector.run(), op.run(leader=elector))
+        else:
+            await op.run()
 
 
 if __name__ == "__main__":

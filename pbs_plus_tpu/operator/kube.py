@@ -57,6 +57,34 @@ class KubeClient:
                 raise KubeError(r.status, text)
             return json.loads(text) if text else None
 
+    # -- coordination.k8s.io Leases (leader election) ----------------------
+    async def get_lease(self, name: str,
+                        namespace: str | None = None) -> Optional[dict]:
+        ns = namespace or self.namespace
+        try:
+            return await self._req(
+                "GET",
+                f"/apis/coordination.k8s.io/v1/namespaces/{ns}/leases/{name}")
+        except KubeError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    async def create_lease(self, spec: dict,
+                           namespace: str | None = None) -> dict:
+        ns = namespace or self.namespace
+        return await self._req(
+            "POST", f"/apis/coordination.k8s.io/v1/namespaces/{ns}/leases",
+            body=spec)
+
+    async def update_lease(self, name: str, spec: dict,
+                           namespace: str | None = None) -> dict:
+        ns = namespace or self.namespace
+        return await self._req(
+            "PUT",
+            f"/apis/coordination.k8s.io/v1/namespaces/{ns}/leases/{name}",
+            body=spec)
+
     # -- typed helpers -----------------------------------------------------
     async def list_pvcs(self, namespace: str | None = None) -> list[dict]:
         ns = namespace or self.namespace

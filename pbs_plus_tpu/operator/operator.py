@@ -167,14 +167,20 @@ class Operator:
         await self.kube.delete_pvc(f"pbs-clone-{pvc_name}"[:63])
         await self.kube.delete_volume_snapshot(f"pbs-snap-{pvc_name}"[:63])
 
-    async def run(self) -> None:
+    async def run(self, *, leader=None) -> None:
+        """``leader`` (operator.leader.LeaderElector) gates reconciling:
+        non-leaders idle (reference: --leader-elect,
+        cmd/operator/main.go:1-73)."""
         while not self._stop.is_set():
             try:
-                res = await self.reconcile()
-                if res.created_pods or res.cleaned:
-                    L.info("operator: +%d pods, -%d cleaned, %d skipped",
-                           len(res.created_pods), len(res.cleaned),
-                           len(res.skipped))
+                if leader is not None and not leader.is_leader:
+                    await asyncio.sleep(0)     # idle replica
+                else:
+                    res = await self.reconcile()
+                    if res.created_pods or res.cleaned:
+                        L.info("operator: +%d pods, -%d cleaned, %d skipped",
+                               len(res.created_pods), len(res.cleaned),
+                               len(res.skipped))
             except Exception:
                 L.exception("reconcile failed")
             try:

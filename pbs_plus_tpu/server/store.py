@@ -94,6 +94,7 @@ class Server:
         # in the backup OnSuccess path) — a sink is attached by the caller
         self.notifications = None
         self.mount_service = None       # lazily created by the web layer
+        self.job_rpc = None             # unix-socket job mutation service
         self._tasks: list[asyncio.Task] = []
         self.log = L.with_scope(component="server")
         # observability state (metrics.py): live per-job progress objects
@@ -189,6 +190,12 @@ class Server:
             None, self.mount_service.cleanup_stale_mounts)
         port = await self.start_arpc()
         self.config.arpc_port = port
+        # one-shot job mutation socket (reference: JobRPCService on
+        # pbs_agent_job_mutate.sock, rpc/job_service.go:58-196)
+        from .jobrpc import JobRPCServer
+        self.job_rpc = JobRPCServer(
+            self, os.path.join(self.config.state_dir, "job.sock"))
+        await self.job_rpc.start()
         self._tasks.append(asyncio.create_task(self.scheduler.run()))
 
     def _cleanup_orphaned_tasks(self) -> None:
@@ -205,6 +212,8 @@ class Server:
             self.log.warning("converted %d orphaned tasks to errors", n)
 
     async def stop(self) -> None:
+        if getattr(self, "job_rpc", None) is not None:
+            await self.job_rpc.stop()
         if self.mount_service is not None:
             await self.mount_service.unmount_all()
         self.scheduler.stop()

@@ -29,6 +29,7 @@ class MockPBS:
         self.token = token
         self.chunks: dict[str, bytes] = {}        # digest hex → raw bytes
         self.snapshots: dict[str, dict] = {}      # "type/id/time" → state
+        self.api_tokens: dict[str, str] = {}      # tokenid → secret
         self.sessions: dict = {}                  # client addr → session
         self.request_log: list[str] = []          # wire golden trace
         self.lock = threading.Lock()
@@ -83,6 +84,51 @@ class MockPBS:
                 auth = self.headers.get("Authorization", "")
                 if auth != f"PBSAPIToken={mock.token}":
                     return self._fail(401, "permission check failed")
+
+                # -- management API (proxmox-backup-manager analog) --------
+                if path.startswith("/api2/json/access/users/"):
+                    self._body()     # drain keep-alive body before replying
+                    parts = path.split("/")
+                    # /api2/json/access/users/{userid}/token/{name}
+                    if len(parts) == 8 and parts[6] == "token":
+                        userid, name = parts[5], parts[7]
+                        tid = f"{userid}!{name}"
+                        if method == "POST":
+                            import secrets as _sec
+                            with mock.lock:
+                                if tid in mock.api_tokens:
+                                    return self._fail(
+                                        400, f"token {tid} already exists")
+                                val = _sec.token_hex(16)
+                                mock.api_tokens[tid] = val
+                            return self._send(200, {"tokenid": tid,
+                                                    "value": val})
+                        if method == "DELETE":
+                            with mock.lock:
+                                if tid not in mock.api_tokens:
+                                    return self._fail(404, "no such token")
+                                del mock.api_tokens[tid]
+                            return self._send(200, None)
+                    return self._fail(404, "unknown access endpoint")
+
+                if method == "GET" and path == "/api2/json/version":
+                    return self._send(200, {"version": "3.2",
+                                            "release": "mock"})
+
+                if method == "GET" and path == "/api2/json/admin/datastore":
+                    return self._send(200, [{"store": "tank",
+                                             "comment": "mock"}])
+
+                if method == "GET" and \
+                        path.startswith("/api2/json/admin/datastore/") and \
+                        path.endswith("/status"):
+                    store = path.split("/")[5]
+                    with mock.lock:
+                        used = sum(len(v) for v in mock.chunks.values())
+                    return self._send(200, {
+                        "store": store, "total": 1 << 40, "used": used,
+                        "avail": (1 << 40) - used,
+                        "counts": {"snapshots": len(mock.snapshots)}})
 
                 if method == "GET" and path == "/api2/json/backup":
                     if self.headers.get("Upgrade") != \
@@ -235,6 +281,9 @@ class MockPBS:
 
             def do_PUT(self):
                 self._handle("PUT")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
 
         self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self.port = self.server.server_address[1]
