@@ -15,7 +15,12 @@ import subprocess
 
 def enumerate_drives() -> list[dict]:
     """[{name, mountpoint, fstype, size_bytes, free_bytes}] for real
-    filesystems (tmpfs/proc/etc. filtered)."""
+    filesystems (tmpfs/proc/etc. filtered).  Platform-dispatched: on
+    Windows the CIM enumeration (agent/win/drives.py) serves the same
+    shape."""
+    if os.name == "nt":
+        from .win.drives import enumerate_drives_windows
+        return enumerate_drives_windows()
     out: list[dict] = []
     if shutil.which("lsblk"):
         try:

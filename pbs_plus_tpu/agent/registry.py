@@ -12,15 +12,28 @@ Secret values are stored sealed and transparently unsealed on read;
 from __future__ import annotations
 
 import contextlib
-import fcntl
 import json
 import os
+
+if os.name != "nt":          # fcntl is unix-only; the nt path uses
+    import fcntl             # winreg (see open_registry)
 from typing import Any, Optional
 
 from ..utils import crypto
 
 SECRET_PREFIX = "sealed:"
 ENV_SEED_PREFIX = "PBS_PLUS_INIT_"
+
+
+def open_registry(path: str, *, key_path: str | None = None):
+    """Platform-dispatched config store: flock+AES-GCM TOML file on
+    unix (this module's Registry), winreg+DPAPI on Windows
+    (agent/win/registry.WinRegistry) — one surface either way
+    (reference: registry_unix.go / registry_windows.go split)."""
+    if os.name == "nt":
+        from .win.registry import WinRegistry
+        return WinRegistry()
+    return Registry(path, key_path=key_path)
 
 
 class Registry:

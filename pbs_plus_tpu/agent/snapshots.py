@@ -263,10 +263,16 @@ class SnapshotManager:
     def __init__(self, *, prefer_direct: bool = False,
                  handlers: list | None = None,
                  mounts_path: str = "/proc/mounts"):
-        self.handlers = handlers if handlers is not None else [
-            BtrfsHandler(), ZfsHandler(),
-            LvmHandler(mounts_path=mounts_path),
-            FreezeHandler(mounts_path=mounts_path)]
+        if handlers is not None:
+            self.handlers = handlers
+        elif os.name == "nt":
+            from .win.vss import VssHandler
+            self.handlers = [VssHandler()]
+        else:
+            self.handlers = [
+                BtrfsHandler(), ZfsHandler(),
+                LvmHandler(mounts_path=mounts_path),
+                FreezeHandler(mounts_path=mounts_path)]
         self.direct = DirectHandler()
         self.prefer_direct = prefer_direct
         self._mounts = mounts_path

@@ -1,0 +1,73 @@
+"""Windows ACL capture/restore (reference:
+internal/agent/agentfs/acls_windows.go:1-310 — per-file security
+descriptors carried through the archive).
+
+Protocol: SDDL strings via PowerShell (runner-seam testable):
+
+    capture: (Get-Acl -LiteralPath <p>).Sddl
+    restore: $a = Get-Acl -LiteralPath <p>; $a.SetSecurityDescriptorSddl
+             Form('<sddl>'); Set-Acl -LiteralPath <p> -AclObject $a
+
+The SDDL travels in the archive's xattr map under ``win.sddl`` (the
+unix build carries POSIX ACLs under ``system.posix_acl_access`` the
+same way), so Linux↔Windows archives stay structurally identical."""
+
+from __future__ import annotations
+
+import re
+import subprocess
+from typing import Callable
+
+Runner = Callable[..., "subprocess.CompletedProcess"]
+
+SDDL_XATTR = "win.sddl"
+
+
+def _ps(script: str) -> list[str]:
+    return ["powershell", "-NoProfile", "-NonInteractive", "-Command",
+            script]
+
+
+def _q(path: str) -> str:
+    return "'" + path.replace("'", "''") + "'"
+
+
+class WinAcls:
+    def __init__(self, *, run: Runner = subprocess.run):
+        self._run = run
+
+    def capture(self, path: str) -> str:
+        """SDDL of ``path`` ('' when unreadable — never fails a walk)."""
+        try:
+            r = self._run(_ps(f"(Get-Acl -LiteralPath {_q(path)}).Sddl"),
+                          check=True, capture_output=True, text=True,
+                          timeout=60)
+            return r.stdout.strip()
+        except Exception:
+            return ""
+
+    def apply(self, path: str, sddl: str) -> bool:
+        """Apply an SDDL from an archive.  The SDDL is UNTRUSTED input
+        (a tampered archive must not execute PowerShell as the agent):
+        allowlist the SDDL grammar's charset, then single-quote-escape."""
+        if not sddl:
+            return False
+        if not re.fullmatch(r"[A-Za-z0-9:;()\-_. ]+", sddl):
+            return False
+        script = (f"$a = Get-Acl -LiteralPath {_q(path)}; "
+                  f"$a.SetSecurityDescriptorSddlForm({_q(sddl)}); "
+                  f"Set-Acl -LiteralPath {_q(path)} -AclObject $a")
+        try:
+            self._run(_ps(script), check=True, capture_output=True,
+                      timeout=60)
+            return True
+        except Exception:
+            return False
+
+    def to_xattrs(self, path: str) -> dict[str, bytes]:
+        sddl = self.capture(path)
+        return {SDDL_XATTR: sddl.encode()} if sddl else {}
+
+    def from_xattrs(self, path: str, xattrs: dict[str, bytes]) -> bool:
+        raw = xattrs.get(SDDL_XATTR)
+        return self.apply(path, raw.decode()) if raw else False

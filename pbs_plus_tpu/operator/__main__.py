@@ -27,7 +27,15 @@ async def main() -> None:
                 f"{os.uname().nodename}-{os.urandom(3).hex()}"
             elector = LeaderElector(
                 kube, lease_name="pbs-plus-tpu-operator", identity=ident)
-            await asyncio.gather(elector.run(), op.run(leader=elector))
+
+            async def run_op():
+                # a stopped operator must also stop renewing the lease,
+                # or standbys never take over (silent reconcile outage)
+                try:
+                    await op.run(leader=elector)
+                finally:
+                    elector.stop()
+            await asyncio.gather(elector.run(), run_op())
         else:
             await op.run()
 

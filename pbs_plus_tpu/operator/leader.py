@@ -41,7 +41,10 @@ class LeaderElector:
         self.is_leader = False
         self._stop = asyncio.Event()
 
-    def _spec(self, acquisitions: int) -> dict:
+    def _spec(self, acquisitions: int,
+              acquire_time: str | None = None) -> dict:
+        """``acquire_time`` preserved on renewals — only a genuine
+        acquisition/takeover stamps a new one (client-go semantics)."""
         now = _fmt(_now())
         return {
             "apiVersion": "coordination.k8s.io/v1",
@@ -50,7 +53,7 @@ class LeaderElector:
             "spec": {
                 "holderIdentity": self.identity,
                 "leaseDurationSeconds": int(self.lease_duration_s),
-                "acquireTime": now,
+                "acquireTime": acquire_time or now,
                 "renewTime": now,
                 "leaseTransitions": acquisitions,
             },
@@ -84,9 +87,12 @@ class LeaderElector:
 
         if holder == self.identity or expired or not holder:
             transitions = int(spec.get("leaseTransitions", 0))
-            if holder != self.identity:
+            renewal = holder == self.identity
+            if not renewal:
                 transitions += 1
-            new = self._spec(transitions)
+            new = self._spec(
+                transitions,
+                acquire_time=spec.get("acquireTime") if renewal else None)
             new["metadata"] = lease.get("metadata", new["metadata"])
             try:
                 await self.kube.update_lease(self.lease_name, new)

@@ -81,9 +81,7 @@ class JobRPCServer:
             started = s.enqueue_backup(req["job_id"])
             return {"ok": True, "started": started}
         if op == "restore_queue":
-            from ..pxar.datastore import parse_snapshot_ref
             from .restore_job import enqueue_restore
-            parse_snapshot_ref(req["snapshot"])
             rid = enqueue_restore(
                 s, target=req["target"], snapshot=req["snapshot"],
                 destination=req["destination"],

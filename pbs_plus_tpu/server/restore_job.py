@@ -96,6 +96,7 @@ def enqueue_restore(server, *, target: str, snapshot: str,
                     destination: str, subpath: str = "") -> str:
     from .jobs import Job
     from .store import make_upid
+    parse_snapshot_ref(snapshot)     # reject bad refs before any row/task
     rid = f"restore-{uuid.uuid4().hex[:8]}"
     server.db.create_restore(rid, target, snapshot, destination, subpath)
     upid = make_upid("restore", rid)

@@ -119,8 +119,12 @@ class CartridgeInventory:
                 """INSERT INTO datasets (volume_tag,name,file_mark,snapshot,
                    bytes,converted_at,meta) VALUES (?,?,?,?,?,?,?)
                    ON CONFLICT(volume_tag,name) DO UPDATE SET
-                     file_mark=excluded.file_mark,
-                     bytes=excluded.bytes, meta=excluded.meta,
+                     file_mark=CASE WHEN excluded.file_mark>=0
+                              THEN excluded.file_mark ELSE file_mark END,
+                     bytes=CASE WHEN excluded.bytes>0
+                              THEN excluded.bytes ELSE bytes END,
+                     meta=CASE WHEN excluded.meta!='{}'
+                              THEN excluded.meta ELSE meta END,
                      snapshot=CASE WHEN excluded.snapshot!=''
                               THEN excluded.snapshot ELSE snapshot END,
                      converted_at=CASE WHEN excluded.snapshot!=''
