@@ -116,6 +116,16 @@ class MuxStream:
             view = view[n:]
 
     # -- lifecycle --------------------------------------------------------
+    def _maybe_retire(self) -> None:
+        """Drop this stream from the connection table once BOTH sides are
+        done (local FIN sent + peer FIN/RST seen).  Without this, every
+        RPC leaks one table entry for the life of the connection — a
+        long-lived control session would grow without bound.  A held
+        reference stays readable; only frame routing ends (no DATA can
+        arrive after the peer's FIN; late WINDOW grants are ignored)."""
+        if self._closed and (self._rx_eof or self._rx_reset):
+            self.conn._drop_stream(self.sid)
+
     async def close(self) -> None:
         """Half-close (FIN); reads continue until peer FIN."""
         if not self._closed:
@@ -126,6 +136,7 @@ class MuxStream:
                     await self.conn._send_frame(FIN, self.sid, b"")
                 except ConnectionError:
                     pass
+            self._maybe_retire()
 
     async def reset(self) -> None:
         self._closed = True
@@ -151,6 +162,7 @@ class MuxStream:
     def _on_fin(self) -> None:
         self._rx_eof = True
         self._rx_event.set()
+        self._maybe_retire()
 
     def _on_rst(self) -> None:
         self._rx_reset = True

@@ -1,0 +1,163 @@
+"""Perf-regression harnesses (reference: the unpublished `go test -bench`
+suites — aRPC per-size transfer, commit-walk B1–B11, pool/journal ops;
+SURVEY §4/§6).  Opt-in, numbers printed not asserted (absolute values are
+machine-dependent); coarse sanity floors only:
+
+    PBS_PLUS_BENCH=1 python -m pytest tests/test_bench_harness.py -q -s
+"""
+
+import asyncio
+import io
+import os
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("PBS_PLUS_BENCH"),
+    reason="bench harness: set PBS_PLUS_BENCH=1")
+
+
+def test_bench_arpc_transfer_per_size(tmp_path):
+    """aRPC raw-stream throughput at 64 KiB / 1 MiB / 8 MiB / 64 MiB
+    (reference: handle_bench_test.go:630-642 per-size suite)."""
+    import threading
+
+    from pbs_plus_tpu.arpc import (
+        Router, Session, TlsClientConfig, TlsServerConfig,
+        connect_to_server, send_data_from_reader, serve)
+    from pbs_plus_tpu.arpc.call import RawStreamHandler
+    from pbs_plus_tpu.utils import mtls
+
+    cm = mtls.CertManager(str(tmp_path / "pki"))
+    cm.load_or_create_ca()
+    cm.ensure_server_identity("server.test")
+    cert, key = cm.issue("bench")
+    (tmp_path / "c.pem").write_bytes(cert)
+    (tmp_path / "c.key").write_bytes(key)
+
+    blob = np.random.default_rng(0).integers(
+        0, 256, 64 << 20, dtype=np.uint8).tobytes()
+
+    async def main():
+        router = Router()
+
+        async def download(req, ctx):
+            n = req.payload["n"]
+            return RawStreamHandler(
+                lambda st: send_data_from_reader(st, io.BytesIO(blob[:n]),
+                                                 n))
+        router.handle("dl", download)
+
+        async def on_conn(conn, peer, headers):
+            await router.serve_connection(conn)
+        srv = await serve("127.0.0.1", 0,
+                          TlsServerConfig(cm.server_cert_path,
+                                          cm.server_key_path,
+                                          cm.ca_cert_path),
+                          on_connection=on_conn)
+        port = srv.sockets[0].getsockname()[1]
+        conn = await connect_to_server(
+            "127.0.0.1", port,
+            TlsClientConfig(str(tmp_path / "c.pem"),
+                            str(tmp_path / "c.key"), cm.ca_cert_path))
+        s = Session(conn)
+        print()
+        for n in (64 << 10, 1 << 20, 8 << 20, 64 << 20):
+            buf = bytearray()
+            t0 = time.perf_counter()
+            _, got = await s.call_binary_into("dl", {"n": n}, buf,
+                                              timeout=600)
+            dt = time.perf_counter() - t0
+            assert got == n
+            print(f"  arpc transfer {n >> 10:>6} KiB: "
+                  f"{n / dt / (1 << 20):8.1f} MiB/s")
+        await conn.close()
+        srv.close()
+        await srv.wait_closed()
+    asyncio.run(main())
+
+
+def test_bench_chunker_backends():
+    """CDC candidate-scan throughput: native C++ vs numpy (reference:
+    the chunker hot loop the commit suites hammer)."""
+    from pbs_plus_tpu.chunker import ChunkerParams, candidates
+
+    params = ChunkerParams(avg_size=4 << 20)
+    data = np.random.default_rng(1).integers(
+        0, 256, 128 << 20, dtype=np.uint8).tobytes()
+    print()
+    for name, buf, fn in (
+            ("native", data, lambda d: candidates(d, params)),
+            # numpy reference path is ~100x slower; bench a smaller slice
+            ("numpy", data[:16 << 20],
+             lambda d: candidates(d, params, force_numpy=True))):
+        t0 = time.perf_counter()
+        out = fn(buf)
+        dt = time.perf_counter() - t0
+        rate = len(buf) / dt / (1 << 20)
+        print(f"  chunker {name}: {rate:8.1f} MiB/s ({len(out)} candidates)")
+        assert rate > 1      # coarse floor: catches pathological regress
+
+
+def test_bench_chunk_store_insert(tmp_path):
+    """Chunk store insert+touch throughput (reference: pool/journal op
+    benches)."""
+    import hashlib
+
+    from pbs_plus_tpu.pxar.datastore import ChunkStore
+    store = ChunkStore(str(tmp_path / "cs"))
+    rng = np.random.default_rng(2)
+    chunks = [rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+              for _ in range(64)]
+    digs = [hashlib.sha256(c).digest() for c in chunks]
+    t0 = time.perf_counter()
+    for d, c in zip(digs, chunks):
+        store.insert(d, c, verify=False)
+    dt_new = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for d, c in zip(digs, chunks):
+        store.insert(d, c, verify=False)     # dedup hit path
+    dt_dup = time.perf_counter() - t0
+    print(f"\n  chunk insert new: {64 / dt_new:7.1f} MiB/s | "
+          f"dup-hit: {64 / dt_dup:8.1f} MiB/s")
+
+
+def test_bench_commit_walk_refs(tmp_path):
+    """Commit-walk with many unchanged files (ref coalescing — the
+    B1/B4 'refs sort + coalescing' analog): re-commit of an untouched
+    500-file tree should be ref-dominated and fast."""
+    from pbs_plus_tpu.chunker import ChunkerParams
+    from pbs_plus_tpu.mount import (
+        ArchiveView, CommitEngine, Journal, MutableFS)
+    from pbs_plus_tpu.pxar import LocalStore
+    from pbs_plus_tpu.pxar.walker import backup_tree
+
+    src = tmp_path / "src"
+    src.mkdir()
+    rng = np.random.default_rng(3)
+    for i in range(500):
+        (src / f"f{i:03d}.bin").write_bytes(
+            rng.integers(0, 256, 20_000, dtype=np.uint8).tobytes())
+    store = LocalStore(str(tmp_path / "ds"), ChunkerParams(avg_size=1 << 14))
+    sess = store.start_session(backup_type="host", backup_id="b")
+    backup_tree(sess, str(src))
+    sess.finish()
+
+    fs = MutableFS(ArchiveView(store.open_snapshot(sess.ref)),
+                   Journal(str(tmp_path / "j" / "j.db")),
+                   str(tmp_path / "pass"))
+    fs.create("one-new.txt")
+    fs.write("one-new.txt", b"delta")
+    engine = CommitEngine(fs, store, backup_id="b", previous=sess.ref)
+    t0 = time.perf_counter()
+    ref2 = engine.commit()
+    dt = time.perf_counter() - t0
+    man = store.datastore.load_manifest(ref2)
+    st = man["stats"]
+    print(f"\n  commit-walk 500 files, 1 changed: {dt:6.2f}s | "
+          f"ref_chunks {st['ref_chunks']} new {st['new_chunks']} "
+          f"reencoded {st['bytes_reencoded']} B")
+    assert st["ref_chunks"] > 0
+    assert st["new_chunks"] * 10 < st["ref_chunks"]
