@@ -22,8 +22,6 @@ pytestmark = pytest.mark.skipif(
 def test_bench_arpc_transfer_per_size(tmp_path):
     """aRPC raw-stream throughput at 64 KiB / 1 MiB / 8 MiB / 64 MiB
     (reference: handle_bench_test.go:630-642 per-size suite)."""
-    import threading
-
     from pbs_plus_tpu.arpc import (
         Router, Session, TlsClientConfig, TlsServerConfig,
         connect_to_server, send_data_from_reader, serve)

@@ -106,9 +106,7 @@ def test_duplicate_session_eviction_storm(tmp_path):
     """10 rapid reconnects under one CN: newest session wins every time,
     no zombie sessions or watcher-map growth (reference: duplicate
     eviction, agents_manager.go:152-171)."""
-    import sys
-    sys.path.insert(0, __file__.rsplit("/", 1)[0])
-    from test_crashed_jobs import _env
+    from test_crashed_jobs import _env   # pytest puts tests/ on sys.path
 
     async def main():
         server, agent, task = await _env(tmp_path)
