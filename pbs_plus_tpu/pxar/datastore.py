@@ -134,11 +134,12 @@ class ChunkStore:
                 if len(name) == 64:
                     yield bytes.fromhex(name)
 
-    def sweep(self, before: float) -> int:
+    def sweep(self, before: float) -> tuple[int, int]:
         """Remove chunks with atime/mtime older than ``before``; returns
-        count removed.  Caller is responsible for having touched all live
-        chunks after the mark (GC phase 1)."""
+        (count_removed, bytes_removed).  Caller is responsible for having
+        touched all live chunks after the mark (GC phase 1)."""
         removed = 0
+        freed = 0
         for sub in os.listdir(self.base):
             d = os.path.join(self.base, sub)
             if not os.path.isdir(d):
@@ -148,11 +149,12 @@ class ChunkStore:
                 try:
                     st = os.stat(p)
                     if max(st.st_atime, st.st_mtime) < before:
+                        freed += st.st_size
                         os.unlink(p)
                         removed += 1
                 except OSError:
                     continue
-        return removed
+        return removed, freed
 
 
 class DynamicIndex:

@@ -1,0 +1,166 @@
+"""Prune + garbage collection: retention policy over snapshot groups,
+then mark-and-sweep over the chunk store.
+
+Reference capability: the keep-last/refcount discipline of the
+reference's datastore tests (internal/pxarmount/{refcount,
+keepLast_chunk}_test.go) and PBS's own prune/GC jobs that PBS-Plus
+schedules around.  Policy here mirrors PBS's keep flags (subset):
+
+    keep_last     newest N per group
+    keep_daily    newest per calendar day, N days
+    keep_weekly   newest per ISO week, N weeks
+
+GC is the PBS two-phase model on this chunk store: phase 1 touches every
+chunk referenced by every surviving snapshot (atime mark), phase 2
+sweeps chunks untouched since the mark started, with a grace window so
+chunks inserted by an in-flight backup session (staged, not yet
+published) can never be collected."""
+
+from __future__ import annotations
+
+import datetime as dt
+import os
+import time
+from dataclasses import dataclass, field
+
+from ..pxar.datastore import Datastore, SnapshotRef
+from ..utils.log import L
+
+GC_GRACE_S = 24 * 3600.0      # PBS-style safety window for in-flight data
+
+
+@dataclass
+class PrunePolicy:
+    keep_last: int = 0            # 0 = keep all
+    keep_daily: int = 0
+    keep_weekly: int = 0
+
+    def __post_init__(self) -> None:
+        # a negative keep (sign bug in a client) would slice to an empty
+        # keep-set and delete the whole group — reject at construction
+        for f in ("keep_last", "keep_daily", "keep_weekly"):
+            if getattr(self, f) < 0:
+                raise ValueError(f"{f} must be >= 0")
+
+    def empty(self) -> bool:
+        return not (self.keep_last or self.keep_daily or self.keep_weekly)
+
+
+@dataclass
+class PruneReport:
+    removed: list[str] = field(default_factory=list)
+    kept: list[str] = field(default_factory=list)
+    chunks_removed: int = 0
+    bytes_freed: int = 0
+    dry_run: bool = False
+
+
+def _parse_time(ref: SnapshotRef) -> dt.datetime:
+    return dt.datetime.strptime(ref.backup_time, "%Y-%m-%dT%H:%M:%SZ"
+                                ).replace(tzinfo=dt.timezone.utc)
+
+
+def select_keep(snaps: list[SnapshotRef],
+                policy: PrunePolicy) -> set[SnapshotRef]:
+    """Which snapshots of ONE group survive (PBS keep-flag semantics:
+    newest-first, each bucket keeps its newest member, a snapshot kept
+    by any rule is kept)."""
+    if policy.empty() or not snaps:
+        return set(snaps)
+    ordered = sorted(snaps, key=lambda r: r.backup_time, reverse=True)
+    keep: set[SnapshotRef] = set()
+    keep.update(ordered[:policy.keep_last])
+    if policy.keep_daily:
+        seen_days: set[str] = set()
+        for r in ordered:
+            day = _parse_time(r).strftime("%Y-%m-%d")
+            if day not in seen_days:
+                seen_days.add(day)
+                keep.add(r)
+                if len(seen_days) >= policy.keep_daily:
+                    break
+    if policy.keep_weekly:
+        seen_weeks: set[str] = set()
+        for r in ordered:
+            week = "{}-W{:02d}".format(*_parse_time(r).isocalendar()[:2])
+            if week not in seen_weeks:
+                seen_weeks.add(week)
+                keep.add(r)
+                if len(seen_weeks) >= policy.keep_weekly:
+                    break
+    return keep
+
+
+def mark_live_chunks(ds: Datastore) -> int:
+    """GC phase 1: touch every chunk referenced by any snapshot index —
+    once per unique digest (a deduplicated store shares chunks across
+    many snapshots; per-entry utime would be millions of redundant
+    syscalls)."""
+    live: set[bytes] = set()
+    for ref in ds.list_snapshots():
+        try:
+            indexes = ds.load_indexes(ref)
+        except OSError:
+            continue     # snapshot vanished mid-scan (concurrent delete)
+        for idx in indexes:
+            for i in range(len(idx.ends)):
+                live.add(idx.digests[i].tobytes())
+    for dg in live:
+        ds.chunks.touch(dg)
+    return len(live)
+
+
+def run_prune(ds: Datastore, policy: PrunePolicy, *,
+              dry_run: bool = False, gc: bool = True,
+              gc_grace_s: float = GC_GRACE_S) -> PruneReport:
+    """Apply ``policy`` to every snapshot group, then (optionally)
+    mark-and-sweep the chunk store."""
+    report = PruneReport(dry_run=dry_run)
+    groups: dict[tuple[str, str], list[SnapshotRef]] = {}
+    for ref in ds.list_snapshots():
+        groups.setdefault((ref.backup_type, ref.backup_id), []).append(ref)
+    for (_t, _b), snaps in sorted(groups.items()):
+        keep = select_keep(snaps, policy)
+        for ref in snaps:
+            if ref in keep:
+                report.kept.append(str(ref))
+            else:
+                report.removed.append(str(ref))
+                if not dry_run:
+                    ds.remove_snapshot(ref)
+    # GC runs whenever requested — garbage may pre-exist this prune
+    # (snapshot DELETE route, an earlier grace-shielded sweep), so it
+    # must not be conditional on THIS run having removed anything
+    if gc and not dry_run:
+        # mark_start must come from the FILE clock, not time.time(): the
+        # kernel stamps utime with the coarse clock, which can lag the
+        # precise clock by ~1 ms — a wall-clock mark would sweep chunks
+        # touched immediately after it (live-chunk loss)
+        mark_start = _file_clock_now(ds.chunks.base)
+        mark_live_chunks(ds)
+        # sweep only chunks last touched before BOTH the mark and the
+        # grace cutoff — a just-inserted chunk of an in-flight session
+        # is always newer than the cutoff
+        cutoff = min(mark_start, time.time() - gc_grace_s)
+        report.chunks_removed, report.bytes_freed = \
+            ds.chunks.sweep(before=cutoff)
+    L.info("prune: removed %d kept %d (dry_run=%s, %d chunks, %d bytes)",
+           len(report.removed), len(report.kept), dry_run,
+           report.chunks_removed, report.bytes_freed)
+    return report
+
+
+def _file_clock_now(base: str) -> float:
+    """'Now' as the filesystem will stamp it (coarse kernel clock)."""
+    import tempfile
+    fd, p = tempfile.mkstemp(dir=base, prefix=".gc-mark-")
+    try:
+        os.close(fd)
+        return os.stat(p).st_mtime
+    finally:
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+
+

@@ -56,6 +56,12 @@ class ServerConfig:
     pbs_token: str = ""
     pbs_namespace: str = ""
     pbs_fingerprint: str = ""
+    # retention: scheduled prune+GC over the local datastore (0 = keep
+    # all; empty schedule = manual only via POST /api2/json/d2d/prune)
+    prune_keep_last: int = 0
+    prune_keep_daily: int = 0
+    prune_keep_weekly: int = 0
+    prune_schedule: str = ""
 
 
 class Server:
@@ -95,6 +101,7 @@ class Server:
         self.notifications = None
         self.mount_service = None       # lazily created by the web layer
         self.job_rpc = None             # unix-socket job mutation service
+        self._prune_lock = asyncio.Lock()   # serializes prune/GC/delete
         self._tasks: list[asyncio.Task] = []
         self.log = L.with_scope(component="server")
         # observability state (metrics.py): live per-job progress objects
@@ -197,6 +204,8 @@ class Server:
             self, os.path.join(self.config.state_dir, "job.sock"))
         await self.job_rpc.start()
         self._tasks.append(asyncio.create_task(self.scheduler.run()))
+        if self.config.prune_schedule:
+            self._tasks.append(asyncio.create_task(self._prune_loop()))
 
     def _cleanup_orphaned_tasks(self) -> None:
         """Tasks still 'running' at startup died with the previous process —
@@ -272,6 +281,50 @@ class Server:
     # -- job enqueue -------------------------------------------------------
     async def _enqueue_backup_row(self, row: database.BackupJobRow) -> None:
         self.enqueue_backup(row.id)
+
+    def prune_policy(self):
+        from .prune import PrunePolicy
+        return PrunePolicy(keep_last=self.config.prune_keep_last,
+                           keep_daily=self.config.prune_keep_daily,
+                           keep_weekly=self.config.prune_keep_weekly)
+
+    async def run_prune(self, policy=None, *, dry_run: bool = False,
+                        gc_grace_s: float | None = None):
+        """Prune+GC off the event loop (reference capability: the
+        keep-last retention + chunk GC the reference's datastore tests
+        pin down; PBS's own prune/GC job analog).  Serialized with every
+        other datastore-mutating admin path (snapshot delete, concurrent
+        prunes) via _prune_lock — a delete racing the mark phase would
+        abort GC mid-flight."""
+        from .prune import GC_GRACE_S, run_prune
+        policy = policy or self.prune_policy()
+        kw = {"gc_grace_s": GC_GRACE_S if gc_grace_s is None
+              else gc_grace_s}
+        async with self._prune_lock:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, lambda: run_prune(self.datastore.datastore, policy,
+                                        dry_run=dry_run, **kw))
+
+    async def _prune_loop(self) -> None:
+        import datetime as dt
+
+        from ..utils import calendar
+        while True:
+            try:
+                nxt = calendar.compute_next_event(
+                    self.config.prune_schedule, dt.datetime.now())
+                if nxt is None:
+                    return
+                await asyncio.sleep(
+                    max(1.0, (nxt - dt.datetime.now()).total_seconds()))
+                report = await self.run_prune()
+                self.log.info("scheduled prune: -%d snapshots, -%d chunks",
+                              len(report.removed), report.chunks_removed)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.log.exception("scheduled prune failed")
+                await asyncio.sleep(60)
 
     async def _post_hook(self, row, status: str, *, snapshot: str = "",
                          error: str = "") -> None:

@@ -672,6 +672,57 @@ echo "  --bootstrap-token <token_id:secret>"
         from .ui import DASHBOARD_HTML
         return web.Response(text=DASHBOARD_HTML, content_type="text/html")
 
+    async def prune_run(request):
+        """Retention + GC (reference: PBS prune/GC job analog).  Body:
+        {keep_last, keep_daily, keep_weekly, dry_run, gc_grace_s}; empty
+        policy falls back to the server's configured one."""
+        from .prune import PrunePolicy
+        try:
+            b = await request.json() if request.can_read_body else {}
+            if not isinstance(b, dict):
+                raise ValueError("want a JSON object")
+            policy = PrunePolicy(
+                keep_last=int(b.get("keep_last", 0)),
+                keep_daily=int(b.get("keep_daily", 0)),
+                keep_weekly=int(b.get("keep_weekly", 0)))
+            grace = b.get("gc_grace_s")
+            grace = float(grace) if grace is not None else None
+        except (ValueError, TypeError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        if policy.empty():
+            policy = server.prune_policy()
+        if policy.empty():
+            return web.json_response(
+                {"error": "no retention policy (configure prune_keep_* "
+                          "or pass keep_last/keep_daily/keep_weekly)"},
+                status=400)
+        report = await server.run_prune(
+            policy, dry_run=bool(b.get("dry_run", False)),
+            gc_grace_s=grace)
+        return web.json_response({"data": {
+            "removed": report.removed, "kept": report.kept,
+            "chunks_removed": report.chunks_removed,
+            "bytes_freed": report.bytes_freed,
+            "dry_run": report.dry_run}})
+
+    async def snapshot_delete(request):
+        from ..pxar.datastore import parse_snapshot_ref
+        snap = "{bt}/{bid}/{ts}".format(
+            bt=request.match_info["bt"], bid=request.match_info["bid"],
+            ts=request.match_info["ts"])
+        try:
+            ref = parse_snapshot_ref(snap)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        ds = server.datastore.datastore
+        if ref not in ds.list_snapshots():
+            return web.json_response({"error": "unknown snapshot"},
+                                     status=404)
+        async with server._prune_lock:      # never race a GC mark phase
+            await asyncio.get_running_loop().run_in_executor(
+                None, ds.remove_snapshot, ref)
+        return web.json_response({"ok": True})
+
     app.router.add_get("/api2/json/d2d/verification", verification_list)
     app.router.add_post("/api2/json/d2d/verification", verification_upsert)
     app.router.add_post("/api2/json/d2d/verification/{id}/run",
@@ -694,6 +745,9 @@ echo "  --bootstrap-token <token_id:secret>"
     app.router.add_get("/plus/agent/install.sh", agent_install_sh)
     app.router.add_get("/plus/agent/pyz", agent_pyz)
     app.router.add_get("/plus/ui", ui_page)
+    app.router.add_post("/api2/json/d2d/prune", prune_run)
+    app.router.add_delete("/api2/json/d2d/snapshots/{bt}/{bid}/{ts}",
+                          snapshot_delete)
     return app
 
 

@@ -1,0 +1,190 @@
+"""Prune + GC (retention policy, mark-and-sweep; reference capability:
+the keep-last/refcount chunk discipline of internal/pxarmount/
+{refcount,keepLast_chunk}_test.go + PBS's prune/GC jobs)."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from pbs_plus_tpu.chunker import ChunkerParams
+from pbs_plus_tpu.pxar import LocalStore
+from pbs_plus_tpu.pxar.datastore import SnapshotRef
+from pbs_plus_tpu.pxar.walker import backup_tree
+from pbs_plus_tpu.server.prune import (
+    PrunePolicy, mark_live_chunks, run_prune, select_keep,
+)
+
+P = ChunkerParams(avg_size=4 << 10)
+
+
+def _ref(t):
+    return SnapshotRef("host", "g", t)
+
+
+def test_select_keep_semantics():
+    snaps = [_ref(t) for t in (
+        "2026-07-01T10:00:00Z", "2026-07-01T22:00:00Z",   # same day
+        "2026-07-02T10:00:00Z",
+        "2026-07-08T10:00:00Z",                           # next ISO week
+        "2026-07-15T10:00:00Z",
+    )]
+    # keep_last: newest N
+    keep = select_keep(snaps, PrunePolicy(keep_last=2))
+    assert {r.backup_time for r in keep} == {
+        "2026-07-08T10:00:00Z", "2026-07-15T10:00:00Z"}
+    # keep_daily: newest per day, N days
+    keep = select_keep(snaps, PrunePolicy(keep_daily=2))
+    assert {r.backup_time for r in keep} == {
+        "2026-07-15T10:00:00Z", "2026-07-08T10:00:00Z"}
+    # keep_daily picks the NEWEST within a day
+    keep = select_keep(snaps, PrunePolicy(keep_daily=4))
+    assert "2026-07-01T22:00:00Z" in {r.backup_time for r in keep}
+    assert "2026-07-01T10:00:00Z" not in {r.backup_time for r in keep}
+    # keep_weekly buckets by ISO week
+    keep = select_keep(snaps, PrunePolicy(keep_weekly=2))
+    assert {r.backup_time for r in keep} == {
+        "2026-07-15T10:00:00Z", "2026-07-08T10:00:00Z"}
+    # union of rules; empty policy keeps all
+    keep = select_keep(snaps, PrunePolicy(keep_last=1, keep_weekly=3))
+    assert len(keep) == 3
+    assert select_keep(snaps, PrunePolicy()) == set(snaps)
+
+
+def _make_snapshots(tmp_path, n=4):
+    """n snapshots of one group: a stable shared file + one unique file
+    per snapshot (unique chunks become garbage once pruned)."""
+    store = LocalStore(str(tmp_path / "ds"), P)
+    rng = np.random.default_rng(1)
+    shared = rng.integers(0, 256, 60_000, dtype=np.uint8).tobytes()
+    refs = []
+    for i in range(n):
+        src = tmp_path / f"src{i}"
+        src.mkdir()
+        (src / "shared.bin").write_bytes(shared)
+        (src / f"uniq{i}.bin").write_bytes(
+            rng.integers(0, 256, 50_000, dtype=np.uint8).tobytes())
+        sess = store.start_session(backup_type="host", backup_id="g",
+                                   backup_time=1_753_000_000 + i * 86_400,
+                                   auto_previous=False)
+        backup_tree(sess, str(src))
+        sess.finish()
+        refs.append(sess.ref)
+    return store, refs
+
+
+def test_prune_and_gc_end_to_end(tmp_path):
+    store, refs = _make_snapshots(tmp_path)
+    ds = store.datastore
+    chunks_before = sum(1 for _ in ds.chunks.iter_digests())
+
+    # dry run: nothing changes
+    rep = run_prune(ds, PrunePolicy(keep_last=2), dry_run=True)
+    assert len(rep.removed) == 2 and len(rep.kept) == 2
+    assert ds.list_snapshots() == refs
+    assert sum(1 for _ in ds.chunks.iter_digests()) == chunks_before
+
+    # real run with zero grace (test clock): old uniq chunks collected
+    rep = run_prune(ds, PrunePolicy(keep_last=2), gc_grace_s=0.0)
+    assert sorted(rep.removed) == sorted(str(r) for r in refs[:2])
+    assert ds.list_snapshots() == refs[2:]
+    assert rep.chunks_removed > 0 and rep.bytes_freed > 0
+
+    # surviving snapshots remain FULLY readable (chunk-level safety)
+    for ref in refs[2:]:
+        r = store.open_snapshot(ref)
+        for e in r.entries():
+            if e.is_file:
+                assert len(r.read_file(e)) == e.size
+    # shared chunks survived the sweep
+    assert sum(1 for _ in ds.chunks.iter_digests()) < chunks_before
+
+
+def test_gc_grace_protects_recent_chunks(tmp_path):
+    """Chunks newer than the grace window are never swept, even when no
+    index references them (in-flight session safety)."""
+    store, refs = _make_snapshots(tmp_path, n=2)
+    ds = store.datastore
+    # simulate an in-flight session's chunk: present, unreferenced, fresh
+    import hashlib
+    orphan = b"in-flight-chunk-data" * 100
+    dg = hashlib.sha256(orphan).digest()
+    ds.chunks.insert(dg, orphan)
+    rep = run_prune(ds, PrunePolicy(keep_last=1))   # default 24h grace
+    assert rep.removed and rep.chunks_removed == 0  # grace shields all
+    assert ds.chunks.has(dg)
+
+
+def test_mark_touches_all_live(tmp_path):
+    store, refs = _make_snapshots(tmp_path, n=2)
+    n = mark_live_chunks(store.datastore)
+    assert n > 0
+
+
+def test_prune_web_route_and_snapshot_delete(tmp_path):
+    from aiohttp import ClientSession
+    from test_web import _mk_server
+    from pbs_plus_tpu.server import database
+
+    async def main():
+        server, runner, port, tid, secret = await _mk_server(tmp_path)
+        base = f"http://127.0.0.1:{port}"
+        sec = os.urandom(12).hex().encode()
+        server.db.put_token("op", sec, kind="api")
+        hdr = {"Authorization": f"Bearer op:{sec.decode()}"}
+
+        # three local snapshots via the datastore directly
+        from pbs_plus_tpu.pxar.walker import backup_tree as bt
+        src = tmp_path / "s"
+        src.mkdir()
+        (src / "f.txt").write_text("x" * 10_000)
+        for i in range(3):
+            sess = server.datastore.start_session(
+                backup_type="host", backup_id="web",
+                backup_time=1_753_000_000 + i * 3600, auto_previous=False)
+            bt(sess, str(src))
+            sess.finish()
+
+        async with ClientSession() as http:
+            # no policy configured and none passed → 400
+            r = await http.post(f"{base}/api2/json/d2d/prune", headers=hdr,
+                                json={})
+            assert r.status == 400
+            r = await http.post(f"{base}/api2/json/d2d/prune", headers=hdr,
+                                json={"keep_last": 1, "gc_grace_s": 0})
+            data = (await r.json())["data"]
+            assert len(data["removed"]) == 2 and len(data["kept"]) == 1
+            assert len(server.datastore.datastore.list_snapshots()) == 1
+
+            # snapshot delete route
+            last = server.datastore.datastore.list_snapshots()[0]
+            r = await http.delete(
+                f"{base}/api2/json/d2d/snapshots/{last.backup_type}/"
+                f"{last.backup_id}/{last.backup_time}", headers=hdr)
+            assert r.status == 200
+            assert server.datastore.datastore.list_snapshots() == []
+            # unknown → 404; traversal → 400
+            r = await http.delete(
+                f"{base}/api2/json/d2d/snapshots/host/nope/"
+                f"2026-01-01T00:00:00Z", headers=hdr)
+            assert r.status == 404
+            # dot-segments are normalized away by HTTP stacks before the
+            # handler; an argv-unsafe component exercises our 400 path
+            r = await http.delete(
+                f"{base}/api2/json/d2d/snapshots/host/a%20b/x",
+                headers=hdr)
+            assert r.status == 400
+            # malformed prune bodies are client errors, not 500s
+            r = await http.post(f"{base}/api2/json/d2d/prune", headers=hdr,
+                                json={"keep_last": "two"})
+            assert r.status == 400
+            r = await http.post(f"{base}/api2/json/d2d/prune", headers=hdr,
+                                json={"keep_last": -3})
+            assert r.status == 400
+            r = await http.post(f"{base}/api2/json/d2d/prune", headers=hdr,
+                                json={"keep_last": 1, "gc_grace_s": "1h"})
+            assert r.status == 400
+        await runner.cleanup()
+        await server.stop()
+    asyncio.run(main())

@@ -309,8 +309,8 @@ def test_gc_sweep_preserves_live_chunks(tmp_path, tree):
             for i in range(len(idx)):
                 os.utime(store.datastore.chunks._path(idx.digest(i)),
                          (mark + 10, mark + 10))
-    removed = store.datastore.chunks.sweep(before=mark)
-    assert removed == 0
+    removed, freed = store.datastore.chunks.sweep(before=mark)
+    assert removed == 0 and freed == 0
     r = store.open_snapshot(s1.ref)
     for e in r.entries():
         if e.is_file and e.size:
