@@ -145,12 +145,16 @@ class ChunkStore:
             if not os.path.isdir(d):
                 continue
             for name in os.listdir(d):
+                if len(name) != 64:          # chunks only (64-hex names)
+                    continue
                 p = os.path.join(d, name)
                 try:
                     st = os.stat(p)
                     if max(st.st_atime, st.st_mtime) < before:
-                        freed += st.st_size
                         os.unlink(p)
+                        # counted only after a successful unlink — an
+                        # EPERM failure must not inflate bytes_freed
+                        freed += st.st_size
                         removed += 1
                 except OSError:
                     continue

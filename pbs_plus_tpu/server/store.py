@@ -102,6 +102,7 @@ class Server:
         self.mount_service = None       # lazily created by the web layer
         self.job_rpc = None             # unix-socket job mutation service
         self._prune_lock = asyncio.Lock()   # serializes prune/GC/delete
+        self._gc_active = False             # backups wait while GC runs
         self._tasks: list[asyncio.Task] = []
         self.log = L.with_scope(component="server")
         # observability state (metrics.py): live per-job progress objects
@@ -301,9 +302,25 @@ class Server:
         kw = {"gc_grace_s": GC_GRACE_S if gc_grace_s is None
               else gc_grace_s}
         async with self._prune_lock:
-            return await asyncio.get_running_loop().run_in_executor(
-                None, lambda: run_prune(self.datastore.datastore, policy,
-                                        dry_run=dry_run, **kw))
+            if not dry_run:
+                # GC must never run concurrently with backups: a mid-
+                # flight incremental may still REFERENCE chunks of the
+                # very snapshot this prune removes (splice touch happens
+                # at walk time, so neither the mark nor the grace window
+                # protects them).  Mutual exclusion: refuse while jobs
+                # run; new jobs wait out the GC (the flag is checked
+                # before each job's session starts).
+                if self.jobs.active_count:
+                    raise RuntimeError(
+                        f"prune deferred: {self.jobs.active_count} "
+                        f"job(s) active")
+                self._gc_active = True
+            try:
+                return await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: run_prune(self.datastore.datastore,
+                                            policy, dry_run=dry_run, **kw))
+            finally:
+                self._gc_active = False
 
     async def _prune_loop(self) -> None:
         import datetime as dt
@@ -374,6 +391,8 @@ class Server:
 
         async def execute():
             from . import hooks
+            while self._gc_active:         # never start mid-GC
+                await asyncio.sleep(0.5)
             async with self.jobs.startup_mu:   # serialize session startups
                 pass
             t0 = time.time()

@@ -41,10 +41,52 @@ def pick_snapshots(server, *, store_filter: str = "",
     return [ref for ref, _ in weights[:max_count]]
 
 
+async def check_source_drift(server, ref, reader, *, rng,
+                             max_files: int = 8) -> dict | None:
+    """Agent-side cross-check (reference: verify_start RPC →
+    VerifyChunkFileHandler, internal/agent/verification/handler.go:70-93):
+    sample files from the snapshot and ask the LIVE agent to hash its
+    current copy.  A mismatch is *drift* (the source changed since the
+    backup), reported separately from corruption.  None when the group
+    has no connected agent."""
+    import os
+
+    from ..arpc import Session
+
+    row = next((j for j in server.db.list_backup_jobs()
+                if (j.backup_id or j.target) == ref.backup_id), None)
+    if row is None:
+        return None
+    target = server.db.get_target(row.target) or {}
+    hostname = target.get("hostname") or row.target
+    ctl = server.agents.get(hostname)
+    if ctl is None:
+        return None
+    files = [e for e in reader.entries()
+             if e.is_file and e.size > 0 and e.digest]
+    if not files:
+        return {"sampled": 0, "drifted": []}
+    idx = rng.choice(len(files), size=min(max_files, len(files)),
+                     replace=False)
+    sess = Session(ctl.conn)
+    drifted = []
+    for i in sorted(int(x) for x in idx):
+        e = files[i]
+        path = os.path.join(row.source_path, e.path)
+        try:
+            resp = await sess.call("verify_start", {"path": path},
+                                   timeout=120)
+            if bytes.fromhex(resp.data["sha256"]) != e.digest:
+                drifted.append(e.path)
+        except Exception:
+            drifted.append(f"{e.path} (unreadable on agent)")
+    return {"sampled": int(len(idx)), "drifted": drifted}
+
+
 async def run_verification(server, v: dict) -> dict:
     vp = VerifyPipeline()
     rng = np.random.default_rng()
-    report = {"checked": 0, "corrupt": [], "snapshots": []}
+    report = {"checked": 0, "corrupt": [], "snapshots": [], "drift": []}
     for ref in pick_snapshots(server, store_filter=v.get("store", "")):
         reader = SplitReader.open_snapshot(server.datastore.datastore, ref)
         res = await asyncio.get_running_loop().run_in_executor(
@@ -55,6 +97,11 @@ async def run_verification(server, v: dict) -> dict:
         if not res.ok:
             report["corrupt"].append(
                 {"snapshot": str(ref), "files": res.corrupt})
+        if v.get("check_source"):
+            drift = await check_source_drift(server, ref, reader, rng=rng)
+            if drift is not None and drift["drifted"]:
+                report["drift"].append(
+                    {"snapshot": str(ref), **drift})
     return report
 
 

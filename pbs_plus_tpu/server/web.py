@@ -33,6 +33,7 @@ import asyncio
 import json
 import os
 import secrets
+import threading
 import time
 import uuid
 from typing import TYPE_CHECKING
@@ -506,8 +507,16 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
                 if v["id"] == vid]
         if not rows:
             return web.json_response({"error": "unknown job"}, status=404)
+        v = dict(rows[0])
+        if request.can_read_body:
+            try:
+                body = await request.json()
+                if isinstance(body, dict) and body.get("check_source"):
+                    v["check_source"] = True   # agent-side drift cross-check
+            except ValueError:
+                pass
         return web.json_response(
-            {"started": enqueue_verification(server, rows[0])})
+            {"started": enqueue_verification(server, v)})
 
     app.router.add_get("/plus/healthz", healthz)
     app.router.add_get("/plus/readyz", readyz)
@@ -672,6 +681,73 @@ echo "  --bootstrap-token <token_id:secret>"
         from .ui import DASHBOARD_HTML
         return web.Response(text=DASHBOARD_HTML, content_type="text/html")
 
+    # per-snapshot directory listings, built once per (snapshot,
+    # manifest-mtime) and reused across the many per-level requests a
+    # tree browser issues (a full entry scan per click would starve the
+    # shared executor on big archives)
+    _tree_cache: dict[str, tuple[float, dict]] = {}
+
+    async def snapshot_filetree(request):
+        """Browse a stored snapshot's tree one level at a time (the
+        reference UI's snapshot file browser backing; live-agent browse
+        is the separate /d2d/filetree)."""
+        from ..pxar.datastore import parse_snapshot_ref
+        from ..pxar.transfer import SplitReader
+        snap = request.query.get("snapshot", "")
+        sub = request.query.get("path", "").strip("/")
+
+        def build() -> dict:
+            ref = parse_snapshot_ref(snap)
+            ds = server.datastore.datastore
+            mtime = os.path.getmtime(
+                os.path.join(ds.snapshot_dir(ref), ds.MANIFEST))
+            hit = _tree_cache.get(snap)
+            if hit is not None and hit[0] == mtime:
+                return hit[1]
+            reader = SplitReader.open_snapshot(ds, ref)
+            bydir: dict[str, list] = {}
+            for e in reader.entries():
+                if not e.path:
+                    continue
+                parent, _, name = e.path.rpartition("/")
+                bydir.setdefault(parent, []).append(
+                    {"name": name, "path": e.path, "kind": e.kind,
+                     "size": e.size, "dir": e.is_dir})
+            while len(_tree_cache) >= 4:
+                _tree_cache.pop(next(iter(_tree_cache)))
+            _tree_cache[snap] = (mtime, bydir)
+            return bydir
+
+        try:
+            bydir = await asyncio.get_running_loop().run_in_executor(
+                None, build)
+        except (FileNotFoundError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=404)
+        return web.json_response({"data": bydir.get(sub, [])})
+
+    async def debug_stacks(request):
+        """All thread + asyncio task stacks (the pprof goroutine-dump
+        analog; reference mounts net/http/pprof on the API mux)."""
+        import sys
+        import traceback
+        lines = ["== threads =="]
+        frames = sys._current_frames()
+        for t in threading.enumerate():
+            lines.append(f"\n-- thread {t.name} "
+                         f"(daemon={t.daemon}, ident={t.ident})")
+            f = frames.get(t.ident)
+            if f is not None:
+                lines.extend(x.rstrip() for x in traceback.format_stack(f))
+        lines.append("\n== asyncio tasks ==")
+        for task in asyncio.all_tasks():
+            lines.append(f"\n-- task {task.get_name()} "
+                         f"(done={task.done()})")
+            for fr in task.get_stack(limit=8):
+                lines.extend(x.rstrip() for x in
+                             traceback.format_stack(fr, limit=1))
+        return web.Response(text="\n".join(lines),
+                            content_type="text/plain")
+
     async def prune_run(request):
         """Retention + GC (reference: PBS prune/GC job analog).  Body:
         {keep_last, keep_daily, keep_weekly, dry_run, gc_grace_s}; empty
@@ -686,7 +762,12 @@ echo "  --bootstrap-token <token_id:secret>"
                 keep_daily=int(b.get("keep_daily", 0)),
                 keep_weekly=int(b.get("keep_weekly", 0)))
             grace = b.get("gc_grace_s")
-            grace = float(grace) if grace is not None else None
+            if grace is not None:
+                import math
+                grace = float(grace)
+                if not math.isfinite(grace) or grace < 0:
+                    raise ValueError("gc_grace_s must be a finite value "
+                                     ">= 0")
         except (ValueError, TypeError) as e:
             return web.json_response({"error": str(e)}, status=400)
         if policy.empty():
@@ -696,9 +777,13 @@ echo "  --bootstrap-token <token_id:secret>"
                 {"error": "no retention policy (configure prune_keep_* "
                           "or pass keep_last/keep_daily/keep_weekly)"},
                 status=400)
-        report = await server.run_prune(
-            policy, dry_run=bool(b.get("dry_run", False)),
-            gc_grace_s=grace)
+        try:
+            report = await server.run_prune(
+                policy, dry_run=bool(b.get("dry_run", False)),
+                gc_grace_s=grace)
+        except RuntimeError as e:
+            # jobs in flight: the caller should retry after they finish
+            return web.json_response({"error": str(e)}, status=409)
         return web.json_response({"data": {
             "removed": report.removed, "kept": report.kept,
             "chunks_removed": report.chunks_removed,
@@ -748,6 +833,9 @@ echo "  --bootstrap-token <token_id:secret>"
     app.router.add_post("/api2/json/d2d/prune", prune_run)
     app.router.add_delete("/api2/json/d2d/snapshots/{bt}/{bid}/{ts}",
                           snapshot_delete)
+    app.router.add_get("/api2/json/d2d/snapshot-filetree",
+                       snapshot_filetree)
+    app.router.add_get("/plus/debug/stacks", debug_stacks)
     return app
 
 

@@ -205,3 +205,95 @@ def test_ui_panel_compile_and_injection(tmp_path):
     assert inject_into_index(str(idx), bundle + "\nvar v2=4;")
     html = idx.read_text()
     assert html.count(MARK_BEGIN) == 1 and "var v2=4;" in html
+
+
+def test_snapshot_filetree_and_debug_stacks(tmp_path):
+    """Stored-snapshot browser (one level per request) + the pprof-style
+    stack dump endpoint."""
+    async def main():
+        server, runner, port, tid, secret = await _mk_server(tmp_path)
+        base = f"http://127.0.0.1:{port}"
+        sec = os.urandom(12).hex().encode()
+        server.db.put_token("op", sec, kind="api")
+        hdr = {"Authorization": f"Bearer op:{sec.decode()}"}
+
+        from pbs_plus_tpu.pxar.walker import backup_tree
+        src = tmp_path / "s"
+        (src / "docs").mkdir(parents=True)
+        (src / "docs" / "a.txt").write_text("alpha")
+        (src / "docs" / "b.txt").write_text("beta")
+        (src / "top.bin").write_bytes(b"z" * 5000)
+        sess = server.datastore.start_session(backup_type="host",
+                                              backup_id="tree")
+        backup_tree(sess, str(src))
+        sess.finish()
+        snap = str(sess.ref)
+
+        async with ClientSession() as http:
+            r = await http.get(
+                f"{base}/api2/json/d2d/snapshot-filetree",
+                params={"snapshot": snap}, headers=hdr)
+            root = (await r.json())["data"]
+            assert {(e["name"], e["dir"]) for e in root} == {
+                ("docs", True), ("top.bin", False)}
+            r = await http.get(
+                f"{base}/api2/json/d2d/snapshot-filetree",
+                params={"snapshot": snap, "path": "docs"}, headers=hdr)
+            docs = (await r.json())["data"]
+            assert sorted(e["name"] for e in docs) == ["a.txt", "b.txt"]
+            assert all(not e["dir"] and e["size"] > 0 for e in docs)
+            # bad ref → 404, not 500
+            r = await http.get(
+                f"{base}/api2/json/d2d/snapshot-filetree",
+                params={"snapshot": "host/../x"}, headers=hdr)
+            assert r.status == 404
+
+            r = await http.get(f"{base}/plus/debug/stacks", headers=hdr)
+            text = await r.text()
+            assert "== threads ==" in text and "MainThread" in text
+            assert "== asyncio tasks ==" in text
+        await runner.cleanup()
+        await server.stop()
+    asyncio.run(main())
+
+
+def test_verification_source_drift(tmp_path):
+    """check_source verification: the agent re-hashes its live files;
+    a modified source reports drift, an intact one reports none."""
+    async def main():
+        import sys
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_job_isolation import _env as iso_env
+        from pbs_plus_tpu.server.verification_job import run_verification
+        server, agent, task = await iso_env(tmp_path)
+        try:
+            src = tmp_path / "vsrc"
+            src.mkdir()
+            (src / "stable.bin").write_bytes(b"s" * 40_000)
+            (src / "mutable.txt").write_text("version 1 " * 500)
+            server.db.upsert_backup_job(database.BackupJobRow(
+                id="vd", target="agent-i", source_path=str(src)))
+            server.enqueue_backup("vd")
+            await server.jobs.wait("backup:vd", timeout=60)
+            assert server.db.get_backup_job("vd").last_status == "success"
+
+            # untouched source: no drift
+            rep = await run_verification(
+                server, {"sample_rate": 1.0, "check_source": True})
+            assert rep["checked"] > 0 and not rep["corrupt"]
+            assert rep["drift"] == []
+
+            # mutate the live source → drift reported, NOT corruption
+            (src / "mutable.txt").write_text("version 2 " * 500)
+            rep = await run_verification(
+                server, {"sample_rate": 1.0, "check_source": True})
+            assert not rep["corrupt"]
+            assert rep["drift"], "drift not detected"
+            drifted = rep["drift"][0]["drifted"]
+            assert "mutable.txt" in drifted
+            assert "stable.bin" not in drifted
+        finally:
+            await agent.stop()
+            task.cancel()
+            await server.stop()
+    asyncio.run(main())
