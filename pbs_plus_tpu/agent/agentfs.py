@@ -29,6 +29,7 @@ from typing import Any
 from ..arpc.call import RawStreamHandler
 from ..arpc.router import HandlerError, Router
 from ..arpc.binary_stream import send_data_from_reader
+from ..pxar.format import read_xattrs
 from ..utils.log import L
 
 MAX_READ = 32 << 20
@@ -126,7 +127,14 @@ class AgentFSServer:
                     target = os.readlink(os.path.join(p, name))
                 except OSError:
                     pass
-            entries.append(_entry_map(name, st, target))
+            e = _entry_map(name, st, target)
+            # piggyback xattrs (POSIX ACLs travel as system.* xattrs) so
+            # the server needs no per-file RPC to preserve them
+            if not statmod.S_ISLNK(st.st_mode):
+                x = read_xattrs(os.path.join(p, name))
+                if x:
+                    e["xattrs"] = x
+            entries.append(e)
         return {"entries": entries}
 
     async def _read_link(self, req, ctx):
@@ -138,16 +146,7 @@ class AgentFSServer:
 
     async def _xattrs(self, req, ctx):
         p = self._resolve(req.payload["path"])
-        out = {}
-        try:
-            for name in os.listxattr(p, follow_symlinks=False):
-                try:
-                    out[name] = os.getxattr(p, name, follow_symlinks=False)
-                except OSError:
-                    continue
-        except OSError:
-            pass
-        return {"xattrs": out}
+        return {"xattrs": read_xattrs(p)}
 
     async def _open(self, req, ctx):
         p = self._resolve(req.payload["path"])

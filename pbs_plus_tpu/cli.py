@@ -26,7 +26,14 @@ def _cmd_server(args: argparse.Namespace) -> int:
             state_dir=args.state_dir, cert_dir=args.cert_dir,
             datastore_dir=args.datastore, arpc_host=args.host,
             arpc_port=args.arpc_port, chunker=args.chunker,
-            chunk_avg=args.chunk_avg))
+            chunk_avg=args.chunk_avg,
+            pbs_url=args.pbs_url, pbs_datastore=args.pbs_datastore,
+            pbs_token=args.pbs_token, pbs_namespace=args.pbs_namespace,
+            pbs_fingerprint=args.pbs_fingerprint,
+            prune_keep_last=args.prune_keep_last,
+            prune_keep_daily=args.prune_keep_daily,
+            prune_keep_weekly=args.prune_keep_weekly,
+            prune_schedule=args.prune_schedule))
         from .server.notify_templates import TemplateSet
         templates = TemplateSet(os.path.join(args.state_dir, "templates"))
         sink = file_spool_sink(os.path.join(args.state_dir, "notify-spool"))
@@ -326,6 +333,18 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--no-auth", action="store_true")
     s.add_argument("--print-token", action="store_true",
                    help="mint + print a bootstrap token at startup")
+    s.add_argument("--pbs-url", default="",
+                   help="push-target PBS base URL (store='pbs' jobs)")
+    s.add_argument("--pbs-datastore", default="")
+    s.add_argument("--pbs-token", default="",
+                   help="PBSAPIToken user@realm!name:secret")
+    s.add_argument("--pbs-namespace", default="")
+    s.add_argument("--pbs-fingerprint", default="")
+    s.add_argument("--prune-keep-last", type=int, default=0)
+    s.add_argument("--prune-keep-daily", type=int, default=0)
+    s.add_argument("--prune-keep-weekly", type=int, default=0)
+    s.add_argument("--prune-schedule", default="",
+                   help="calendar expr for scheduled prune+GC")
     s.set_defaults(fn=_cmd_server)
 
     a = sub.add_parser("agent", help="run the backup agent")

@@ -145,9 +145,18 @@ class ChunkStore:
             if not os.path.isdir(d):
                 continue
             for name in os.listdir(d):
-                if len(name) != 64:          # chunks only (64-hex names)
-                    continue
                 p = os.path.join(d, name)
+                if len(name) != 64:
+                    # not a chunk (e.g. a crashed writer's .tmp debris):
+                    # still reap when stale, but never count it in the
+                    # chunk accounting
+                    try:
+                        st = os.stat(p)
+                        if max(st.st_atime, st.st_mtime) < before:
+                            os.unlink(p)
+                    except OSError:
+                        pass
+                    continue
                 try:
                     st = os.stat(p)
                     if max(st.st_atime, st.st_mtime) < before:

@@ -127,6 +127,22 @@ def decode_entries(stream: BinaryIO) -> Iterator[Entry]:
         yield Entry.from_wire(codec.decode_map(body))
 
 
+def read_xattrs(p: str) -> dict[str, bytes]:
+    """All xattrs of ``p`` (no symlink follow); POSIX ACLs travel as
+    system.posix_acl_* entries.  Unreadable names are skipped — a
+    denied xattr must never fail a walk."""
+    out: dict[str, bytes] = {}
+    try:
+        for name in os.listxattr(p, follow_symlinks=False):
+            try:
+                out[name] = os.getxattr(p, name, follow_symlinks=False)
+            except OSError:
+                continue
+    except OSError:
+        pass
+    return out
+
+
 def entry_from_stat(path: str, st: os.stat_result, *,
                     link_target: str = "") -> Entry:
     """Build an Entry from an os.stat result (lstat for symlinks)."""

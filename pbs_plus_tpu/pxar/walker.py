@@ -12,7 +12,7 @@ import os
 import stat as statmod
 from typing import Callable, Iterator
 
-from .format import Entry, KIND_HARDLINK, entry_from_stat
+from .format import Entry, KIND_HARDLINK, entry_from_stat, read_xattrs
 
 ExcludeFn = Callable[[str], bool]
 
@@ -66,7 +66,9 @@ def iter_tree(root: str, *, exclude: ExcludeFn | None = None,
                     continue
                 yield entry_from_stat(rel_p, st, link_target=target), None
             elif statmod.S_ISDIR(st.st_mode):
-                yield entry_from_stat(rel_p, st), None
+                e = entry_from_stat(rel_p, st)
+                e.xattrs = read_xattrs(abs_p)
+                yield e, None
                 yield from walk(abs_p, rel_p)
             elif statmod.S_ISREG(st.st_mode):
                 key = (st.st_dev, st.st_ino)
@@ -79,7 +81,9 @@ def iter_tree(root: str, *, exclude: ExcludeFn | None = None,
                 else:
                     if st.st_nlink > 1:
                         seen_inodes[key] = rel_p
-                    yield entry_from_stat(rel_p, st), abs_p
+                    e = entry_from_stat(rel_p, st)
+                    e.xattrs = read_xattrs(abs_p)
+                    yield e, abs_p
             else:
                 # fifo / socket / device — metadata only
                 yield entry_from_stat(rel_p, st), None

@@ -202,6 +202,7 @@ class RemoteTreeBackup:
             size=m["size"] if kind == KIND_FILE else 0,
             link_target=m.get("target", ""),
             rdev=m.get("rdev", 0),
+            xattrs={k: bytes(v) for k, v in m.get("xattrs", {}).items()},
         )
 
     async def run(self) -> BackupResult:

@@ -103,6 +103,8 @@ def enqueue_restore(server, *, target: str, snapshot: str,
     server.db.create_task(upid, rid, "restore", detail=f"{snapshot} -> {destination}")
 
     async def execute():
+        while getattr(server, "_gc_active", False):   # never read mid-GC
+            await asyncio.sleep(0.5)
         await run_restore_job(server, rid, target=target, snapshot=snapshot,
                               destination=destination, subpath=subpath)
         server.db.append_task_log(upid, "restore served to agent")

@@ -68,6 +68,8 @@ async def check_source_drift(server, ref, reader, *, rng,
         return {"sampled": 0, "drifted": []}
     idx = rng.choice(len(files), size=min(max_files, len(files)),
                      replace=False)
+    from ..arpc.call import CallError
+
     sess = Session(ctl.conn)
     drifted = []
     for i in sorted(int(x) for x in idx):
@@ -78,8 +80,14 @@ async def check_source_drift(server, ref, reader, *, rng,
                                    timeout=120)
             if bytes.fromhex(resp.data["sha256"]) != e.digest:
                 drifted.append(e.path)
-        except Exception:
+        except CallError:
+            # the agent answered: the file is gone/unreadable — drift
             drifted.append(f"{e.path} (unreadable on agent)")
+        except (asyncio.TimeoutError, ConnectionError, OSError) as exc:
+            # transport trouble is NOT drift: report the abort instead
+            # of smearing the remaining samples as changed files
+            return {"sampled": int(len(idx)), "drifted": drifted,
+                    "aborted": f"agent unreachable mid-check: {exc}"}
     return {"sampled": int(len(idx)), "drifted": drifted}
 
 
@@ -113,6 +121,8 @@ def enqueue_verification(server, v: dict) -> bool:
     server.db.create_task(upid, vid, "verify")
 
     async def execute():
+        while getattr(server, "_gc_active", False):   # never read mid-GC
+            await asyncio.sleep(0.5)
         report = await run_verification(server, v)
         status = (database.STATUS_SUCCESS if not report["corrupt"]
                   else database.STATUS_ERROR)

@@ -686,6 +686,7 @@ echo "  --bootstrap-token <token_id:secret>"
     # tree browser issues (a full entry scan per click would starve the
     # shared executor on big archives)
     _tree_cache: dict[str, tuple[float, dict]] = {}
+    _tree_cache_lock = threading.Lock()   # build() runs on executor threads
 
     async def snapshot_filetree(request):
         """Browse a stored snapshot's tree one level at a time (the
@@ -701,9 +702,10 @@ echo "  --bootstrap-token <token_id:secret>"
             ds = server.datastore.datastore
             mtime = os.path.getmtime(
                 os.path.join(ds.snapshot_dir(ref), ds.MANIFEST))
-            hit = _tree_cache.get(snap)
-            if hit is not None and hit[0] == mtime:
-                return hit[1]
+            with _tree_cache_lock:
+                hit = _tree_cache.get(snap)
+                if hit is not None and hit[0] == mtime:
+                    return hit[1]
             reader = SplitReader.open_snapshot(ds, ref)
             bydir: dict[str, list] = {}
             for e in reader.entries():
@@ -713,9 +715,10 @@ echo "  --bootstrap-token <token_id:secret>"
                 bydir.setdefault(parent, []).append(
                     {"name": name, "path": e.path, "kind": e.kind,
                      "size": e.size, "dir": e.is_dir})
-            while len(_tree_cache) >= 4:
-                _tree_cache.pop(next(iter(_tree_cache)))
-            _tree_cache[snap] = (mtime, bydir)
+            with _tree_cache_lock:
+                while len(_tree_cache) >= 4:
+                    _tree_cache.pop(next(iter(_tree_cache)))
+                _tree_cache[snap] = (mtime, bydir)
             return bydir
 
         try:

@@ -331,3 +331,51 @@ def test_scheduler_triggers_due_job(env, tmp_path):
         agent_task.cancel()
         await server.stop()
     asyncio.run(main())
+
+
+def test_xattrs_roundtrip_through_agent_backup(env, tmp_path):
+    """xattrs (the POSIX-ACL carrier) survive agent backup → snapshot →
+    restore (reference: agentfs xattr/ACL preservation, acls_unix.go)."""
+    async def main():
+        server, agent, agent_task = await env()
+        src = tmp_path / "xsrc"
+        src.mkdir()
+        sub = src / "sub"
+        sub.mkdir()
+        f = src / "tagged.txt"
+        f.write_text("with xattrs")
+        try:
+            os.setxattr(f, "user.demo", b"v1")
+            os.setxattr(sub, "user.dirattr", b"d1")
+        except OSError:
+            pytest.skip("filesystem does not support user xattrs")
+
+        server.db.upsert_backup_job(database.BackupJobRow(
+            id="x1", target="agent-e2e", source_path=str(src)))
+        server.enqueue_backup("x1")
+        await server.jobs.wait("backup:x1", timeout=60)
+        row = server.db.get_backup_job("x1")
+        assert row.last_status == database.STATUS_SUCCESS, row.last_error
+
+        from pbs_plus_tpu.pxar.datastore import parse_snapshot_ref
+        r = server.datastore.open_snapshot(
+            parse_snapshot_ref(row.last_snapshot))
+        by = {e.path: e for e in r.entries()}
+        assert by["tagged.txt"].xattrs == {"user.demo": b"v1"}
+        assert by["sub"].xattrs == {"user.dirattr": b"d1"}
+
+        dest = tmp_path / "xdest"
+        server.db.create_restore("xr", "agent-e2e", row.last_snapshot,
+                                 str(dest))
+        await run_restore_job(server, "xr", target="agent-e2e",
+                              snapshot=row.last_snapshot,
+                              destination=str(dest))
+        for _ in range(100):
+            if not agent.jobs:
+                break
+            await asyncio.sleep(0.1)
+        assert os.getxattr(dest / "tagged.txt", "user.demo") == b"v1"
+        await agent.stop()
+        agent_task.cancel()
+        await server.stop()
+    asyncio.run(main())
