@@ -71,6 +71,13 @@ _READDIR = C.CFUNCTYPE(C.c_int, C.c_char_p, C.c_void_p, _FILLER, C.c_long,
                        C.c_void_p)
 _CREATE = C.CFUNCTYPE(C.c_int, C.c_char_p, C.c_uint, C.c_void_p)
 _UTIMENS = C.CFUNCTYPE(C.c_int, C.c_char_p, C.POINTER(_Timespec))
+_SETXATTR = C.CFUNCTYPE(C.c_int, C.c_char_p, C.c_char_p,
+                        C.POINTER(C.c_char), C.c_size_t, C.c_int)
+_GETXATTR = C.CFUNCTYPE(C.c_int, C.c_char_p, C.c_char_p,
+                        C.POINTER(C.c_char), C.c_size_t)
+_LISTXATTR = C.CFUNCTYPE(C.c_int, C.c_char_p, C.POINTER(C.c_char),
+                         C.c_size_t)
+_REMOVEXATTR = C.CFUNCTYPE(C.c_int, C.c_char_p, C.c_char_p)
 _VOIDP = C.c_void_p
 
 
@@ -83,8 +90,10 @@ class _FuseOps(C.Structure):        # libfuse 2.9 fuse_operations (API 26)
         ("truncate", _TRUNCATE), ("utime", _VOIDP), ("open", _OPEN),
         ("read", _READ), ("write", _WRITE), ("statfs", _VOIDP),
         ("flush", _VOIDP), ("release", _VOIDP), ("fsync", _VOIDP),
-        ("setxattr", _VOIDP), ("getxattr", _VOIDP), ("listxattr", _VOIDP),
-        ("removexattr", _VOIDP), ("opendir", _VOIDP), ("readdir", _READDIR),
+        ("setxattr", _SETXATTR), ("getxattr", _GETXATTR),
+        ("listxattr", _LISTXATTR),
+        ("removexattr", _REMOVEXATTR), ("opendir", _VOIDP),
+        ("readdir", _READDIR),
         ("releasedir", _VOIDP), ("fsyncdir", _VOIDP), ("init", _VOIDP),
         ("destroy", _VOIDP), ("access", _VOIDP), ("create", _CREATE),
         ("ftruncate", _VOIDP), ("fgetattr", _VOIDP), ("lock", _VOIDP),
@@ -205,6 +214,57 @@ class FuseMount:
             fs.rename(src.decode(), dst.decode())
             return 0
 
+        # xattr protocol (libfuse 2.9): size==0 queries the needed
+        # length; too-small buffers answer -ERANGE; absent → -ENODATA
+        @_guard
+        def op_setxattr(path: bytes, name: bytes, value, size, flags):
+            data = C.string_at(value, size) if size else b""
+            p, n = path.decode(), name.decode()
+            create = bool(flags & os.XATTR_CREATE)
+            replace = bool(flags & os.XATTR_REPLACE)
+            if create and replace:
+                return -errno.EINVAL       # real filesystems reject this
+            if create or replace:
+                exists = n in fs.get_xattrs(p)
+                if create and exists:
+                    return -errno.EEXIST
+                if replace and not exists:
+                    return -errno.ENODATA
+            fs.set_xattr(p, n, data)
+            return 0
+
+        @_guard
+        def op_getxattr(path: bytes, name: bytes, value, size):
+            data = fs.get_xattr(path.decode(), name.decode())
+            if data is None:
+                return -errno.ENODATA
+            if size == 0:
+                return len(data)
+            if size < len(data):
+                return -errno.ERANGE
+            C.memmove(value, data, len(data))
+            return len(data)
+
+        @_guard
+        def op_listxattr(path: bytes, buf, size):
+            names = sorted(fs.get_xattrs(path.decode()))
+            blob = b"".join(n.encode() + b"\0" for n in names)
+            if size == 0:
+                return len(blob)
+            if size < len(blob):
+                return -errno.ERANGE
+            if blob:
+                C.memmove(buf, blob, len(blob))
+            return len(blob)
+
+        @_guard
+        def op_removexattr(path: bytes, name: bytes):
+            p, n = path.decode(), name.decode()
+            if n not in fs.get_xattrs(p):
+                return -errno.ENODATA
+            fs.remove_xattr(p, n)
+            return 0
+
         @_guard
         def op_symlink(target: bytes, path: bytes):
             fs.symlink(path.decode(), target.decode())
@@ -256,6 +316,10 @@ class FuseMount:
         ops.chmod = _CHMOD(op_chmod)
         ops.chown = _CHOWN(op_chown)
         ops.utimens = _UTIMENS(op_utimens)
+        ops.setxattr = _SETXATTR(op_setxattr)
+        ops.getxattr = _GETXATTR(op_getxattr)
+        ops.listxattr = _LISTXATTR(op_listxattr)
+        ops.removexattr = _REMOVEXATTR(op_removexattr)
         return ops
 
     # -- lifecycle ----------------------------------------------------------

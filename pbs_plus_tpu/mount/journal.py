@@ -226,6 +226,15 @@ class Journal:
                 (node_id,)).fetchall()
         return {r["name"]: r["value"] for r in rows}
 
+    def xattr(self, node_id: int, name: str) -> bytes | None:
+        """Single-name lookup — getxattr is a hot kernel path (probe +
+        fetch per call); fetching the whole dict would double the IO."""
+        with self._lock:
+            r = self._conn.execute(
+                "SELECT value FROM xattrs WHERE node_id=? AND name=?",
+                (node_id, name)).fetchone()
+        return r["value"] if r else None
+
     # -- maintenance -------------------------------------------------------
     def sync(self) -> None:
         with self._lock:

@@ -517,6 +517,17 @@ class MutableFS:
         return dict(e.xattrs) if e else {}
 
     @_mutating
+    def get_xattr(self, path: str, name: str) -> bytes | None:
+        """Single-name lookup for the kernel getxattr hot path."""
+        r = self.resolve(path)
+        if not r.exists:
+            raise FileNotFoundError(path)
+        if r.node is not None:
+            return self.journal.xattr(r.node.id, name)
+        e = self._arch_lookup(r.arch_path)  # type: ignore[arg-type]
+        return e.xattrs.get(name) if e else None
+
+    @_mutating
     def remove_xattr(self, path: str, name: str) -> None:
         n = self._node_for_meta(path)
         self.journal.del_xattr(n.id, name)

@@ -98,3 +98,34 @@ def test_posix_error_mapping(mount):
         os.rmdir(f"{mp}/sub")          # not empty
     with pytest.raises(FileExistsError):
         os.mkdir(f"{mp}/dir1")
+
+
+def test_kernel_xattrs(mount):
+    """xattr ops over the kernel mount: set/get/list/remove, ERANGE/
+    ENODATA protocol, persistence through a commit + fresh snapshot."""
+    m, fs, engine, store, mp, src = mount
+    f = os.path.join(mp, "a.txt")
+    os.setxattr(f, "user.k1", b"v1")
+    os.setxattr(f, "user.k2", b"longer-value-2")
+    assert os.getxattr(f, "user.k1") == b"v1"
+    assert sorted(os.listxattr(f)) == ["user.k1", "user.k2"]
+    os.removexattr(f, "user.k2")
+    assert os.listxattr(f) == ["user.k1"]
+    with pytest.raises(OSError):
+        os.getxattr(f, "user.gone")
+    with pytest.raises(OSError):
+        os.removexattr(f, "user.gone")
+
+    # XATTR_REPLACE on a missing name fails; CREATE on existing fails
+    with pytest.raises(OSError):
+        os.setxattr(f, "user.nope", b"x", os.XATTR_REPLACE)
+    with pytest.raises(OSError):
+        os.setxattr(f, "user.k1", b"x", os.XATTR_CREATE)
+
+    # survives the commit → next snapshot carries the xattr
+    ref = engine.commit()
+    r = store.open_snapshot(ref)
+    by = {e.path: e for e in r.entries()}
+    assert by["a.txt"].xattrs.get("user.k1") == b"v1"
+    # and the live mount still serves it post-hot-swap
+    assert os.getxattr(f, "user.k1") == b"v1"
