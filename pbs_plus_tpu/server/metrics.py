@@ -230,6 +230,19 @@ class MetricsRegistry:
               [({}, float(len(ms.mounts) if ms else 0))])
         gauge("pbs_plus_uptime_seconds", "Server uptime",
               [({}, now - s.started_at)])
+        lp = getattr(s, "last_prune", {})
+        gauge("pbs_plus_prune_last_run_timestamp",
+              "Unix time of the last prune+GC",
+              [({}, lp["at"])] if lp else [])
+        gauge("pbs_plus_prune_last_removed_snapshots",
+              "Snapshots removed by the last prune",
+              [({}, float(lp["removed"]))] if lp else [])
+        gauge("pbs_plus_prune_last_chunks_removed",
+              "Chunks collected by the last GC",
+              [({}, float(lp["chunks_removed"]))] if lp else [])
+        gauge("pbs_plus_prune_last_bytes_freed",
+              "Bytes freed by the last GC",
+              [({}, float(lp["bytes_freed"]))] if lp else [])
         gauge("pbs_plus_db_bytes", "SQLite database size",
               [({}, float(s.db.file_size()))])
         gauge("pbs_plus_scrape_timestamp", "Scrape time", [({}, time.time())])

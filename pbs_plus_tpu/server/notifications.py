@@ -167,7 +167,33 @@ class AlertScanner:
                                f"target {t['name']} offline",
                                {"template": "alert-target-offline",
                                 "target": t["name"]}))
+        alerts.extend(self._datastore_usage_alert())
         return alerts
+
+    def _datastore_usage_alert(self) -> list[tuple[str, str, dict]]:
+        """Filesystem fill alert for the datastore volume (threshold via
+        alert setting datastore_usage_pct, default 90; errors at 98)."""
+        try:
+            pct = float(self.server.db.get_alert_setting(
+                "datastore_usage_pct", "90"))
+        except ValueError:
+            pct = 90.0
+        try:
+            sv = os.statvfs(self.server.config.datastore_dir)
+        except OSError:
+            return []
+        total = sv.f_blocks * sv.f_frsize
+        if not total:
+            return []
+        used = total - sv.f_bavail * sv.f_frsize
+        used_pct = 100.0 * used / total
+        if used_pct < pct:
+            return []
+        sev = "error" if used_pct >= 98.0 else "warning"
+        return [(sev, "datastore volume filling up",
+                 {"template": "alert-datastore-usage",
+                  "percent": round(used_pct, 1), "used": used,
+                  "total": total})]
 
     def _quiet_now(self, now: float) -> bool:
         lt = time.localtime(now)
@@ -185,9 +211,13 @@ class AlertScanner:
         for severity, title, body in alerts:
             if quiet and severity != "error":
                 continue                 # warnings wait out quiet windows
-            if now - self._last_alert.get(title, 0) < self.cooldown_s:
+            # cooldown per (severity, title): an escalation (warning →
+            # error, e.g. the fill alert crossing 98%) must deliver
+            # immediately, not wait out the warning's cooldown
+            key = f"{severity}:{title}"
+            if now - self._last_alert.get(key, 0) < self.cooldown_s:
                 continue
-            self._last_alert[title] = now
+            self._last_alert[key] = now
             tmpl = body.get("template")
             if tmpl:
                 try:

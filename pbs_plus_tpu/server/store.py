@@ -103,6 +103,7 @@ class Server:
         self.job_rpc = None             # unix-socket job mutation service
         self._prune_lock = asyncio.Lock()   # serializes prune/GC/delete
         self._gc_active = False             # backups wait while GC runs
+        self.last_prune: dict = {}          # metrics: last prune/GC stats
         self._tasks: list[asyncio.Task] = []
         self.log = L.with_scope(component="server")
         # observability state (metrics.py): live per-job progress objects
@@ -316,9 +317,16 @@ class Server:
                         f"job(s) active")
                 self._gc_active = True
             try:
-                return await asyncio.get_running_loop().run_in_executor(
+                report = await asyncio.get_running_loop().run_in_executor(
                     None, lambda: run_prune(self.datastore.datastore,
                                             policy, dry_run=dry_run, **kw))
+                if not dry_run:
+                    self.last_prune = {
+                        "at": time.time(),
+                        "removed": len(report.removed),
+                        "chunks_removed": report.chunks_removed,
+                        "bytes_freed": report.bytes_freed}
+                return report
             finally:
                 self._gc_active = False
 

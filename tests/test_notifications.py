@@ -160,3 +160,29 @@ def test_alert_settings_from_db_apply(tmp_path):
         assert sc.cooldown_s == 120.0
         await server.stop()
     asyncio.run(main())
+
+
+def test_datastore_usage_alert(tmp_path):
+    """The fill alert fires when usage crosses the configured threshold
+    (statvfs-based; threshold driven by the alert-settings API)."""
+    async def main():
+        server = Server(ServerConfig(
+            state_dir=str(tmp_path / "s"), cert_dir=str(tmp_path / "c"),
+            datastore_dir=str(tmp_path / "d"), max_concurrent=2))
+        await server.start()
+        events = []
+        sc = AlertScanner(server, sink=lambda s, t, b: events.append((s, t, b)))
+        # threshold 0 → always fires on any real filesystem
+        server.db.put_alert_setting("datastore_usage_pct", "0")
+        sc._emit(sc.scan())
+        hits = [b for _, t, b in events if "filling" in t]
+        assert hits and 0 <= hits[0]["percent"] <= 100
+        assert "text" in hits[0] and "%" in hits[0]["text"]
+        # threshold 101 → never fires
+        events.clear()
+        sc._last_alert.clear()
+        server.db.put_alert_setting("datastore_usage_pct", "101")
+        sc._emit(sc.scan())
+        assert not [t for _, t, _ in events if "filling" in t]
+        await server.stop()
+    asyncio.run(main())
