@@ -130,7 +130,8 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
         open_paths = ("/plus/healthz", "/plus/readyz", "/plus/metrics",
                       "/plus/agent/bootstrap", "/plus/agent/renew",
                       "/plus/agent/install.sh", "/plus/agent/pyz",
-                      "/plus/ui")
+                      "/plus/agent/binary", "/plus/agent/version",
+                      "/plus/agent/signer.pub", "/plus/ui")
         if not require_auth or request.path in open_paths:
             return await handler(request)
         hdr = request.headers.get("Authorization", "")
@@ -677,6 +678,23 @@ echo "  --bootstrap-token <token_id:secret>"
             pyz, headers={"Content-Disposition":
                           'attachment; filename="pbs-plus-tpu-agent.pyz"'})
 
+    async def agent_version(request):
+        """Update metadata the agent Updater polls: version (content
+        hash), sha256, Ed25519 signature over the artifact (reference:
+        the server's agent version endpoint + signed binary download the
+        updater/binswap consumes)."""
+        info = await asyncio.get_running_loop().run_in_executor(
+            None, _agent_release_info, server)
+        return web.json_response(info)
+
+    async def agent_signer_pub(request):
+        """The release-signing public key (fetched at install time;
+        pinned by the agent thereafter)."""
+        pub = await asyncio.get_running_loop().run_in_executor(
+            None, _signer_keys, server)
+        return web.Response(body=pub[1],
+                            content_type="application/x-pem-file")
+
     async def ui_page(request):
         from .ui import DASHBOARD_HTML
         return web.Response(text=DASHBOARD_HTML, content_type="text/html")
@@ -832,6 +850,9 @@ echo "  --bootstrap-token <token_id:secret>"
     app.router.add_get("/plus/notifications", notifications_list)
     app.router.add_get("/plus/agent/install.sh", agent_install_sh)
     app.router.add_get("/plus/agent/pyz", agent_pyz)
+    app.router.add_get("/plus/agent/binary", agent_pyz)   # updater alias
+    app.router.add_get("/plus/agent/version", agent_version)
+    app.router.add_get("/plus/agent/signer.pub", agent_signer_pub)
     app.router.add_get("/plus/ui", ui_page)
     app.router.add_post("/api2/json/d2d/prune", prune_run)
     app.router.add_delete("/api2/json/d2d/snapshots/{bt}/{bid}/{ts}",
@@ -842,7 +863,82 @@ echo "  --bootstrap-token <token_id:secret>"
     return app
 
 
-_pyz_lock = __import__("threading").Lock()
+_pyz_lock = threading.Lock()
+_release_cache: dict = {}
+
+
+def _signer_keys(server) -> tuple[bytes, bytes]:
+    """(private_pem, public_pem) of the release-signing key —
+    load-or-create Ed25519 under the state dir (reference: the signer
+    key whose signatures updater/binswap verify)."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+
+    key_p = os.path.join(server.config.state_dir, "signer.key")
+    pub_p = key_p + ".pub"
+    with _pyz_lock:
+        if os.path.exists(key_p):
+            # NEVER regenerate while a private key exists — agents pin
+            # the public key at install; a new pair would brick fleet
+            # auto-update silently.  The pub is derived, not trusted
+            # from disk, so a missing/partial .pub self-heals.
+            priv = open(key_p, "rb").read()
+            key = serialization.load_pem_private_key(priv, password=None)
+            pub = key.public_key().public_bytes(
+                serialization.Encoding.PEM,
+                serialization.PublicFormat.SubjectPublicKeyInfo)
+            if not os.path.exists(pub_p):
+                tmp = f"{pub_p}.tmp.{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    f.write(pub)
+                os.replace(tmp, pub_p)
+            return priv, pub
+        key = ed25519.Ed25519PrivateKey.generate()
+        priv = key.private_bytes(serialization.Encoding.PEM,
+                                 serialization.PrivateFormat.PKCS8,
+                                 serialization.NoEncryption())
+        pub = key.public_key().public_bytes(
+            serialization.Encoding.PEM,
+            serialization.PublicFormat.SubjectPublicKeyInfo)
+        for path, data in ((pub_p, pub), (key_p, priv)):
+            tmp = f"{path}.tmp.{os.getpid()}"
+            fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            os.write(fd, data)
+            os.close(fd)
+            os.replace(tmp, path)      # priv lands LAST: its presence
+        return priv, pub               # implies the pub is complete
+
+
+_RELEASE_TTL_S = 30.0
+
+
+def _agent_release_info(server) -> dict:
+    """{version, sha256, signature} for the current agent artifact.
+    Short-TTL cached BEFORE touching the pyz builder — a fleet's version
+    polls must not each walk the package tree under the build lock."""
+    import hashlib
+
+    from cryptography.hazmat.primitives import serialization
+
+    state = server.config.state_dir
+    hit = _release_cache.get(state)
+    now = time.monotonic()
+    if hit is not None and now - hit[2] < _RELEASE_TTL_S:
+        return hit[1]
+    pyz = _build_agent_pyz(state)
+    mtime = os.path.getmtime(pyz)
+    if hit is not None and hit[0] == mtime:
+        _release_cache[state] = (mtime, hit[1], now)
+        return hit[1]
+    data = open(pyz, "rb").read()
+    digest = hashlib.sha256(data).hexdigest()
+    priv_pem, _pub = _signer_keys(server)
+    key = serialization.load_pem_private_key(priv_pem, password=None)
+    sig = key.sign(data)
+    info = {"version": digest[:16], "sha256": digest,
+            "signature": sig.hex(), "size": len(data)}
+    _release_cache[state] = (mtime, info, now)
+    return info
 
 
 def _build_agent_pyz(state_dir: str) -> str:

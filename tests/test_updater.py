@@ -75,3 +75,63 @@ def test_watchdog_rollback_on_crash_loop(tmp_path):
     assert wd.on_boot() == "grace"      # boot 2 (crashed, restarted)
     assert wd.on_boot() == "rolled-back"  # boot 3 → crash loop
     assert live.read_bytes() == b"v1"
+
+
+def test_update_loop_against_live_server(tmp_path):
+    """The full auto-update loop: server signs its agent artifact; the
+    Updater polls /plus/agent/version, downloads /plus/agent/binary,
+    verifies the Ed25519 signature against /plus/agent/signer.pub, and
+    stages the swap (reference: updater poll → verify → stage)."""
+    import asyncio
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from aiohttp import ClientSession
+    from test_web import _mk_server
+    from pbs_plus_tpu.agent.updater import BinSwap, SwapState, Updater
+
+    async def main():
+        server, runner, port, tid, secret = await _mk_server(tmp_path)
+        base = f"http://127.0.0.1:{port}"
+        async with ClientSession() as http:
+            pub = await (await http.get(f"{base}/plus/agent/signer.pub")
+                         ).read()
+            assert b"PUBLIC KEY" in pub
+            info = await (await http.get(f"{base}/plus/agent/version")
+                          ).json()
+            assert info["sha256"] and info["signature"]
+
+            state = tmp_path / "swapstate"
+            state.mkdir()
+            target = tmp_path / "agent.pyz"
+            target.write_bytes(b"old build")
+            swap = BinSwap(SwapState(str(target), str(state)))
+            up = Updater(swap, current_version="old",
+                         signing_pubkey_pem=pub)
+            staged = await up.check_and_stage(http, base)
+            assert staged == info["version"]
+            assert os.path.exists(swap.st.staged_path)
+            # staged bytes hash-match the advertised release
+            import hashlib
+            got = hashlib.sha256(
+                open(swap.st.staged_path, "rb").read()).hexdigest()
+            assert got == info["sha256"]
+
+            # same version again → no re-stage
+            up2 = Updater(swap, current_version=info["version"],
+                          signing_pubkey_pem=pub)
+            assert await up2.check_and_stage(http, base) is None
+
+            # a wrong pubkey rejects the artifact
+            from cryptography.hazmat.primitives import serialization
+            from cryptography.hazmat.primitives.asymmetric import ed25519
+            evil = ed25519.Ed25519PrivateKey.generate().public_key()
+            evil_pem = evil.public_bytes(
+                serialization.Encoding.PEM,
+                serialization.PublicFormat.SubjectPublicKeyInfo)
+            up3 = Updater(swap, current_version="old",
+                          signing_pubkey_pem=evil_pem)
+            assert await up3.check_and_stage(http, base) is None
+        await runner.cleanup()
+        await server.stop()
+    asyncio.run(main())
