@@ -92,10 +92,11 @@ def iter_tree(root: str, *, exclude: ExcludeFn | None = None,
 
 
 def backup_tree(session, root: str, *, exclude: ExcludeFn | None = None,
-                on_error=None) -> int:
+                on_error=None, counters: dict | None = None) -> int:
     """Stream a directory tree into a BackupSession's writer.  Returns the
-    number of entries written.  (The minimal end-to-end slice's local-target
-    path; the agent path streams the same entries over aRPC.)"""
+    number of entries written; ``counters`` (optional dict) accumulates
+    ``files``/``bytes`` for job stats.  (The minimal end-to-end slice's
+    local-target path; the agent path streams the same entries over aRPC.)"""
     w = session.writer
     n = 0
     for entry, src in iter_tree(root, exclude=exclude, on_error=on_error):
@@ -107,6 +108,9 @@ def backup_tree(session, root: str, *, exclude: ExcludeFn | None = None,
                 if on_error:
                     on_error(entry.path, e)
                 continue
+            if counters is not None:
+                counters["files"] = counters.get("files", 0) + 1
+                counters["bytes"] = counters.get("bytes", 0) + entry.size
         else:
             w.write_entry(entry)
         n += 1

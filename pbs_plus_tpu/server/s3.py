@@ -137,16 +137,18 @@ class S3Client:
 
 
 async def backup_s3_tree(client: S3Client, session, *,
-                         exclusions: list[str] | None = None) -> int:
+                         exclusions: list[str] | None = None,
+                         counters: dict | None = None) -> int:
     """Walk an S3 bucket (prefix) into a BackupSession — keys become
     archive paths, '/'-separated components become directories.
-    Returns entries written."""
-    import fnmatch
+    Returns entries written; ``counters`` accumulates files/bytes.
+    Exclusions use the one shared semantic (backup_job.match_exclusion),
+    identical across agent/local/s3 target kinds."""
     import queue as _q
     import threading
 
     from ..pxar.format import Entry, KIND_DIR, KIND_FILE
-    from .backup_job import _QueuePumpReader, _SENTINEL
+    from .backup_job import _QueuePumpReader, _SENTINEL, match_exclusion
 
     objects = []
     async for o in client.list_objects():
@@ -155,7 +157,7 @@ async def backup_s3_tree(client: S3Client, session, *,
             else key
         if not rel or rel.endswith("/"):
             continue
-        if exclusions and any(fnmatch.fnmatch(rel, p) for p in exclusions):
+        if exclusions and match_exclusion(rel, exclusions):
             continue
         objects.append((rel, key, o["size"]))
     objects.sort(key=lambda x: tuple(x[0].split("/")))
@@ -209,5 +211,8 @@ async def backup_s3_tree(client: S3Client, session, *,
             await loop.run_in_executor(None, t.join)
         if exc:
             raise exc[0]
+        if counters is not None:
+            counters["files"] = counters.get("files", 0) + 1
+            counters["bytes"] = counters.get("bytes", 0) + size
         n += 1
     return n

@@ -24,7 +24,7 @@ from ..utils.log import L
 from ..utils.mtls import CertManager
 from . import database
 from .backup_job import (make_batch_hasher, make_chunker_factory,
-                         run_backup_job)
+                         run_target_backup)
 from .jobs import Job, JobsManager
 from .scheduler import Scheduler
 
@@ -424,7 +424,7 @@ class Server:
 
             def on_pump(result):
                 self.live_progress[row.id] = (t0, result)
-            res = await run_backup_job(
+            res = await run_target_backup(
                 run_row, db=self.db, agents=self.agents, store=store,
                 on_pump=on_pump)
             result_box["res"] = res
