@@ -21,6 +21,10 @@ def _cmd_server(args: argparse.Namespace) -> int:
     from .server.web import start_web
     from .server.notifications import AlertScanner, BatchTracker, file_spool_sink
 
+    if args.log_file:
+        from .utils.log import add_rotating_file
+        add_rotating_file(args.log_file)
+
     async def main():
         server = Server(ServerConfig(
             state_dir=args.state_dir, cert_dir=args.cert_dir,
@@ -345,6 +349,8 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--prune-keep-weekly", type=int, default=0)
     s.add_argument("--prune-schedule", default="",
                    help="calendar expr for scheduled prune+GC")
+    s.add_argument("--log-file", default="",
+                   help="size-rotated JSON log file (50 MiB x 5)")
     s.set_defaults(fn=_cmd_server)
 
     a = sub.add_parser("agent", help="run the backup agent")

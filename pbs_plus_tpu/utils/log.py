@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import logging
 import sys
 import threading
@@ -98,3 +99,24 @@ L = Logger()
 
 def set_level(level: int) -> None:
     _root.setLevel(level)
+
+
+def add_rotating_file(path: str, *, max_bytes: int = 50 << 20,
+                      backups: int = 5) -> "logging.Handler":
+    """Size-rotated JSON log file (reference: lumberjack rotation,
+    internal/log/log_unix.go).  Returns the handler so callers can
+    remove it on shutdown."""
+    import logging.handlers
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                exist_ok=True)
+    h = logging.handlers.RotatingFileHandler(
+        path, maxBytes=max_bytes, backupCount=backups)
+    h.setFormatter(_JSONFormatter())
+    _root.addHandler(h)
+    return h
+
+
+def remove_rotating_file(h: "logging.Handler") -> None:
+    """Detach + close a handler returned by add_rotating_file."""
+    _root.removeHandler(h)
+    h.close()

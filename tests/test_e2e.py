@@ -471,3 +471,40 @@ def test_s3_target_backup_job(tmp_path):
         await server.stop()
         await runner.cleanup()
     asyncio.run(main())
+
+
+def test_subpath_restore(env, tmp_path):
+    """Restore only a subtree of a snapshot (reference: restore with a
+    subpath — the remote-archive server scopes to it)."""
+    async def main():
+        server, agent, agent_task = await env()
+        src = tmp_path / "spsrc"
+        (src / "docs").mkdir(parents=True)
+        (src / "data").mkdir()
+        (src / "docs" / "keep.txt").write_text("subtree me")
+        (src / "data" / "skip.bin").write_bytes(b"x" * 10_000)
+        server.db.upsert_backup_job(database.BackupJobRow(
+            id="sp", target="agent-e2e", source_path=str(src)))
+        server.enqueue_backup("sp")
+        await server.jobs.wait("backup:sp", timeout=60)
+        row = server.db.get_backup_job("sp")
+        assert row.last_status == database.STATUS_SUCCESS, row.last_error
+
+        dest = tmp_path / "spdest"
+        server.db.create_restore("spr", "agent-e2e", row.last_snapshot,
+                                 str(dest), subpath="docs")
+        await run_restore_job(server, "spr", target="agent-e2e",
+                              snapshot=row.last_snapshot,
+                              destination=str(dest), subpath="docs")
+        for _ in range(100):
+            if not agent.jobs:
+                break
+            await asyncio.sleep(0.1)
+        restored = {os.path.relpath(os.path.join(dp, f), dest)
+                    for dp, _, fs in os.walk(dest) for f in fs}
+        assert "keep.txt" in restored
+        assert not any("skip.bin" in r for r in restored)
+        await agent.stop()
+        agent_task.cancel()
+        await server.stop()
+    asyncio.run(main())

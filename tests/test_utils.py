@@ -106,3 +106,24 @@ def test_validate_paths():
     assert validate.hostname("node-1.example.com")
     with pytest.raises(validate.ValidationError):
         validate.hostname("-bad-")
+
+
+def test_rotating_log_file(tmp_path):
+    """Size-rotated JSON file logging (reference: lumberjack rotation)."""
+    import json as _json
+
+    from pbs_plus_tpu.utils.log import (
+        L, add_rotating_file, remove_rotating_file)
+
+    path = tmp_path / "srv.log"
+    h = add_rotating_file(str(path), max_bytes=4000, backups=2)
+    try:
+        for i in range(200):
+            L.info("rotation line %d with some padding payload", i)
+        files = sorted(p.name for p in tmp_path.glob("srv.log*"))
+        assert "srv.log" in files and len(files) >= 2   # rotated
+        line = open(path).readlines()[-1]
+        rec = _json.loads(line)
+        assert rec["level"] == "INFO" and "rotation line" in rec["msg"]
+    finally:
+        remove_rotating_file(h)
