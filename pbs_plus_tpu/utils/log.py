@@ -107,8 +107,7 @@ def add_rotating_file(path: str, *, max_bytes: int = 50 << 20,
     internal/log/log_unix.go).  Returns the handler so callers can
     remove it on shutdown."""
     import logging.handlers
-    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
-                exist_ok=True)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     h = logging.handlers.RotatingFileHandler(
         path, maxBytes=max_bytes, backupCount=backups)
     h.setFormatter(_JSONFormatter())

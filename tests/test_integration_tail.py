@@ -267,3 +267,51 @@ def test_mtfprobe_cli(tmp_path, capsys):
     rc = cli_main(["mtfprobe", str(tmp_path / "trunc.bkf")])
     rc2 = cli_main(["mtfprobe", str(tmp_path / "trunc.bkf"), "--lenient"])
     assert rc2 == 0 and rc in (0, 1)
+
+
+def test_mtf_to_pbs_with_inventory(tmp_path):
+    """The tape-migration chain: MTF media → converter → PBS upload
+    (mock) → cartridge inventory mapping (reference: tapeio converter
+    consuming backupproxy.NewPBSStore, converter.go:15, + the mtf
+    store's dataset→snapshot records)."""
+    from mock_pbs import MockPBS
+    from pbs_plus_tpu.chunker import ChunkerParams
+    from pbs_plus_tpu.pxar.datastore import Datastore
+    from pbs_plus_tpu.pxar.pbsstore import PBSConfig, PBSStore
+    from pbs_plus_tpu.tapeio.converter import convert_mtf_to_snapshot
+    from pbs_plus_tpu.tapeio.inventory import CartridgeInventory
+    from pbs_plus_tpu.tapeio.mtf import write_synthetic_mtf
+
+    media = tmp_path / "LTO007.bkf"
+    tree = {"acme": None, "acme/db.bak": b"D" * 40_000,
+            "acme/logs": None, "acme/logs/app.log": b"log line\n" * 500}
+    with open(media, "wb") as f:
+        write_synthetic_mtf(f, tree)
+
+    pbs = MockPBS()
+    try:
+        store = PBSStore(PBSConfig(base_url=pbs.base_url,
+                                   datastore="tank",
+                                   auth_token=pbs.token),
+                         ChunkerParams(avg_size=1 << 14))
+        sess = store.start_session(backup_type="host",
+                                   backup_id="tape-acme",
+                                   backup_time=1_753_000_000)
+        with open(media, "rb") as f:
+            res = convert_mtf_to_snapshot(f, sess)
+        sess.finish({"source_media": "LTO007"})
+        assert res.files == 2 and res.entries >= 4
+
+        ref = next(iter(pbs.snapshots))
+        payload = pbs.read_stream(ref, Datastore.PAYLOAD_IDX)
+        assert payload == tree["acme/db.bak"] + tree["acme/logs/app.log"]
+
+        inv = CartridgeInventory(str(tmp_path / "tapes.db"))
+        inv.record_dataset("LTO007", "acme", file_mark=0, snapshot=ref,
+                           bytes_=len(payload))
+        hit = inv.find_dataset("acme")[0]
+        assert hit["snapshot"] == ref
+        assert inv.unconverted() == []
+        inv.close()
+    finally:
+        pbs.close()

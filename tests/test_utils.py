@@ -118,8 +118,11 @@ def test_rotating_log_file(tmp_path):
     path = tmp_path / "srv.log"
     h = add_rotating_file(str(path), max_bytes=4000, backups=2)
     try:
+        import uuid
+        run_tag = uuid.uuid4().hex[:8]   # defeat the global log dedup
         for i in range(200):
-            L.info("rotation line %d with some padding payload", i)
+            L.info("rotation line %s-%d with some padding payload",
+                   run_tag, i)
         files = sorted(p.name for p in tmp_path.glob("srv.log*"))
         assert "srv.log" in files and len(files) >= 2   # rotated
         line = open(path).readlines()[-1]
