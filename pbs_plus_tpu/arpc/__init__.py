@@ -15,7 +15,9 @@ routing key (identity model, SURVEY §5.8).
 QUIC note: the reference's control plane rides QUIC for connection
 migration + head-of-line avoidance; no QUIC stack is baked into this image,
 so the control plane multiplexes over the same TCP transport (a transport
-abstraction keeps the door open).
+abstraction keeps the door open).  The full control/data separation
+design — per-job data connections, crashed-job detection, flow control —
+is docs/data-plane.md.
 """
 
 from .mux import MuxConnection, MuxStream, MuxError
