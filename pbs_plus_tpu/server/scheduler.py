@@ -38,6 +38,7 @@ class Scheduler:
         self._last_enqueued: dict[str, dt.datetime] = {}
         self._retry_at: dict[str, float] = {}
         self._pending_verifications: set[str] = set()
+        self._trigger_tasks: set[asyncio.Task] = set()
         self._stop = asyncio.Event()
 
     async def run(self) -> None:
@@ -111,11 +112,40 @@ class Scheduler:
 
     # -- verifications -----------------------------------------------------
     def on_backup_complete(self, store: str) -> None:
-        """Mark run-on-backup verifications pending (reference:
-        OnBackupComplete → TriggerPendingVerifications)."""
+        """Mark run-on-backup verifications pending AND trigger them
+        immediately (reference: OnBackupComplete →
+        TriggerPendingVerifications fires right away, scheduler.go:320 —
+        not at the next 30 s tick)."""
+        marked = False
         for v in self.db.list_verification_jobs():
             if v["run_on_backup"] and (not v["store"] or v["store"] == store):
                 self._pending_verifications.add(v["id"])
+                marked = True
+        if marked:
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return                  # no loop: the next tick picks it up
+            t = loop.create_task(self._fire_pending())
+            self._trigger_tasks.add(t)          # strong ref (loop keeps
+            t.add_done_callback(self._trigger_tasks.discard)  # weak only)
+
+    async def _fire_pending(self) -> None:
+        """Enqueue ONLY the pending set, immediately — never calendar
+        evaluation, so the concurrent periodic tick cannot double-enqueue
+        a schedule-due job.  Failures keep the id pending (the next tick
+        retries) and are logged, never lost to task GC."""
+        if self.enqueue_verification is None:
+            return
+        for v in self.db.list_verification_jobs():
+            if v["id"] not in self._pending_verifications:
+                continue
+            self._pending_verifications.discard(v["id"])
+            try:
+                await self.enqueue_verification(v)
+            except Exception:
+                self._pending_verifications.add(v["id"])
+                L.exception("pending verification enqueue failed")
 
     async def _tick_verifications(self, now: dt.datetime) -> None:
         if self.enqueue_verification is None:

@@ -117,6 +117,10 @@ def enqueue_verification(server, v: dict) -> bool:
     from .jobs import Job
     from .store import make_upid
     vid = v["id"]
+    if server.jobs.is_active(f"verify:{vid}"):
+        # dedup BEFORE creating the task row: a deduped enqueue must not
+        # leave an orphan task_log entry stuck "running" forever
+        return False
     upid = make_upid("verify", vid)
     server.db.create_task(upid, vid, "verify")
 

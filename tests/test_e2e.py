@@ -508,3 +508,38 @@ def test_subpath_restore(env, tmp_path):
         agent_task.cancel()
         await server.stop()
     asyncio.run(main())
+
+
+def test_verification_triggers_on_backup_complete(env, tmp_path):
+    """run_on_backup verification fires automatically after a backup
+    (reference: OnBackupComplete → TriggerPendingVerifications,
+    scheduler.go:320)."""
+    async def main():
+        server, agent, agent_task = await env()
+        server.db.upsert_verification_job("auto-v", sample_rate=1.0,
+                                          run_on_backup=True)
+        src = tmp_path / "vtrig"
+        src.mkdir()
+        (src / "f.bin").write_bytes(b"verify me " * 5000)
+        server.db.upsert_backup_job(database.BackupJobRow(
+            id="vt", target="agent-e2e", source_path=str(src)))
+        server.enqueue_backup("vt")
+        await server.jobs.wait("backup:vt", timeout=60)
+        assert server.db.get_backup_job("vt").last_status == \
+            database.STATUS_SUCCESS
+
+        # the pending verification was enqueued by the completion hook
+        for _ in range(150):
+            v = server.db.get_verification_job("auto-v")
+            if v and v["last_status"]:
+                break
+            await asyncio.sleep(0.1)
+        v = server.db.get_verification_job("auto-v")
+        assert v["last_status"] == database.STATUS_SUCCESS, v
+        import json as _json
+        rep = _json.loads(v["last_report"])
+        assert rep["checked"] > 0 and not rep["corrupt"]
+        await agent.stop()
+        agent_task.cancel()
+        await server.stop()
+    asyncio.run(main())
