@@ -165,6 +165,9 @@ class MuxStream:
         self._maybe_retire()
 
     def _on_rst(self) -> None:
+        # no retire here: RST kills both directions, so _dispatch pops the
+        # table entry unconditionally (single owner for RST retirement) —
+        # unlike FIN, which must wait for the local side via _maybe_retire
         self._rx_reset = True
         self._rx_event.set()
         self._tx_event.set()

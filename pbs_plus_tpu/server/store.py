@@ -256,6 +256,12 @@ class Server:
             raise PermissionError("invalid bootstrap token")
         cert_pem = self.certs.sign_csr(csr_pem)
         from ..utils.mtls import common_name
+        from ..utils import validate
+        # same mint-time gate as the manual target route: the hostname
+        # becomes a target name (a datastore path component) and is
+        # rendered in the dashboard — a token holder must not be able to
+        # store an arbitrary string here
+        validate.hostname(hostname)
         cn = common_name(cert_pem)
         if cn != hostname:
             raise PermissionError(f"CSR CN {cn!r} != hostname {hostname!r}")
@@ -376,9 +382,26 @@ class Server:
         store = self.datastore
         if row.store == "pbs":
             if not self.config.pbs_url:
-                raise RuntimeError(
-                    f"job {row.id!r} wants store='pbs' but no PBS push "
-                    f"target is configured (ServerConfig.pbs_url)")
+                # Record as a job error rather than raising: a raise here
+                # would abort the scheduler tick mid-loop and starve every
+                # due job sorted after the misconfigured one.
+                msg = (f"job {row.id!r} wants store='pbs' but no PBS push "
+                       f"target is configured (ServerConfig.pbs_url)")
+                self.log.error("%s", msg)
+                self.db.append_task_log(upid, f"error: {msg}")
+                self.db.finish_task(upid, database.STATUS_ERROR)
+                self.db.record_backup_result(row.id, database.STATUS_ERROR,
+                                             error=msg)
+                if self.notifications is not None:
+                    self.notifications.record(row.id, database.STATUS_ERROR,
+                                              detail=msg)
+                try:    # post-script fires on every failed run (on_error
+                        # parity); enqueue_backup itself is sync
+                    asyncio.get_running_loop().create_task(self._post_hook(
+                        row, database.STATUS_ERROR, error=msg))
+                except RuntimeError:
+                    pass
+                return False
             from ..pxar.pbsstore import PBSConfig, PBSStore
             kind = row.chunker or self.config.chunker
             store = PBSStore(

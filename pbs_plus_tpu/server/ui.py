@@ -108,48 +108,59 @@ async function api(path,opts){const r=await fetch(path,
  Object.assign({headers:hdrs()},opts||{}));
  if(!r.ok)throw new Error(path+': '+r.status);return r.json()}
 function cls(s){return s==='success'?'ok':(s==='error'?'err':'warn')}
+// every API-derived value goes through esc() before innerHTML — target
+// hostnames (and anything else a token holder can write) are untrusted
+function esc(s){return String(s).replace(/[&<>"']/g,c=>({'&':'&amp;',
+ '<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
 function row(cells){return '<tr>'+cells.map(c=>'<td>'+c+'</td>')
  .join('')+'</tr>'}
 async function load(){
  try{
   const jobs=(await api('/api2/json/d2d/backup')).data;
   $('jobs').innerHTML='<tr><th>id</th><th>target</th><th>status</th>'+
-   '<th>last snapshot</th><th></th></tr>'+jobs.map(j=>row([j.id,j.target,
-   `<span class="${cls(j.last_status)}">${j.last_status??'—'}${
+   '<th>last snapshot</th><th></th></tr>'+jobs.map(j=>row([esc(j.id),
+   esc(j.target),
+   `<span class="${cls(j.last_status)}">${esc(j.last_status??'—')}${
       j.running?' ▶':''}</span>`,
-   j.last_snapshot??'<span class=muted>—</span>',
-   `<button onclick="runJob('${j.id}')">run</button>`])).join('');
+   j.last_snapshot!=null?esc(j.last_snapshot):'<span class=muted>—</span>',
+   `<button onclick="runJob(decodeURIComponent('${
+      encodeURIComponent(j.id)}'))">run</button>`])).join('');
   const snaps=(await api('/api2/json/d2d/snapshots')).data;
   $('snaps').innerHTML='<tr><th>snapshot</th><th></th></tr>'+
-   snaps.slice(-15).reverse().map(s=>row([s.snapshot,
-   `<button onclick="mountSnap('${s.snapshot}')">mount</button>`]))
+   snaps.slice(-15).reverse().map(s=>row([esc(s.snapshot),
+   `<button onclick="mountSnap(decodeURIComponent('${
+      encodeURIComponent(s.snapshot)}'))">mount</button>`]))
    .join('');
   const tasks=(await api('/api2/json/d2d/tasks')).data;
   $('tasks').innerHTML='<tr><th>task</th><th>kind</th><th>status</th></tr>'+
-   tasks.slice(0,12).map(t=>row([t.upid.slice(0,34)+'…',t.kind,
-   `<span class="${cls(t.status)}">${t.status}</span>`])).join('');
+   tasks.slice(0,12).map(t=>row([esc(t.upid.slice(0,34))+'…',esc(t.kind),
+   `<span class="${cls(t.status)}">${esc(t.status)}</span>`])).join('');
   const tg=(await api('/api2/json/d2d/target')).data;
   $('targets').innerHTML='<tr><th>name</th><th>host</th><th>state</th></tr>'+
-   tg.map(t=>row([t.name,t.hostname,t.connected?
+   tg.map(t=>row([esc(t.name),esc(t.hostname),t.connected?
    '<span class=ok>connected</span>':'<span class=err>offline</span>']))
    .join('');
   const ms=(await api('/api2/json/d2d/mount')).data;
   $('mounts').innerHTML='<tr><th>id</th><th>snapshot</th><th></th></tr>'+
-   ms.map(m=>row([m.mount_id,m.snapshot,
-   `<button onclick="unmount('${m.mount_id}')">unmount</button>`]))
+   ms.map(m=>row([esc(m.mount_id),esc(m.snapshot),
+   `<button onclick="unmount(decodeURIComponent('${
+      encodeURIComponent(m.mount_id)}'))">unmount</button>`]))
    .join('');
   const rs=(await api('/api2/json/d2d/restores')).data;
   $('restores').innerHTML='<tr><th>id</th><th>snapshot</th>'+
-   '<th>status</th></tr>'+rs.slice(0,10).map(r=>row([r.id,r.snapshot,
-   `<span class="${cls(r.status)}">${r.status??'queued'}</span>`]))
+   '<th>status</th></tr>'+rs.slice(0,10).map(r=>row([esc(r.id),
+   esc(r.snapshot),
+   `<span class="${cls(r.status)}">${esc(r.status??'queued')}</span>`]))
    .join('');
  }catch(e){console.error(e)}
 }
-async function runJob(id){await api(`/api2/json/d2d/backup/${id}/run`,
+async function runJob(id){await api(
+ `/api2/json/d2d/backup/${encodeURIComponent(id)}/run`,
  {method:'POST'});setTimeout(load,500)}
 async function mountSnap(s){await api('/api2/json/d2d/mount',{method:'POST',
  body:JSON.stringify({snapshot:s})});setTimeout(load,500)}
-async function unmount(id){await api(`/api2/json/d2d/mount/${id}`,
+async function unmount(id){await api(
+ `/api2/json/d2d/mount/${encodeURIComponent(id)}`,
  {method:'DELETE'});setTimeout(load,500)}
 load();setInterval(load,5000);
 </script></body></html>

@@ -307,6 +307,8 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
         # from it stays reachable through parse_snapshot_ref
         try:
             validate.snapshot_component(name)
+            if b.get("hostname"):
+                validate.hostname(b["hostname"])
         except ValueError as e:
             return web.json_response({"error": str(e)}, status=400)
         server.db.upsert_target(name, b.get("kind", "agent"),
@@ -655,13 +657,24 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
         """Self-install script (the agent-binary-download analog —
         reference serves agent binaries/MSI from the server)."""
         host = request.headers.get("Host", "SERVER")
+        # Embed the server CA so the artifact download runs over *verified*
+        # TLS pinned to this deployment's CA (no -k: an install-time MITM
+        # could otherwise substitute a malicious agent before the Ed25519
+        # update verification ever gets a chance to run).
+        with open(server.certs.ca_cert_path) as f:
+            ca_pem = f.read()
+        if not ca_pem.endswith("\n"):     # keep the heredoc terminator on
+            ca_pem += "\n"                # its own line for any ca.pem
         script = f"""#!/bin/sh
 # pbs-plus-tpu agent installer (server: {host})
 set -e
 BASE="${{PBS_PLUS_URL:-https://{host}}}"
 DEST="${{PBS_PLUS_DEST:-/opt/pbs-plus-tpu}}"
 mkdir -p "$DEST"
-curl -fsSk "$BASE/plus/agent/pyz" -o "$DEST/pbs-plus-tpu-agent.pyz"
+CA="$DEST/server-ca.pem"
+cat > "$CA" <<'PBS_PLUS_CA_EOF'
+{ca_pem}PBS_PLUS_CA_EOF
+curl -fsS --cacert "$CA" "$BASE/plus/agent/pyz" -o "$DEST/pbs-plus-tpu-agent.pyz"
 chmod +x "$DEST/pbs-plus-tpu-agent.pyz"
 echo "installed $DEST/pbs-plus-tpu-agent.pyz"
 echo "run: python3 $DEST/pbs-plus-tpu-agent.pyz agent \\\\"

@@ -333,3 +333,42 @@ def test_mux_write_unblocks_on_peer_rst():
         srv.close()
         await srv.wait_closed()
     run_async(main())
+
+
+def test_mux_peer_rst_after_local_close_retires_stream():
+    """A stream the local side has already closed must leave the
+    connection table when the peer RSTs it (advisor r2 flagged _on_rst;
+    retirement on RST is owned by _dispatch's unconditional pop — this
+    regression test pins the behavior regardless of owner)."""
+    from pbs_plus_tpu.arpc.mux import MuxConnection
+
+    async def main():
+        accepted = asyncio.Queue()
+
+        async def on_conn(reader, writer):
+            conn = MuxConnection(reader, writer, is_client=False,
+                                 keepalive_s=0)
+            conn.start()
+            await accepted.put(conn)
+
+        srv = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        r, w = await asyncio.open_connection("127.0.0.1", port)
+        client = MuxConnection(r, w, is_client=True, keepalive_s=0)
+        client.start()
+        server_conn = await accepted.get()
+
+        st = await client.open_stream()
+        await st.write(b"hi")
+        await st.close()                  # local FIN; peer has not FIN'd
+        peer_st = await server_conn.accept_stream()
+        await peer_st.reset()             # peer answers with RST, not FIN
+        await asyncio.sleep(0.2)
+        assert st.sid not in client._streams, \
+            "peer-RST after local close must retire the stream table entry"
+
+        await client.close()
+        await server_conn.close()
+        srv.close()
+        await srv.wait_closed()
+    run_async(main())

@@ -306,6 +306,43 @@ def test_backup_fails_cleanly_when_agent_offline(env, tmp_path):
     asyncio.run(main())
 
 
+def test_misconfigured_pbs_job_does_not_starve_tick(env, tmp_path):
+    """A job pointing at store='pbs' with no pbs_url must record a job
+    error — not raise out of the scheduler tick and skip every due job
+    sorted after it (advisor r2)."""
+    async def main():
+        import datetime as dt
+        server, agent, agent_task = await env()
+        src = tmp_path / "src-starve"
+        src.mkdir()
+        (src / "f.txt").write_text("data")
+        # insertion order == tick order: the broken job comes first
+        server.db.upsert_backup_job(database.BackupJobRow(
+            id="badpbs", target="agent-e2e", source_path=str(src),
+            schedule="hourly", store="pbs"))
+        server.db.upsert_backup_job(database.BackupJobRow(
+            id="okjob", target="agent-e2e", source_path=str(src),
+            schedule="hourly"))
+        now = dt.datetime.now().replace(minute=0, second=5, microsecond=0) \
+            + dt.timedelta(hours=1)
+        await server.scheduler.tick(now)
+        # broken job: recorded as an error, with a task log to point at
+        row = server.db.get_backup_job("badpbs")
+        assert row.last_status == database.STATUS_ERROR
+        assert "pbs" in (row.last_error or "")
+        tasks = server.db.list_tasks(job_id="badpbs")
+        assert tasks and tasks[0]["status"] == database.STATUS_ERROR
+        # the job after it in the list still fired this same tick
+        assert server.jobs.is_active("backup:okjob")
+        await server.jobs.wait("backup:okjob", timeout=60)
+        assert server.db.get_backup_job("okjob").last_status \
+            == database.STATUS_SUCCESS
+        await agent.stop()
+        agent_task.cancel()
+        await server.stop()
+    asyncio.run(main())
+
+
 def test_scheduler_triggers_due_job(env, tmp_path):
     async def main():
         import datetime as dt

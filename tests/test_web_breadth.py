@@ -45,6 +45,15 @@ def test_breadth_routes(tmp_path):
             assert all(t["name"] != "t-del"
                        for t in (await r.json())["data"])
 
+            # hostname is rendered into the operator dashboard — a value
+            # that fails RFC-1123 validation (e.g. an XSS payload) must be
+            # rejected at mint time (advisor r2: stored XSS via hostname)
+            r = await http.post(f"{base}/api2/json/d2d/target", headers=hdr,
+                                json={"name": "t-xss", "kind": "agent",
+                                      "hostname":
+                                      "<img src=x onerror=alert(1)>"})
+            assert r.status == 400
+
             # token list (metadata only) + revoke
             r = await http.get(f"{base}/api2/json/d2d/token", headers=hdr)
             toks = (await r.json())["data"]
@@ -96,7 +105,12 @@ def test_breadth_routes(tmp_path):
 
             # agent install script + pyz download
             r = await http.get(f"{base}/plus/agent/install.sh", headers=hdr)
-            assert "pbs-plus-tpu agent installer" in await r.text()
+            script = await r.text()
+            assert "pbs-plus-tpu agent installer" in script
+            # install must pin the deployment CA, never disable TLS
+            # verification (advisor r2: -k allowed install-time MITM)
+            assert "--cacert" in script and "BEGIN CERTIFICATE" in script
+            assert "-fsSk" not in script and " -k " not in script
             r = await http.get(f"{base}/plus/agent/pyz", headers=hdr)
             body = await r.read()
             assert body[:2] in (b"#!", b"PK")     # shebang'd zipapp
@@ -105,6 +119,8 @@ def test_breadth_routes(tmp_path):
             r = await http.get(f"{base}/plus/ui", headers=hdr)
             html = await r.text()
             assert "PBS Plus" in html and "/api2/json/d2d/backup" in html
+            # dashboard escapes API-derived cells before innerHTML
+            assert "function esc(" in html and "esc(t.hostname)" in html
         await runner.cleanup()
         await server.stop()
     asyncio.run(main())
