@@ -26,14 +26,14 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..chunker.spec import WINDOW, ChunkerParams, select_cuts
 from ..ops.cuckoo import CuckooIndex
-from ..ops.rolling_hash import candidate_mask, device_tables
-from ..ops.sha256 import sha256_stream_chunks
+from ..ops.rolling_hash import batched_candidate_hits, device_tables
+from ..ops.sha256 import sha256_streams_chunks
+
+_PIPE_MASK_ROWS = 32          # candidate-batch row cap per dispatch
 
 
 @dataclass(frozen=True)
@@ -82,47 +82,57 @@ class DedupPipeline:
             n_buckets=self.config.index_buckets)
         self._tables = device_tables(self.params)
         self.stats = {"bytes_in": 0, "chunks": 0, "new_chunks": 0,
-                      "device_steps": 0}
+                      "device_steps": 0, "batched_rows": 0, "max_batch": 0}
 
     # (streaming consumers use TpuChunker below — the drop-in chunker
     # backend; this class is the batched whole-stream pipeline)
     def process_streams(self, streams: dict[str, bytes | np.ndarray],
                         ) -> dict[str, StreamResult]:
         """Chunk + fingerprint + probe complete streams (each stream fully
-        in memory; segmented on device internally)."""
+        in memory).  The batch axis is cross-stream INSIDE each device
+        dispatch: segments from different streams stack into one
+        ``[B, S]`` candidate kernel (histories are raw stream bytes, so
+        every segment of every stream is independent), and every stream's
+        chunks share one bucketed SHA dispatch set."""
         names = sorted(streams)
         arrs = {n: (np.frombuffer(streams[n], dtype=np.uint8)
                     if not isinstance(streams[n], np.ndarray) else streams[n])
                 for n in names}
         out: dict[str, StreamResult] = {}
-        # 1) candidates per stream (segmented, halo-carried)
+        # 1) candidate masks: all segments of all streams, grouped by
+        # padded size, stacked [B, S_pad] per dispatch
         seg = self.config.segment_bytes
-        all_cuts: dict[str, list[int]] = {}
+        tasks_by_pad: dict[int, list[tuple[str, int, int]]] = {}
         for n in names:
             a = arrs[n]
-            ends_parts = []
-            for off in range(0, len(a), seg):
-                part = a[off:off + seg]
-                hist = np.zeros((1, WINDOW - 1), dtype=np.uint8)
-                if off:
-                    hist[0] = a[off - (WINDOW - 1):off]
-                S = len(part)
-                S_pad = max(1 << 14, 1 << int(S - 1).bit_length())
-                buf = np.zeros((1, S_pad), dtype=np.uint8)
-                buf[0, :S] = part
-                m = candidate_mask(jnp.asarray(buf), self._tables,
-                                   self.params.mask, self.params.magic,
-                                   history=jnp.asarray(hist))
-                self.stats["device_steps"] += 1
-                hits = np.nonzero(np.asarray(m)[0, :S])[0]
-                valid = hits + off >= WINDOW - 1
-                ends_parts.append(hits[valid] + 1 + off)
-            ends = np.concatenate(ends_parts) if ends_parts else np.empty(0, np.int64)
-            all_cuts[n] = select_cuts(ends, len(a), self.params)
             self.stats["bytes_in"] += len(a)
-        # 2) hash all chunks (bucketed across all streams for batch density)
+            for off in range(0, len(a), seg):
+                S = min(seg, len(a) - off)
+                S_pad = max(1 << 14, 1 << int(S - 1).bit_length())
+                tasks_by_pad.setdefault(S_pad, []).append((n, off, S))
+        ends_parts: dict[str, list[np.ndarray]] = {n: [] for n in names}
+        for S_pad, tasks in sorted(tasks_by_pad.items()):
+            for lo in range(0, len(tasks), _PIPE_MASK_ROWS):
+                batch = tasks[lo:lo + _PIPE_MASK_ROWS]
+                hits_rows = batched_candidate_hits(
+                    [arrs[n][off:off + S] for n, off, S in batch],
+                    [arrs[n][off - (WINDOW - 1):off] if off else None
+                     for n, off, S in batch],
+                    self._tables, self.params)
+                self.stats["device_steps"] += 1
+                self.stats["batched_rows"] += len(batch)
+                self.stats["max_batch"] = max(self.stats["max_batch"],
+                                              len(batch))
+                for (n, off, S), hits in zip(batch, hits_rows):
+                    valid = hits + off >= WINDOW - 1
+                    ends_parts[n].append(hits[valid] + 1 + off)
+        all_cuts: dict[str, list[int]] = {}
+        for n in names:
+            ends = np.sort(np.concatenate(ends_parts[n])) \
+                if ends_parts[n] else np.empty(0, np.int64)
+            all_cuts[n] = select_cuts(ends, len(arrs[n]), self.params)
+        # 2) hash all chunks — ONE cross-stream bucketed dispatch set
         bounds_by_stream: dict[str, list[tuple[int, int]]] = {}
-        digests_by_stream: dict[str, list[bytes]] = {}
         for n in names:
             s = 0
             bounds = []
@@ -130,19 +140,22 @@ class DedupPipeline:
                 bounds.append((s, e))
                 s = e
             bounds_by_stream[n] = bounds
-            digests_by_stream[n] = sha256_stream_chunks(arrs[n], bounds)
-        # 3) probe + insert
+        digest_lists = sha256_streams_chunks(
+            [arrs[n] for n in names], [bounds_by_stream[n] for n in names])
+        digests_by_stream = dict(zip(names, digest_lists))
+        # 3) probe (one cross-stream device probe) + ordered host insert
+        all_digs = [d for n in names for d in digests_by_stream[n]]
+        maybe_all = self.index.probe_confirmed(all_digs) if all_digs else []
+        maybe_iter = iter(maybe_all)
+        batch_seen: set[bytes] = set()
         for n in names:
             res = StreamResult()
-            digs = digests_by_stream[n]
-            if digs:
-                maybe = self.index.probe_confirmed(digs)
-            else:
-                maybe = []
-            for (s, e), d, present in zip(bounds_by_stream[n], digs, maybe):
+            for (s, e), d in zip(bounds_by_stream[n], digests_by_stream[n]):
+                present = next(maybe_iter) or d in batch_seen
                 is_new = not present
                 if is_new:
                     self.index.insert(d)
+                    batch_seen.add(d)
                 res.chunks.append(ChunkRecord(s, e - s, d, is_new))
                 self.stats["chunks"] += 1
                 self.stats["new_chunks"] += int(is_new)
@@ -155,7 +168,9 @@ class TpuChunker:
     offsets, computed by the device kernel.  Drop-in for CpuChunker in
     transfer writers (``chunker="tpu"`` — the one-line config change from
     BASELINE.json).  Buffers segment bytes host-side; candidate evaluation
-    is device-batched per feed."""
+    goes through the process-wide DeviceFeeder, which coalesces concurrent
+    streams' feeds into ``[B, S]`` batched dispatches (the production
+    batch axis — models/feeder.py)."""
 
     # device-dispatch counter across all instances: integration tests
     # assert the TPU path actually ran when chunker="tpu" is configured
@@ -163,7 +178,6 @@ class TpuChunker:
 
     def __init__(self, params: ChunkerParams):
         self.params = params
-        self._tables = device_tables(params)
         self._tail = np.zeros(WINDOW - 1, dtype=np.uint8)
         self._seen = 0
         self._chunk_start = 0
@@ -172,15 +186,9 @@ class TpuChunker:
         self._finalized = False
 
     def _candidates(self, data: np.ndarray) -> np.ndarray:
+        from .feeder import get_feeder
         TpuChunker.device_dispatches += 1
-        S = len(data)
-        S_pad = max(1 << 14, 1 << int(S - 1).bit_length()) if S else 1 << 14
-        buf = np.zeros((1, S_pad), dtype=np.uint8)
-        buf[0, :S] = data
-        hist = self._tail[None]
-        m = candidate_mask(jnp.asarray(buf), self._tables, self.params.mask,
-                           self.params.magic, history=jnp.asarray(hist))
-        hits = np.nonzero(np.asarray(m)[0, :S])[0]
+        hits = get_feeder().candidate_hits(data, self._tail, self.params)
         valid = hits + self._seen >= WINDOW - 1
         return hits[valid] + 1 + self._seen
 

@@ -1,0 +1,240 @@
+"""DeviceFeeder — the cross-stream batch aggregator for device dispatches.
+
+The missing half of the batch-axis thesis (BASELINE config #3): N
+concurrent backup jobs each drive their own writer thread, and every
+writer owns a streaming ``TpuChunker``.  Without aggregation each feed
+dispatches its own ``[1, S]`` candidate kernel and its own SHA batch, so
+the device never sees the agent fan-in.  The reference multiplexes N
+agents into one server process (internal/server/jobs/manager.go:168-179,
+internal/conf/buffer.go:33-38); here that multiplexing is carried one
+level further — onto the device batch axis.
+
+Mechanics (single dispatch thread, adaptive batching via backpressure):
+
+    writer threads ──submit──▶ pending queues ──▶ [feeder thread]
+      candidate req (buf, history, params)          groups by params,
+      sha req (chunk list)                          pads to [B, S_pad],
+                                                    ONE device dispatch,
+      ◀──────── per-request futures ◀────────────── splits results
+
+While the feeder thread is busy dispatching batch *k*, new requests
+accumulate and form batch *k+1* — batching emerges from device latency
+itself (no mandatory linger).  A small optional linger widens batches
+when the queue is empty at wake time.
+
+Bit-parity: rows in a batched ``[B, S_pad]`` dispatch are computed
+independently by the kernel (per-row history, per-row mask slice), so
+results are bit-identical to the ``[1, S]`` dispatches they replace —
+pinned by tests/test_fanin.py (digest parity with the CPU backend) and
+tests/test_feeder.py (direct batched-vs-solo equality).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..chunker.spec import ChunkerParams
+
+# combined SHA dispatch cap: bounds the one-dispatch device buffer when
+# many writers flush 64 MiB hash batches at once
+_SHA_BATCH_BYTES_CAP = 256 << 20
+# candidate batch row cap per dispatch (jit cache: B padded to pow2)
+_MASK_BATCH_ROWS_CAP = 64
+
+
+@dataclass
+class _MaskReq:
+    buf: np.ndarray                 # uint8[S], S > 0
+    history: np.ndarray             # uint8[WINDOW-1]
+    key: tuple                      # (seed, mask, magic) — batch group key
+    params: ChunkerParams
+    done: threading.Event = field(default_factory=threading.Event)
+    hits: Optional[np.ndarray] = None    # relative candidate end indices
+    exc: Optional[BaseException] = None
+
+
+@dataclass
+class _ShaReq:
+    chunks: list                    # list[bytes]
+    nbytes: int
+    done: threading.Event = field(default_factory=threading.Event)
+    digests: Optional[list] = None
+    exc: Optional[BaseException] = None
+
+
+class DeviceFeeder:
+    """Process-wide aggregator: many streams' device work → few batched
+    dispatches.  All jax calls happen on the one feeder thread."""
+
+    def __init__(self, *, linger_s: float | None = None):
+        if linger_s is None:
+            linger_s = float(os.environ.get("PBS_PLUS_FEEDER_LINGER_S",
+                                            "0.002"))
+        self.linger_s = linger_s
+        self._cv = threading.Condition()
+        self._mask_q: list[_MaskReq] = []
+        self._sha_q: list[_ShaReq] = []
+        self._thread: Optional[threading.Thread] = None
+        self._tables_cache: dict[tuple, object] = {}   # params key → device tables
+        self.stats = {"mask_dispatches": 0, "mask_rows": 0,
+                      "max_mask_batch": 0, "sha_dispatches": 0,
+                      "sha_streams": 0, "max_sha_streams": 0}
+
+    # -- public API (writer threads) --------------------------------------
+    def candidate_hits(self, buf: np.ndarray, history: np.ndarray,
+                       params: ChunkerParams) -> np.ndarray:
+        """Relative candidate end indices (0-based positions where the
+        rolling hash matched) within ``buf``.  Blocks the calling writer
+        thread until the batched dispatch lands."""
+        req = _MaskReq(buf=buf, history=history,
+                       key=(params.seed, params.mask, params.magic),
+                       params=params)
+        self._submit(self._mask_q, req)
+        req.done.wait()
+        if req.exc is not None:
+            raise req.exc
+        return req.hits
+
+    def sha256_batch(self, chunks: list) -> list:
+        """Digest a list of chunk buffers; coalesced with other streams'
+        pending batches into one bucketed device dispatch."""
+        if not chunks:
+            return []
+        req = _ShaReq(chunks=chunks, nbytes=sum(len(c) for c in chunks))
+        self._submit(self._sha_q, req)
+        req.done.wait()
+        if req.exc is not None:
+            raise req.exc
+        return req.digests
+
+    # -- internals ---------------------------------------------------------
+    def _submit(self, q: list, req) -> None:
+        with self._cv:
+            q.append(req)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="device-feeder", daemon=True)
+                self._thread.start()
+            self._cv.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._mask_q and not self._sha_q:
+                    self._cv.wait()
+                # adaptive widening: if only one request is pending, give
+                # concurrent writers a linger window to join the batch
+                if (self.linger_s > 0
+                        and len(self._mask_q) + len(self._sha_q) == 1):
+                    self._cv.wait(self.linger_s)
+                # drain IN PLACE — the queue list objects are permanent.
+                # (_submit callers capture the list reference outside the
+                # lock at argument-evaluation time; rebinding here would
+                # orphan a concurrent append into the taken list)
+                mask_reqs = self._mask_q[:]
+                self._mask_q.clear()
+                sha_reqs = self._take_sha_locked()
+            if mask_reqs:
+                self._dispatch_masks(mask_reqs)
+            if sha_reqs:
+                self._dispatch_sha(sha_reqs)
+
+    def _take_sha_locked(self) -> list[_ShaReq]:
+        out, total = [], 0
+        while self._sha_q and (not out
+                               or total + self._sha_q[0].nbytes
+                               <= _SHA_BATCH_BYTES_CAP):
+            r = self._sha_q.pop(0)
+            out.append(r)
+            total += r.nbytes
+        return out
+
+    def _tables(self, key: tuple, params: ChunkerParams):
+        t = self._tables_cache.get(key)
+        if t is None:
+            from ..ops.rolling_hash import device_tables
+            t = self._tables_cache[key] = device_tables(params)
+        return t
+
+    def _dispatch_masks(self, reqs: list[_MaskReq]) -> None:
+        # group by chunker params (mask/magic/seed differ per job config)
+        groups: dict[tuple, list[_MaskReq]] = {}
+        for r in reqs:
+            groups.setdefault(r.key, []).append(r)
+        for key, group in groups.items():
+            for i in range(0, len(group), _MASK_BATCH_ROWS_CAP):
+                self._dispatch_mask_group(key, group[i:i + _MASK_BATCH_ROWS_CAP])
+
+    def _dispatch_mask_group(self, key: tuple, group: list[_MaskReq]) -> None:
+        from ..ops.rolling_hash import batched_candidate_hits
+        params = group[0].params
+        tables = self._tables(key, params)
+        try:
+            hits = batched_candidate_hits([r.buf for r in group],
+                                          [r.history for r in group],
+                                          tables, params)
+            self.stats["mask_dispatches"] += 1
+            self.stats["mask_rows"] += len(group)
+            self.stats["max_mask_batch"] = max(self.stats["max_mask_batch"],
+                                               len(group))
+            for r, h in zip(group, hits):
+                r.hits = h
+                r.done.set()
+        except BaseException:
+            # failure isolation: retry each stream's request alone so a
+            # poisoned input (or a batch-sized OOM) fails only its owner,
+            # never the unrelated jobs co-batched with it
+            for r in group:
+                try:
+                    r.hits = batched_candidate_hits(
+                        [r.buf], [r.history], tables, params)[0]
+                    self.stats["mask_dispatches"] += 1
+                    self.stats["mask_rows"] += 1
+                except BaseException as e:
+                    r.exc = e
+                r.done.set()
+
+    def _dispatch_sha(self, reqs: list[_ShaReq]) -> None:
+        from ..ops.sha256 import sha256_chunks
+        try:
+            all_chunks: list = []
+            for r in reqs:
+                all_chunks.extend(r.chunks)
+            digests = sha256_chunks(all_chunks)
+            self.stats["sha_dispatches"] += 1
+            self.stats["sha_streams"] += len(reqs)
+            self.stats["max_sha_streams"] = max(self.stats["max_sha_streams"],
+                                                len(reqs))
+            off = 0
+            for r in reqs:
+                r.digests = digests[off:off + len(r.chunks)]
+                off += len(r.chunks)
+                r.done.set()
+        except BaseException:
+            # same isolation contract as the mask path
+            for r in reqs:
+                try:
+                    r.digests = sha256_chunks(r.chunks)
+                    self.stats["sha_dispatches"] += 1
+                    self.stats["sha_streams"] += 1
+                except BaseException as e:
+                    r.exc = e
+                r.done.set()
+
+
+_feeder: Optional[DeviceFeeder] = None
+_feeder_lock = threading.Lock()
+
+
+def get_feeder() -> DeviceFeeder:
+    global _feeder
+    if _feeder is None:
+        with _feeder_lock:
+            if _feeder is None:
+                _feeder = DeviceFeeder()
+    return _feeder

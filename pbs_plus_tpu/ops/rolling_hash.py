@@ -105,6 +105,34 @@ def candidate_mask(data: jax.Array, tables: jax.Array, mask: int,
                                jnp.uint32(magic), history)
 
 
+def batched_candidate_hits(bufs: list, hists: list, tables: jax.Array,
+                           params: ChunkerParams) -> list[np.ndarray]:
+    """THE pack/dispatch/unpack step for cross-stream candidate batching:
+    stack variable-length segments (with optional per-row 63-byte history)
+    into one pow2-padded ``[B_pad, S_pad]`` candidate_mask dispatch and
+    return each row's raw hit indices (0-based positions, unfiltered —
+    callers apply their own window-validity/offset arithmetic).
+
+    Shared by the production DeviceFeeder (models/feeder.py) and the
+    whole-stream DedupPipeline so their padding/history handling cannot
+    diverge (the bit-parity guarantee hangs on this one implementation).
+    """
+    B = len(bufs)
+    S_max = max(len(b) for b in bufs)
+    S_pad = max(1 << 14, 1 << int(S_max - 1).bit_length()) if S_max \
+        else 1 << 14
+    B_pad = 1 << int(B - 1).bit_length() if B > 1 else 1
+    buf = np.zeros((B_pad, S_pad), dtype=np.uint8)
+    hist = np.zeros((B_pad, WINDOW - 1), dtype=np.uint8)
+    for i, (b, h) in enumerate(zip(bufs, hists)):
+        buf[i, :len(b)] = b
+        if h is not None:
+            hist[i] = h
+    m = np.asarray(candidate_mask(jnp.asarray(buf), tables, params.mask,
+                                  params.magic, history=jnp.asarray(hist)))
+    return [np.nonzero(m[i, :len(b)])[0] for i, b in enumerate(bufs)]
+
+
 def candidate_ends_host(data: bytes | np.ndarray, params: ChunkerParams,
                         *, device=None) -> np.ndarray:
     """Convenience: run the device kernel on one stream and return sorted

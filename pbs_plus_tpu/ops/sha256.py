@@ -242,6 +242,42 @@ def sha256_stream_chunks(stream, bounds: list[tuple[int, int]], *,
     return out  # type: ignore[return-value]
 
 
+def sha256_streams_chunks(streams: list, bounds_per_stream: list,
+                          ) -> list[list[bytes]]:
+    """Cross-stream bucketed digesting: concatenate many streams into ONE
+    device buffer so every stream's chunks share the same bucketed
+    dispatches (the batch axis across agent streams — without this, B
+    streams cost B dispatch sets even when their chunks would bucket
+    together).  Returns per-stream digest lists in input order."""
+    arrs = [np.frombuffer(s, dtype=np.uint8)
+            if isinstance(s, (bytes, bytearray, memoryview)) else s
+            for s in streams]
+    total = sum(int(len(a)) for a in arrs)
+    # starts are int32 in the scan kernel: past ~2 GiB combined, fall back
+    # to per-stream dispatch sets rather than overflow
+    if total > (1 << 31) - MAX_CHUNK_BYTES - (1 << 20):
+        return [sha256_stream_chunks(a, b) if b else []
+                for a, b in zip(arrs, bounds_per_stream)]
+    all_bounds: list[tuple[int, int]] = []
+    counts: list[int] = []
+    off = 0
+    for a, bounds in zip(arrs, bounds_per_stream):
+        all_bounds.extend((off + s, off + e) for s, e in bounds)
+        counts.append(len(bounds))
+        off += len(a)
+    if not all_bounds:
+        return [[] for _ in arrs]
+    dstream = jnp.concatenate([jnp.asarray(a) for a in arrs if len(a)]) \
+        if total else jnp.zeros(0, dtype=jnp.uint8)
+    flat = sha256_stream_chunks(dstream, all_bounds)
+    out: list[list[bytes]] = []
+    k = 0
+    for c in counts:
+        out.append(flat[k:k + c])
+        k += c
+    return out
+
+
 def sha256_chunks(chunks: list[bytes]) -> list[bytes]:
     """Digest a list of standalone chunk buffers (concatenates into one
     stream buffer, then bucket-hashes)."""

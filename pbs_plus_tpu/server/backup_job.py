@@ -97,11 +97,12 @@ def make_batch_hasher(kind: str):
         def hasher(chunks):
             # guard runs lazily on the writer thread (first call probes
             # the accelerator tunnel; never on the event loop, never a
-            # hang on a dead tunnel)
+            # hang on a dead tunnel); the feeder coalesces this stream's
+            # batch with other concurrent writers' into one dispatch
             from ..utils.jaxdev import ensure_backend
             ensure_backend()
-            from ..ops.sha256 import sha256_chunks
-            return sha256_chunks(chunks)
+            from ..models.feeder import get_feeder
+            return get_feeder().sha256_batch(chunks)
         return hasher
     return None
 

@@ -254,14 +254,15 @@ class Server:
         CertManager.SignCSR + host cert stored in DB as expected list)."""
         if not self.db.check_token(token_id, token_secret, kind="bootstrap"):
             raise PermissionError("invalid bootstrap token")
-        cert_pem = self.certs.sign_csr(csr_pem)
-        from ..utils.mtls import common_name
         from ..utils import validate
+        from ..utils.mtls import common_name
         # same mint-time gate as the manual target route: the hostname
         # becomes a target name (a datastore path component) and is
         # rendered in the dashboard — a token holder must not be able to
-        # store an arbitrary string here
+        # store an arbitrary string here.  Gate BEFORE sign_csr so the CA
+        # never issues a cert for a rejected name.
         validate.hostname(hostname)
+        cert_pem = self.certs.sign_csr(csr_pem)
         cn = common_name(cert_pem)
         if cn != hostname:
             raise PermissionError(f"CSR CN {cn!r} != hostname {hostname!r}")

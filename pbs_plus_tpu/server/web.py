@@ -185,6 +185,8 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
                     body["token_id"], secret,
                     drives=body.get("drives"))
                 break
+            except ValueError as e:       # invalid hostname → client error
+                return web.json_response({"error": str(e)}, status=400)
             except PermissionError as e:
                 last_err = e
         else:

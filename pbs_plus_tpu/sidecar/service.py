@@ -23,9 +23,9 @@ import numpy as np
 
 from ..chunker.spec import ChunkerParams
 from ..models.dedup import TpuChunker
+from ..models.feeder import get_feeder
 from ..models.similarity import SimilarityModel
 from ..ops.cuckoo import CuckooIndex
-from ..ops.sha256 import sha256_chunks
 from ..utils import codec
 from ..utils.log import L
 
@@ -97,7 +97,9 @@ class DedupService:
                 del st.pending[:n]
                 st.base = c
                 out_cuts.append(c)
-        digests = sha256_chunks(chunks) if chunks else []
+        # feeder-coalesced: concurrent gRPC streams' hash batches land in
+        # one bucketed device dispatch (models/feeder.py)
+        digests = get_feeder().sha256_batch(chunks) if chunks else []
         with self._lock:
             self.stats["bytes"] += len(data)
             self.stats["chunks"] += len(chunks)

@@ -1,0 +1,159 @@
+"""DeviceFeeder unit battery: cross-stream batching with bit-parity,
+result routing, and failure isolation (VERDICT r2 missing #2 — the
+production batch aggregator)."""
+
+import hashlib
+import threading
+
+import numpy as np
+import pytest
+
+import pbs_plus_tpu.models.feeder as feeder_mod
+from pbs_plus_tpu.chunker import ChunkerParams, CpuChunker
+from pbs_plus_tpu.models.dedup import TpuChunker
+from pbs_plus_tpu.models.feeder import DeviceFeeder
+
+P = ChunkerParams(avg_size=4 << 10)
+
+
+@pytest.fixture
+def wide_feeder(monkeypatch):
+    """Fresh feeder with a wide linger so concurrent submitters reliably
+    land in one batch (production default lingers 2 ms)."""
+    f = DeviceFeeder(linger_s=0.05)
+    monkeypatch.setattr(feeder_mod, "_feeder", f)
+    return f
+
+
+def _data(n, seed):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8
+                                                ).tobytes()
+
+
+def test_concurrent_streams_batch_with_bit_parity(wide_feeder):
+    """8 writer threads drive TpuChunkers through the feeder at once:
+    cuts are bit-identical to the CPU chunker AND at least one device
+    dispatch carried B > 1 rows (the batch axis actually ran)."""
+    n_threads = 8
+    datas = [_data(200_000, seed=i) for i in range(n_threads)]
+    cuts_tpu: dict[int, list] = {}
+    errs: list[BaseException] = []
+    barrier = threading.Barrier(n_threads)
+
+    def work(i):
+        try:
+            barrier.wait()
+            ch = TpuChunker(P)
+            cuts = []
+            for off in range(0, len(datas[i]), 1 << 16):
+                cuts += ch.feed(datas[i][off:off + (1 << 16)])
+            cuts += ch.finalize()
+            cuts_tpu[i] = cuts
+        except BaseException as e:   # surface in the main thread
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errs, errs
+    for i in range(n_threads):
+        ch = CpuChunker(P)
+        want = []
+        for off in range(0, len(datas[i]), 1 << 16):
+            want += ch.feed(datas[i][off:off + (1 << 16)])
+        want += ch.finalize()
+        assert cuts_tpu[i] == want, f"stream {i} cut mismatch"
+    assert wide_feeder.stats["max_mask_batch"] > 1, \
+        f"no multi-stream dispatch formed: {wide_feeder.stats}"
+    # batching reduced dispatch count below one-per-request
+    assert wide_feeder.stats["mask_dispatches"] \
+        < wide_feeder.stats["mask_rows"]
+
+
+def test_sha_requests_coalesce_and_route(wide_feeder):
+    """Concurrent hash batches from different streams coalesce into one
+    device dispatch and every caller gets exactly its own digests."""
+    n_threads = 6
+    chunk_lists = [
+        [_data(1000 + 13 * i + j, seed=100 + 10 * i + j) for j in range(5)]
+        for i in range(n_threads)]
+    results: dict[int, list] = {}
+    errs: list[BaseException] = []
+    barrier = threading.Barrier(n_threads)
+
+    def work(i):
+        try:
+            barrier.wait()
+            results[i] = wide_feeder.sha256_batch(chunk_lists[i])
+        except BaseException as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errs, errs
+    for i in range(n_threads):
+        want = [hashlib.sha256(c).digest() for c in chunk_lists[i]]
+        assert results[i] == want, f"stream {i} digest routing broken"
+    assert wide_feeder.stats["max_sha_streams"] > 1, wide_feeder.stats
+    assert wide_feeder.stats["sha_dispatches"] \
+        < wide_feeder.stats["sha_streams"]
+
+
+def test_dispatch_failure_propagates_and_feeder_survives(wide_feeder):
+    """A poisoned request fails its caller without wedging the feeder
+    thread; the next request succeeds."""
+    from pbs_plus_tpu.ops.sha256 import MAX_CHUNK_BYTES
+    with pytest.raises(ValueError):
+        wide_feeder.sha256_batch([b"\0" * (MAX_CHUNK_BYTES + 1)])
+    good = [b"still alive"]
+    assert wide_feeder.sha256_batch(good) \
+        == [hashlib.sha256(good[0]).digest()]
+
+
+def test_poisoned_request_does_not_fail_cobatched_streams(wide_feeder):
+    """Failure isolation: when one stream's bad input poisons the combined
+    dispatch, co-batched innocent streams still get their digests (each
+    request is retried alone; only the offender errors)."""
+    from pbs_plus_tpu.ops.sha256 import MAX_CHUNK_BYTES
+    n_good = 4
+    goods = [[_data(2000 + i, seed=300 + i)] for i in range(n_good)]
+    results: dict[int, object] = {}
+    barrier = threading.Barrier(n_good + 1)
+
+    def good_work(i):
+        barrier.wait()
+        try:
+            results[i] = wide_feeder.sha256_batch(goods[i])
+        except BaseException as e:
+            results[i] = e
+
+    def bad_work():
+        barrier.wait()
+        try:
+            wide_feeder.sha256_batch([b"\0" * (MAX_CHUNK_BYTES + 1)])
+            results["bad"] = None
+        except ValueError as e:
+            results["bad"] = e
+
+    threads = [threading.Thread(target=good_work, args=(i,))
+               for i in range(n_good)] + [threading.Thread(target=bad_work)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert isinstance(results["bad"], ValueError), \
+        "poisoned stream did not get its error"
+    for i in range(n_good):
+        assert results[i] == [hashlib.sha256(goods[i][0]).digest()], \
+            f"innocent co-batched stream {i} was failed: {results[i]!r}"
+
+
+def test_empty_sha_batch_is_noop(wide_feeder):
+    assert wide_feeder.sha256_batch([]) == []

@@ -54,6 +54,18 @@ def test_breadth_routes(tmp_path):
                                       "<img src=x onerror=alert(1)>"})
             assert r.status == 400
 
+            # same gate on the OTHER writer of the targets table: agent
+            # bootstrap rejects an invalid hostname with a 4xx (and the
+            # CA never signs for it) instead of a 500
+            from pbs_plus_tpu.utils import mtls as m
+            tok, secv = server.issue_bootstrap_token()
+            key = m.generate_private_key()
+            r = await http.post(f"{base}/plus/agent/bootstrap", json={
+                "hostname": "<img src=x>", "token_id": tok,
+                "token_secret": secv.hex(),
+                "csr": m.make_csr(key, "<img src=x>").decode()})
+            assert r.status == 400, await r.text()
+
             # token list (metadata only) + revoke
             r = await http.get(f"{base}/api2/json/d2d/token", headers=hdr)
             toks = (await r.json())["data"]
