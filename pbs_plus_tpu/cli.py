@@ -31,6 +31,7 @@ def _cmd_server(args: argparse.Namespace) -> int:
             datastore_dir=args.datastore, arpc_host=args.host,
             arpc_port=args.arpc_port, chunker=args.chunker,
             chunk_avg=args.chunk_avg,
+            datastore_format=args.datastore_format,
             pbs_url=args.pbs_url, pbs_datastore=args.pbs_datastore,
             pbs_token=args.pbs_token, pbs_namespace=args.pbs_namespace,
             pbs_fingerprint=args.pbs_fingerprint,
@@ -230,7 +231,8 @@ def _cmd_mount(args: argparse.Namespace) -> int:
     from .pxar.datastore import SnapshotRef
 
     async def main():
-        store = LocalStore(args.store, ChunkerParams(avg_size=args.chunk_avg))
+        store = LocalStore(args.store, ChunkerParams(avg_size=args.chunk_avg),
+                           pbs_format=args.datastore_format == "pbs")
         previous = None
         if args.snapshot:
             previous = SnapshotRef(*args.snapshot.strip("/").split("/"))
@@ -334,6 +336,10 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--web-port", type=int, default=8017)
     s.add_argument("--chunker", default="cpu")
     s.add_argument("--chunk-avg", type=int, default=4 << 20)
+    s.add_argument("--datastore-format", default="tpxd",
+                   choices=("tpxd", "pbs"),
+                   help="on-disk snapshot layout: native tpxd, or pbs "
+                        "(stock-PBS DataBlob chunks + .didx indexes)")
     s.add_argument("--no-auth", action="store_true")
     s.add_argument("--print-token", action="store_true",
                    help="mint + print a bootstrap token at startup")
@@ -380,6 +386,8 @@ def main(argv: list[str] | None = None) -> int:
     m.add_argument("--socket", required=True)
     m.add_argument("--backup-id", default="")
     m.add_argument("--chunk-avg", type=int, default=4 << 20)
+    m.add_argument("--datastore-format", default="tpxd",
+                   choices=("tpxd", "pbs"))
     m.add_argument("--mountpoint", default="",
                    help="also expose the mount via kernel FUSE here")
     m.set_defaults(fn=_cmd_mount)

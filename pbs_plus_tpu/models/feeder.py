@@ -139,10 +139,19 @@ class DeviceFeeder:
                 mask_reqs = self._mask_q[:]
                 self._mask_q.clear()
                 sha_reqs = self._take_sha_locked()
-            if mask_reqs:
-                self._dispatch_masks(mask_reqs)
-            if sha_reqs:
-                self._dispatch_sha(sha_reqs)
+            # belt over the per-dispatch isolation: NOTHING may kill this
+            # thread while drained requests are unserved — waiters block
+            # with no timeout, so a lost request is a permanent deadlock
+            try:
+                if mask_reqs:
+                    self._dispatch_masks(mask_reqs)
+                if sha_reqs:
+                    self._dispatch_sha(sha_reqs)
+            except BaseException as e:
+                for r in mask_reqs + sha_reqs:
+                    if not r.done.is_set():
+                        r.exc = e
+                        r.done.set()
 
     def _take_sha_locked(self) -> list[_ShaReq]:
         out, total = [], 0
@@ -171,10 +180,12 @@ class DeviceFeeder:
                 self._dispatch_mask_group(key, group[i:i + _MASK_BATCH_ROWS_CAP])
 
     def _dispatch_mask_group(self, key: tuple, group: list[_MaskReq]) -> None:
-        from ..ops.rolling_hash import batched_candidate_hits
         params = group[0].params
-        tables = self._tables(key, params)
         try:
+            # import + table build inside the guard: a backend-init or
+            # device failure here must fail THESE waiters, not the thread
+            from ..ops.rolling_hash import batched_candidate_hits
+            tables = self._tables(key, params)
             hits = batched_candidate_hits([r.buf for r in group],
                                           [r.history for r in group],
                                           tables, params)
@@ -188,11 +199,14 @@ class DeviceFeeder:
         except BaseException:
             # failure isolation: retry each stream's request alone so a
             # poisoned input (or a batch-sized OOM) fails only its owner,
-            # never the unrelated jobs co-batched with it
+            # never the unrelated jobs co-batched with it.  Re-resolve the
+            # import/tables per retry — the batch may have failed there.
             for r in group:
                 try:
+                    from ..ops.rolling_hash import batched_candidate_hits
                     r.hits = batched_candidate_hits(
-                        [r.buf], [r.history], tables, params)[0]
+                        [r.buf], [r.history], self._tables(key, params),
+                        params)[0]
                     self.stats["mask_dispatches"] += 1
                     self.stats["mask_rows"] += 1
                 except BaseException as e:
@@ -200,8 +214,8 @@ class DeviceFeeder:
                 r.done.set()
 
     def _dispatch_sha(self, reqs: list[_ShaReq]) -> None:
-        from ..ops.sha256 import sha256_chunks
         try:
+            from ..ops.sha256 import sha256_chunks
             all_chunks: list = []
             for r in reqs:
                 all_chunks.extend(r.chunks)
@@ -219,6 +233,7 @@ class DeviceFeeder:
             # same isolation contract as the mask path
             for r in reqs:
                 try:
+                    from ..ops.sha256 import sha256_chunks
                     r.digests = sha256_chunks(r.chunks)
                     self.stats["sha_dispatches"] += 1
                     self.stats["sha_streams"] += 1

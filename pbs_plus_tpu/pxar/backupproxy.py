@@ -80,8 +80,11 @@ class BackupSession:
         try:
             midx, pidx, stats = self.writer.finish()
             ds = self.store.datastore
-            midx.write(os.path.join(self._tmp_dir, ds.META_IDX))
-            pidx.write(os.path.join(self._tmp_dir, ds.PAYLOAD_IDX))
+            fmt = "pbs" if ds.pbs_format else "tpxd"
+            midx.write(os.path.join(self._tmp_dir, ds.meta_idx_name),
+                       fmt=fmt)
+            pidx.write(os.path.join(self._tmp_dir, ds.payload_idx_name),
+                       fmt=fmt)
             if verify_hook is not None:
                 verify_hook(SplitReader(midx, pidx, ds.chunks))
             # same-second concurrent sessions: re-check the final dir at
@@ -102,6 +105,8 @@ class BackupSession:
                 previous=str(self.previous_ref) if self.previous_ref else None,
                 extra=extra_manifest,
             )
+            if ds.pbs_format:
+                self._write_pbs_manifest(ds, midx, pidx)
             os.makedirs(os.path.dirname(self._final_dir), exist_ok=True)
             os.replace(self._tmp_dir, self._final_dir)
         except BaseException:
@@ -110,6 +115,26 @@ class BackupSession:
             raise
         self._done = True
         return manifest
+
+    def _write_pbs_manifest(self, ds, midx, pidx) -> None:
+        """index.json.blob in the PBS manifest schema, alongside the
+        internal manifest (a stock PBS lists snapshots off this file)."""
+        from .pbsformat import blob_encode, index_file_csum, manifest_json
+        files = []
+        for name, idx in ((ds.meta_idx_name, midx),
+                          (ds.payload_idx_name, pidx)):
+            with open(os.path.join(self._tmp_dir, name), "rb") as f:
+                data = f.read()
+            files.append({"filename": name, "size": idx.total_size,
+                          "csum": index_file_csum(data).hex(),
+                          "crypt-mode": "none"})
+        t = _dt.datetime.strptime(
+            self.ref.backup_time, "%Y-%m-%dT%H:%M:%SZ"
+        ).replace(tzinfo=_dt.timezone.utc).timestamp()
+        doc = manifest_json(self.ref.backup_type, self.ref.backup_id,
+                            int(t), files)
+        with open(os.path.join(self._tmp_dir, ds.MANIFEST_PBS), "wb") as f:
+            f.write(blob_encode(doc))
 
     def abort(self) -> None:
         if not self._done:
@@ -123,8 +148,8 @@ class LocalStore:
 
     def __init__(self, base_dir: str, params: ChunkerParams, *,
                  chunker_factory: ChunkerFactory = _default_chunker_factory,
-                 batch_hasher=None):
-        self.datastore = Datastore(base_dir)
+                 batch_hasher=None, pbs_format: bool = False):
+        self.datastore = Datastore(base_dir, pbs_format=pbs_format)
         self.params = params
         self._chunker_factory = chunker_factory
         self.batch_hasher = batch_hasher

