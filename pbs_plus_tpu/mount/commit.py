@@ -201,16 +201,40 @@ class CommitEngine:
                     prog.emit("verify")
                     self._verify(reader)
 
+                # stores without readable pre-publish staging (PBS wire:
+                # chunk uploads are digest-verified server-side) verify
+                # post-publish through the reader instead
+                pre_verify = getattr(session, "supports_verify_hook", True)
                 manifest = session.finish(
                     {"commit": True,
                      "journal": fs.journal.stats()},
-                    verify_hook=_pre_publish_verify)
+                    verify_hook=_pre_publish_verify if pre_verify else None)
             except BaseException:
                 session.abort()
                 raise
 
             new_ref = session.ref
             reader = self.store.open_snapshot(new_ref)
+            if not pre_verify:
+                prog.emit("verify")
+                try:
+                    self._verify(reader)   # post-publish, same discipline
+                except BaseException:
+                    # the bad snapshot is already published — delete it so
+                    # it can never become the next backup's splice base
+                    L.error("post-publish verify FAILED for %s — deleting "
+                            "the published snapshot", new_ref)
+                    close = getattr(reader.store, "close", None)
+                    if close is not None:
+                        close()
+                    delete = getattr(self.store, "delete_snapshot", None)
+                    if delete is not None:
+                        try:
+                            delete(new_ref)
+                        except Exception as de:
+                            L.error("could not delete bad snapshot %s: %s",
+                                    new_ref, de)
+                    raise
 
             prog.emit("swap")
             # readers are also excluded by the freeze barrier (read paths

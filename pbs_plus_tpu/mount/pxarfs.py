@@ -28,11 +28,21 @@ class ArchiveView:
 
     def hot_swap(self, reader: SplitReader) -> None:
         """Replace the archive under the live mount (reference: HotSwap —
-        performed only after a successful commit publish)."""
+        performed only after a successful commit publish).  The displaced
+        reader's chunk source is closed if it holds a connection (PBS
+        reader sessions) — one leaked socket per commit otherwise."""
         with self._lock:
+            old = self._reader
             self._reader = reader
             self.generation += 1
             self.stats["swaps"] += 1
+        if old is not None and old is not reader:
+            close = getattr(old.store, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
 
     # -- lookups (None-safe for init-mode empty mounts) --------------------
     def lookup(self, path: str) -> Optional[Entry]:

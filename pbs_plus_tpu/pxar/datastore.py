@@ -64,6 +64,12 @@ def parse_snapshot_ref(s: str) -> "SnapshotRef":
     return SnapshotRef(*parts)
 
 
+def parse_backup_time(ts: str) -> int:
+    """Inverse of format_backup_time: 'YYYY-mm-ddTHH:MM:SSZ' → epoch s."""
+    return int(_dt.datetime.strptime(ts, "%Y-%m-%dT%H:%M:%SZ")
+               .replace(tzinfo=_dt.timezone.utc).timestamp())
+
+
 def format_backup_time(t: float | _dt.datetime) -> str:
     if isinstance(t, (int, float)):
         t = _dt.datetime.fromtimestamp(t, _dt.timezone.utc)

@@ -23,17 +23,23 @@ Speaks the PBS backup-writer endpoint vocabulary:
 Index csum contract (golden-tested): sha256 over the concatenation of
 ``end_offset (u64 LE) || digest (32 B)`` per record, in stream order.
 
-Two honest divergences from a stock PBS, stated in docs/architecture.md:
-- Transport: stock PBS runs these endpoints over an HTTP/2 connection
-  upgraded from the ``proxmox-backup-protocol-v1`` GET; this client sends
-  the same vocabulary over plain HTTP/1.1 requests (a thin h2 bridge at
-  the server edge adapts it — the in-process mock in tests/mock_pbs.py is
-  the executable contract).
-- Dedup granularity: ``previous`` preloads the server's known-digest set
-  (chunks already present are never re-uploaded — exactly how
-  proxmox-backup-client dedups), but ref-level range splicing
-  (write_entry_ref) is local-store-only because the backup protocol
-  cannot read previous chunk data back.
+Ref-level range splicing against PBS targets (round 3): the previous
+snapshot's indexes (already fetched for the known-digest preload) back a
+``SplitReader`` whose chunk source is a PBS *reader* session
+(``proxmox-backup-reader-protocol-v1`` vocabulary: ``GET
+/api2/json/reader`` establish + ``GET /chunk?digest=``).  Unchanged files
+splice previous (offset, digest) runs into the new index with NO chunk
+reads, NO chunking and NO hashing (matching the commit engine's reuse,
+/root/reference/internal/pxarmount/commit_walk.go:449-479 +
+commit_reuse.go); the reader session is only dialed for boundary chunks
+of non-aligned ranges and for decoding previous meta entries.
+
+One honest divergence from a stock PBS, stated in docs/architecture.md:
+Transport: stock PBS runs these endpoints over an HTTP/2 connection
+upgraded from the ``proxmox-backup-protocol-v1`` GET; this client sends
+the same vocabulary over plain HTTP/1.1 requests (a thin h2 bridge at
+the server edge adapts it — the in-process mock in tests/mock_pbs.py is
+the executable contract).
 """
 
 from __future__ import annotations
@@ -54,14 +60,16 @@ from ..utils import validate
 from ..utils.log import L
 from .datastore import (
     DIDX_MAGIC, DIDX_VERSION, Datastore, DynamicIndex, SnapshotRef, _HDR,
-    format_backup_time, parse_backup_type,
+    format_backup_time, parse_backup_time, parse_backup_type,
 )
 from .transfer import (
-    ChunkerFactory, DedupWriter, WriterStats, _default_chunker_factory,
+    ChunkerFactory, DedupWriter, SplitReader, WriterStats,
+    _default_chunker_factory,
 )
 from ..chunker import spec as _spec
 
 PROTOCOL_UPGRADE = "proxmox-backup-protocol-v1"
+READER_UPGRADE = "proxmox-backup-reader-protocol-v1"
 INDEX_PUT_BATCH = 256          # records per PUT /dynamic_index
 
 
@@ -250,16 +258,84 @@ class PBSChunkSink:
         pass                            # server-side GC owns chunk liveness
 
 
+class PBSReaderSource:
+    """ChunkStore-shaped ``.get(digest)`` over a PBS *reader* session —
+    the chunk source behind previous-snapshot SplitReaders (ref splicing
+    + previous-meta decode).  The session is established lazily on first
+    use: a fully-spliced unchanged tree never dials it for payload."""
+
+    def __init__(self, cfg: PBSConfig, backup_type: str, backup_id: str,
+                 backup_time: int):
+        self.cfg = cfg
+        self._params = {"store": cfg.datastore, "backup-type": backup_type,
+                        "backup-id": backup_id, "backup-time": backup_time}
+        if cfg.namespace:
+            self._params["ns"] = cfg.namespace
+        self._http: _PBSHttp | None = None
+        self._dctx = zstandard.ZstdDecompressor()
+        self.chunks_fetched = 0
+
+    def _session(self) -> _PBSHttp:
+        if self._http is None:
+            h = _PBSHttp(self.cfg)
+            h.call("GET", "/api2/json/reader", params=self._params,
+                   headers={"Upgrade": READER_UPGRADE})
+            h.session_bound = True
+            self._http = h
+        return self._http
+
+    def _call(self, path: str, params: dict):
+        """Session call with ONE re-dial on transport failure: unlike the
+        writer session, a reader session is read-only and safe to
+        re-establish — without this, a keep-alive timeout on a long-lived
+        hot-swapped mount view would poison every later read."""
+        try:
+            return self._session().call("GET", path, params=params)
+        except (ConnectionError, http.client.HTTPException, OSError):
+            self.close()
+            return self._session().call("GET", path, params=params)
+
+    def get(self, digest: bytes) -> bytes:
+        raw = self._call("/chunk", {"digest": digest.hex()})
+        data = self._dctx.decompress(raw, max_output_size=1 << 30)
+        if hashlib.sha256(data).digest() != digest:
+            raise IOError(f"reader chunk {digest.hex()} digest mismatch")
+        self.chunks_fetched += 1
+        return data
+
+    def download(self, file_name: str) -> bytes:
+        """GET /download?file-name= — index/blob bytes of the session's
+        snapshot (the reader-protocol file download)."""
+        return self._call("/download", {"file-name": file_name})
+
+    def touch(self, digest: bytes) -> None:
+        pass
+
+    def close(self) -> None:
+        if self._http is not None:
+            self._http.close()
+            self._http = None
+
+
 class PBSBackupSession:
     """Same surface as backupproxy.BackupSession: ``.writer``,
-    ``finish()``, ``abort()``, ``.ref`` — but the sink is the PBS wire."""
+    ``finish()``, ``abort()``, ``.ref`` — but the sink is the PBS wire.
+
+    ``supports_verify_hook`` is False: there is no pre-publish staging a
+    client can read back (uploads are digest-verified server-side per
+    chunk; the commit engine re-verifies post-publish through a reader
+    session instead)."""
+
+    supports_verify_hook = False
 
     def __init__(self, store: "PBSStore", ref: SnapshotRef,
                  http_: _PBSHttp, known: set[bytes],
-                 chunker_factory: ChunkerFactory):
+                 chunker_factory: ChunkerFactory,
+                 previous: "object | None" = None):
         self.store = store
         self.ref = ref
         self._http = http_
+        self._previous = previous          # SplitReader over PBSReaderSource
         self.sink = PBSChunkSink(http_, known)
         # writer ids are minted up front: the server requires a valid wid
         # on every /dynamic_chunk upload.  All chunk uploads ride the
@@ -272,7 +348,8 @@ class PBSBackupSession:
         self.sink.set_wid(self._wids[Datastore.PAYLOAD_IDX])
         self.writer = DedupWriter(
             self.sink,                 # ChunkStore-shaped
-            previous=None,             # ref-splicing is local-store-only
+            previous=previous,         # index-backed splicing; boundary
+                                       # bytes ride the PBS reader session
             payload_params=store.params,
             chunker_factory=chunker_factory,
             batch_hasher=store.batch_hasher,
@@ -281,7 +358,7 @@ class PBSBackupSession:
 
     @property
     def previous_reader(self):
-        return None
+        return self._previous
 
     def _upload_index(self, name: str, records: list[tuple[int, bytes]]) -> None:
         wid = self._wids[name]
@@ -329,13 +406,22 @@ class PBSBackupSession:
             self._http.call("POST", "/finish")
         except BaseException:
             self._done = True
+            self._close_reader()
             self._http.close()         # dropping the session aborts it
             raise
         self._done = True
+        self._close_reader()
         self._http.close()
         L.info("PBS upload finished: %s (%d new chunks, %d bytes encoded)",
                self.ref, self.sink.uploaded_chunks, self.sink.uploaded_bytes)
         return manifest
+
+    def _close_reader(self) -> None:
+        if self._previous is not None:
+            try:
+                self._previous.store.close()
+            except Exception:
+                pass
 
     def _finish_writer(self):
         midx, pidx, stats = self.writer.finish()
@@ -381,6 +467,7 @@ class PBSBackupSession:
     def abort(self) -> None:
         if not self._done:
             self._done = True
+            self._close_reader()
             self._http.close()         # no /finish → server discards
 
 
@@ -395,6 +482,37 @@ class PBSStore:
         self.params = params
         self._chunker_factory = chunker_factory
         self.batch_hasher = batch_hasher
+
+    def open_snapshot(self, ref: SnapshotRef, **kw):
+        """SplitReader over a published PBS snapshot (reader session:
+        index download + digest-addressed chunk fetch) — the LocalStore
+        surface the commit engine hot-swaps onto after a commit."""
+        source = PBSReaderSource(self.cfg, ref.backup_type, ref.backup_id,
+                                 parse_backup_time(ref.backup_time))
+        midx = index_from_bytes(source.download(Datastore.META_IDX))
+        pidx = index_from_bytes(source.download(Datastore.PAYLOAD_IDX))
+        return SplitReader(midx, pidx, source, **kw)
+
+    def delete_snapshot(self, ref: SnapshotRef) -> None:
+        """Management-API snapshot removal (the commit engine's cleanup
+        for a snapshot that fails post-publish verification)."""
+        h = _PBSHttp(self.cfg)
+        try:
+            params = {"backup-type": ref.backup_type,
+                      "backup-id": ref.backup_id,
+                      "backup-time": parse_backup_time(ref.backup_time)}
+            if self.cfg.namespace:
+                params["ns"] = self.cfg.namespace
+            h.call("DELETE",
+                   f"/api2/json/admin/datastore/{self.cfg.datastore}"
+                   f"/snapshots", params=params)
+        finally:
+            h.close()
+
+    def last_snapshot(self, backup_type: str, backup_id: str):
+        """Not resolvable client-side without a list API call; sessions
+        resolve 'previous' server-side via GET /previous."""
+        return None
 
     def start_session(self, *, backup_type: str, backup_id: str,
                       backup_time: float | None = None,
@@ -425,6 +543,7 @@ class PBSStore:
                       backup_id: str, t: float,
                       auto_previous: bool) -> PBSBackupSession:
         known: set[bytes] = set()
+        previous = None
         if auto_previous:
             # preload the server-known digest set from the previous
             # snapshot's indexes; a chunk-format mismatch in the previous
@@ -439,13 +558,17 @@ class PBSStore:
                 if (ch.get("format") == _spec.CHUNK_FORMAT
                         and ch.get("avg") == self.params.avg_size
                         and ch.get("seed") == self.params.seed):
+                    idxs: dict[str, DynamicIndex] = {}
                     for name in (Datastore.PAYLOAD_IDX, Datastore.META_IDX):
                         raw = http_.call("GET", "/previous",
                                          params={"archive-name": name})
                         if raw:
                             idx = index_from_bytes(raw)
+                            idxs[name] = idx
                             for i in range(len(idx.ends)):
                                 known.add(idx.digests[i].tobytes())
+                    previous = self._previous_reader(http_, idxs,
+                                                     backup_type, backup_id)
                 else:
                     L.warning("previous PBS snapshot uses different chunk "
                               "format/params; full upload")
@@ -454,4 +577,21 @@ class PBSStore:
                     raise
         ref = SnapshotRef(backup_type, backup_id, format_backup_time(t))
         return PBSBackupSession(self, ref, http_, known,
-                                self._chunker_factory)
+                                self._chunker_factory, previous=previous)
+
+    def _previous_reader(self, http_: _PBSHttp,
+                         idxs: dict[str, DynamicIndex],
+                         backup_type: str, backup_id: str):
+        """SplitReader over the previous snapshot, chunk-sourced from a
+        lazy PBS reader session — enables write_entry_ref splicing with
+        zero chunk IO for aligned (whole-chunk) ranges."""
+        if Datastore.PAYLOAD_IDX not in idxs or \
+                Datastore.META_IDX not in idxs:
+            return None
+        try:
+            prev_t = int(http_.call("GET", "/previous_backup_time"))
+        except (PBSError, TypeError, ValueError):
+            return None                # server without reader support
+        source = PBSReaderSource(self.cfg, backup_type, backup_id, prev_t)
+        return SplitReader(idxs[Datastore.META_IDX],
+                           idxs[Datastore.PAYLOAD_IDX], source)

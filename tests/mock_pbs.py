@@ -19,9 +19,19 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import zstandard
 
 from pbs_plus_tpu.pxar.pbsstore import index_csum, index_to_bytes
-from pbs_plus_tpu.pxar.datastore import DynamicIndex
+from pbs_plus_tpu.pxar.datastore import DynamicIndex, parse_backup_time
 
 import numpy as np
+
+
+def _index_from_records(recs: list) -> DynamicIndex:
+    """[(end, digest)] → DynamicIndex (the one serialization the mock's
+    /previous and /download endpoints share)."""
+    return DynamicIndex(
+        np.array([e for e, _ in recs], dtype=np.uint64),
+        np.frombuffer(b"".join(d for _, d in recs),
+                      dtype=np.uint8).reshape(-1, 32)
+        if recs else np.empty((0, 32), dtype=np.uint8))
 
 
 class MockPBS:
@@ -31,11 +41,26 @@ class MockPBS:
         self.snapshots: dict[str, dict] = {}      # "type/id/time" → state
         self.api_tokens: dict[str, str] = {}      # tokenid → secret
         self.sessions: dict = {}                  # client addr → session
+        self.reader_sessions: dict = {}           # client addr → reader sess
         self.request_log: list[str] = []          # wire golden trace
         self.lock = threading.Lock()
         self._dctx = zstandard.ZstdDecompressor()
+        self._cctx = zstandard.ZstdCompressor(level=3)
 
         mock = self
+
+        def resolve_previous(params) -> dict | None:
+            """Latest snapshot of the session's backup group, or None."""
+            group = [r for r in mock.snapshots
+                     if r.startswith(f"{params['backup-type']}/"
+                                     f"{params['backup-id']}/")]
+            return mock.snapshots[max(group)] if group else None
+
+        def previous_ref(params) -> str | None:
+            group = [r for r in mock.snapshots
+                     if r.startswith(f"{params['backup-type']}/"
+                                     f"{params['backup-id']}/")]
+            return max(group) if group else None
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -130,6 +155,70 @@ class MockPBS:
                         "avail": (1 << 40) - used,
                         "counts": {"snapshots": len(mock.snapshots)}})
 
+                if method == "GET" and path == "/api2/json/reader":
+                    if self.headers.get("Upgrade") != \
+                            "proxmox-backup-reader-protocol-v1":
+                        return self._fail(400, "invalid upgrade protocol")
+                    for k in ("store", "backup-type", "backup-id",
+                              "backup-time"):
+                        if k not in q:
+                            return self._fail(400, f"missing {k}")
+                    with mock.lock:
+                        mock.reader_sessions[self.client_address] = \
+                            {"params": q}
+                    return self._send(200, {"msg": "reader established"})
+
+                if method == "GET" and path == "/chunk":
+                    if self.client_address not in mock.reader_sessions:
+                        return self._fail(400, "no reader session on this "
+                                               "connection")
+                    digest = q.get("digest", "")
+                    with mock.lock:
+                        raw = mock.chunks.get(digest)
+                    if raw is None:
+                        return self._fail(404, f"unknown chunk {digest}")
+                    return self._send(200, mock._cctx.compress(raw))
+
+                if method == "GET" and path == "/download":
+                    rs = mock.reader_sessions.get(self.client_address)
+                    if rs is None:
+                        return self._fail(400, "no reader session on this "
+                                               "connection")
+                    p = rs["params"]
+                    import datetime as dt
+                    ts = dt.datetime.fromtimestamp(
+                        int(p["backup-time"]),
+                        dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+                    ref = f"{p['backup-type']}/{p['backup-id']}/{ts}"
+                    snap = mock.snapshots.get(ref)
+                    if snap is None:
+                        return self._fail(404, f"no snapshot {ref}")
+                    name = q.get("file-name", "")
+                    if name in snap["indexes"]:
+                        return self._send(200, index_to_bytes(
+                            _index_from_records(snap["indexes"][name])))
+                    if name in snap["blobs"]:
+                        return self._send(200, snap["blobs"][name])
+                    return self._fail(404, f"unknown file {name}")
+
+                if method == "DELETE" and \
+                        path.startswith("/api2/json/admin/datastore/") and \
+                        path.endswith("/snapshots"):
+                    self._body()
+                    try:
+                        import datetime as dt
+                        ts = dt.datetime.fromtimestamp(
+                            int(q["backup-time"]),
+                            dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+                        ref = f"{q['backup-type']}/{q['backup-id']}/{ts}"
+                    except (KeyError, ValueError):
+                        return self._fail(400, "bad snapshot params")
+                    with mock.lock:
+                        if ref not in mock.snapshots:
+                            return self._fail(404, f"no snapshot {ref}")
+                        del mock.snapshots[ref]
+                    return self._send(200, None)
+
                 if method == "GET" and path == "/api2/json/backup":
                     if self.headers.get("Upgrade") != \
                             "proxmox-backup-protocol-v1":
@@ -223,26 +312,21 @@ class MockPBS:
                     sess["blobs"][name] = body
                     return self._send(200, None)
 
+                if method == "GET" and path == "/previous_backup_time":
+                    ref = previous_ref(sess["params"])
+                    if ref is None:
+                        return self._fail(404, "no previous backup")
+                    return self._send(
+                        200, parse_backup_time(ref.rsplit("/", 1)[1]))
+
                 if method == "GET" and path == "/previous":
                     name = q.get("archive-name", "")
-                    p = sess["params"]
-                    group = [r for r in mock.snapshots
-                             if r.startswith(f"{p['backup-type']}/"
-                                             f"{p['backup-id']}/")]
-                    if not group:
+                    prev = resolve_previous(sess["params"])
+                    if prev is None:
                         return self._fail(404, "no previous backup")
-                    prev = mock.snapshots[max(group)]
                     if name in prev["indexes"]:
-                        idx = DynamicIndex(
-                            np.array([e for e, _ in prev["indexes"][name]],
-                                     dtype=np.uint64),
-                            np.frombuffer(
-                                b"".join(d for _, d in
-                                         prev["indexes"][name]),
-                                dtype=np.uint8).reshape(-1, 32)
-                            if prev["indexes"][name] else
-                            np.empty((0, 32), dtype=np.uint8))
-                        return self._send(200, index_to_bytes(idx))
+                        return self._send(200, index_to_bytes(
+                            _index_from_records(prev["indexes"][name])))
                     if name in prev["blobs"]:
                         return self._send(200, prev["blobs"][name])
                     return self._fail(404, f"unknown archive {name}")
