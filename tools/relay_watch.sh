@@ -1,0 +1,33 @@
+#!/bin/sh
+# Round-long TPU relay watcher (VERDICT r2 task 1).
+# Probes the accelerator relay ports every 120s; logs every probe, and
+# touches .relay_watch/OPEN the first time any port accepts so the
+# session can immediately run bench.py on the live chip.
+cd /root/repo || exit 1
+mkdir -p .relay_watch
+while true; do
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  open=$(python - <<'EOF'
+import socket
+for port in (8082, 8083, 8087, 8092):
+    s = socket.socket()
+    s.settimeout(2.0)
+    try:
+        s.connect(("127.0.0.1", port))
+    except OSError:
+        pass
+    else:
+        print(port)
+        break
+    finally:
+        s.close()
+EOF
+)
+  if [ -n "$open" ]; then
+    echo "$ts OPEN port=$open" >> .relay_watch/log
+    date -u +%s > .relay_watch/OPEN
+  else
+    echo "$ts closed" >> .relay_watch/log
+  fi
+  sleep 120
+done
