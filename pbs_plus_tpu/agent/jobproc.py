@@ -90,6 +90,11 @@ async def child_backup_main(cfg: dict) -> int:
         fs = AgentFSServer(snap.snapshot_path)
         router = Router()
         fs.register(router)
+
+        # the job child is where backup CPU burns — profile it through
+        # its own data session (pprof-on-every-process)
+        from ..utils.profiling import profile_rpc
+        router.handle("profile", profile_rpc)
         try:
             await router.serve_connection(conn)
         finally:

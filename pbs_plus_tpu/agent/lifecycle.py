@@ -82,6 +82,10 @@ class AgentLifecycle:
         r.handle("filetree", self._filetree)
         r.handle("verify_start", self._verify_start)
         r.handle("drives", self._drives)
+        # CPU-profile capture on demand (the agent-side pprof analog,
+        # reference internal/agent/cli/entry.go:59-79)
+        from ..utils.profiling import profile_rpc
+        r.handle("profile", profile_rpc)
 
     async def _drives(self, req, ctx):
         from .drives import enumerate_drives

@@ -140,6 +140,7 @@ class CuckooIndex:
         slots ran out) falls back to per-digest eviction chains.  A 1M
         preload (PBSStore ``previous`` known-digest warm-up) builds in
         one pass instead of a million Python round-trips."""
+        digests = list(digests)          # accept any iterable, like insert
         for d in digests:
             if len(d) != 32:
                 raise ValueError(f"digest must be 32 bytes, got {len(d)}")

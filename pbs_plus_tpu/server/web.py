@@ -451,6 +451,63 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
             "tasks": len(asyncio.all_tasks()),
         })
 
+    _profile_lock = asyncio.Lock()
+
+    async def debug_profile(request):
+        """CPU-profile capture (the pprof /debug/pprof/profile analog;
+        reference internal/server/web/server.go:135-139).  Body:
+        ``{"seconds": N}`` profiles this server process;
+        ``{"target": host}`` RPCs the agent daemon;
+        ``{"target": host, "backup_id": job}`` reaches the running job
+        child through its data session.  ``?format=text`` renders the
+        pprof-``top`` table instead of JSON."""
+        from ..utils.profiling import MAX_SECONDS, capture_profile, render_top
+        b = await request.json() if request.can_read_body else {}
+        if not isinstance(b, dict):
+            return web.json_response({"error": "body must be an object"},
+                                     status=400)
+        try:
+            seconds = float(b.get("seconds", 2.0))
+        except (TypeError, ValueError):
+            return web.json_response({"error": "bad seconds"}, status=400)
+        if not (0 < seconds <= MAX_SECONDS):
+            return web.json_response(
+                {"error": f"seconds must be in (0, {MAX_SECONDS:.0f}]"},
+                status=400)
+        target = b.get("target", "")
+        if _profile_lock.locked():
+            return web.json_response({"error": "profile already running"},
+                                     status=409)
+        async with _profile_lock:
+            if target:
+                cid = target
+                sess = server.agents.get(cid)
+                if b.get("backup_id"):
+                    # job sessions carry a per-run suffix
+                    # ("<host>|<job>-<run>"): resolve by prefix
+                    pfx = f"{target}|{b['backup_id']}"
+                    live = [s for s in server.agents.sessions()
+                            if s.client_id == pfx
+                            or s.client_id.startswith(pfx + "-")]
+                    cid = pfx
+                    sess = live[0] if live else None
+                if sess is None:
+                    return web.json_response(
+                        {"error": f"no live session for {cid!r}"},
+                        status=503)
+                from ..arpc import Session
+                resp = await Session(sess.conn).call(
+                    "profile", {"seconds": seconds},
+                    timeout=seconds + 30.0)
+                prof = resp.data
+            else:
+                prof = await asyncio.get_running_loop().run_in_executor(
+                    None, capture_profile, seconds)
+        if request.query.get("format") == "text":
+            return web.Response(text=render_top(prof),
+                                content_type="text/plain")
+        return web.json_response({"data": prof})
+
     # -- snapshot mounts ---------------------------------------------------
     def _mount_service():
         if getattr(server, "mount_service", None) is None:
@@ -546,6 +603,7 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
     app.router.add_get("/api2/json/d2d/snapshot-zip", snapshot_zip)
     app.router.add_get("/plus/debug/tasks", debug_tasks)
     app.router.add_get("/plus/debug/stats", debug_stats)
+    app.router.add_post("/plus/debug/profile", debug_profile)
     app.router.add_post("/api2/json/d2d/mount", mount_create)
     app.router.add_get("/api2/json/d2d/mount", mount_list)
     app.router.add_delete("/api2/json/d2d/mount/{mid}", mount_delete)
