@@ -35,6 +35,9 @@ def _cmd_server(args: argparse.Namespace) -> int:
             pbs_url=args.pbs_url, pbs_datastore=args.pbs_datastore,
             pbs_token=args.pbs_token, pbs_namespace=args.pbs_namespace,
             pbs_fingerprint=args.pbs_fingerprint,
+            pbs_auth_key_path=args.pbs_auth_key,
+            pbs_csrf_key_path=args.pbs_csrf_key,
+            pbs_auth_allowed_users=args.pbs_auth_users,
             prune_keep_last=args.prune_keep_last,
             prune_keep_daily=args.prune_keep_daily,
             prune_keep_weekly=args.prune_keep_weekly,
@@ -350,6 +353,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="PBSAPIToken user@realm!name:secret")
     s.add_argument("--pbs-namespace", default="")
     s.add_argument("--pbs-fingerprint", default="")
+    s.add_argument("--pbs-auth-key", default="",
+                   help="PBS ticket-signing key (e.g. /etc/proxmox-backup/"
+                        "authkey.key); enables PBS-cookie auth on the web API")
+    s.add_argument("--pbs-csrf-key", default="",
+                   help="PBS CSRF secret (/etc/proxmox-backup/csrf.key); "
+                        "required for cookie-authenticated write requests")
+    s.add_argument("--pbs-auth-users", default="",
+                   help="CSV of PBS userids granted sidecar access via "
+                        "cookie (default root@pam; '*' = any PBS user)")
     s.add_argument("--prune-keep-last", type=int, default=0)
     s.add_argument("--prune-keep-daily", type=int, default=0)
     s.add_argument("--prune-keep-weekly", type=int, default=0)

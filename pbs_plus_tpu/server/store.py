@@ -57,6 +57,16 @@ class ServerConfig:
     pbs_token: str = ""
     pbs_namespace: str = ""
     pbs_fingerprint: str = ""
+    # PBS-host drop-in: path to PBS's ticket-signing key
+    # (/etc/proxmox-backup/authkey.key); when set, the web API accepts
+    # the PBS UI's auth cookie alongside bearer tokens (reference:
+    # internal/server/web/auth.go:55-297).  Cookie-authed writes
+    # additionally need a CSRFPreventionToken validated with the PBS
+    # CSRF secret; only allowed_users (default root@pam, "*" = any)
+    # get sidecar access.
+    pbs_auth_key_path: str = ""
+    pbs_csrf_key_path: str = ""
+    pbs_auth_allowed_users: str = ""
     # retention: scheduled prune+GC over the local datastore (0 = keep
     # all; empty schedule = manual only via POST /api2/json/d2d/prune)
     prune_keep_last: int = 0

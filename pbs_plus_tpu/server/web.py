@@ -23,8 +23,9 @@ aiohttp application; route groups:
     GET/POST /api2/json/d2d/verification         verification jobs
 
 Auth: API routes use bearer tokens minted by ``api_token`` (sealed in DB);
-the reference proxies PBS ticket auth — a PBS host integration can swap
-the authenticator (web/auth.go analog) without touching handlers.
+with ``pbs_auth_key_path`` configured (PBS-host drop-in) the middleware
+also accepts the PBS UI's auth cookie, verified against PBS's own
+ticket-signing key (``server/pbsauth.py``, the web/auth.go analog).
 """
 
 from __future__ import annotations
@@ -114,6 +115,14 @@ class RateLimiter:
 def build_app(server: "Server", *, require_auth: bool = True) -> web.Application:
     metrics = MetricsRegistry(server)
     limiter = RateLimiter()
+    from .pbsauth import (
+        load_authenticator, load_csrf_validator, parse_allowed_users)
+    ticket_auth = load_authenticator(
+        getattr(server.config, "pbs_auth_key_path", ""))
+    csrf_auth = load_csrf_validator(
+        getattr(server.config, "pbs_csrf_key_path", ""))
+    ticket_users = parse_allowed_users(
+        getattr(server.config, "pbs_auth_allowed_users", ""))
 
     @web.middleware
     async def rate_limit(request: web.Request, handler):
@@ -146,6 +155,27 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
                         for c in _secret_candidates(sec))
                 except Exception:
                     authorized = False
+        if not authorized and ticket_auth is not None:
+            # PBS-host drop-in: the PBS UI's own auth cookie signs the
+            # operator in (reference internal/server/web/auth.go:297-321).
+            # Cookie auth alone covers safe methods only; writes need a
+            # CSRFPreventionToken (browsers attach cookies cross-origin —
+            # real PBS enforces the same; the reference sidecar doesn't).
+            cookie = (request.cookies.get("__Host-PBSAuthCookie")
+                      or request.cookies.get("PBSAuthCookie"))
+            if cookie:
+                ticket = ticket_auth.verify_ticket(cookie)
+                if (ticket is not None
+                        and (ticket_users is None
+                             or ticket.userid in ticket_users)):
+                    if request.method in ("GET", "HEAD", "OPTIONS"):
+                        authorized = True
+                    elif csrf_auth is not None and csrf_auth.verify_token(
+                            request.headers.get("CSRFPreventionToken", ""),
+                            ticket.userid):
+                        authorized = True
+                    if authorized:
+                        request["pbs_userid"] = ticket.userid
         if not authorized:
             return web.json_response({"error": "unauthorized"}, status=401)
         return await handler(request)
