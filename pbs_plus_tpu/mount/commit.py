@@ -23,6 +23,7 @@ anywhere leaves the old archive serving and the journal intact.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import shutil
 import time
@@ -262,17 +263,73 @@ class CommitEngine:
         finally:
             fs.unfreeze()
 
+    # verify dispatch ceiling: files are re-hashed in device batches of at
+    # most this many bytes, so commit memory stays bounded no matter how
+    # large the changed set is (reference: commit_memory_test's B-series
+    # pins the same property on the Go engine)
+    VERIFY_BATCH_BYTES = 32 << 20
+
     def _verify(self, reader: SplitReader) -> None:
         """Re-hash the files this commit wrote (changed/new content) against
         their recorded digests (reference: verifyBackedFileHashes — only
-        passthrough-backed files, so commit cost stays O(changed bytes))."""
+        passthrough-backed files, so commit cost stays O(changed bytes),
+        with peak memory bounded by VERIFY_BATCH_BYTES per dispatch)."""
         changed = set(getattr(self, "_changed_paths", []))
         vp = VerifyPipeline()
         entries = [e for e in reader.entries()
                    if e.is_file and e.size and e.digest and e.path in changed]
-        chunks = [reader.read_file(e) for e in entries]
-        res = vp.verify_chunks(chunks, [e.digest for e in entries])
-        self.progress.verified = res.checked
-        if not res.ok:
+        # verify reads every changed chunk exactly once — the reader's
+        # big serving cache would just retain them all; cap it for the
+        # duration so commit peak stays ~2x the batch ceiling
+        cache = getattr(reader, "_cache", None)
+        saved_cap = getattr(cache, "max_bytes", None)
+        if cache is not None and saved_cap is not None:
+            cache.max_bytes = min(saved_cap, self.VERIFY_BATCH_BYTES)
+        try:
+            self._verify_entries(vp, reader, entries)
+        finally:
+            if cache is not None and saved_cap is not None:
+                cache.max_bytes = saved_cap
+
+    def _verify_entries(self, vp, reader, entries) -> None:
+        checked = 0
+        corrupt: list[str] = []
+        batch: list = []
+        batch_bytes = 0
+
+        def flush():
+            nonlocal checked, batch, batch_bytes
+            if not batch:
+                return
+            chunks = [reader.read_file(e) for e in batch]
+            res = vp.verify_chunks(chunks, [e.digest for e in batch])
+            checked += res.checked
+            corrupt.extend(batch[i].path for i in res.corrupt)
+            batch, batch_bytes = [], 0
+
+        for e in entries:
+            if e.size > self.VERIFY_BATCH_BYTES:
+                # a single over-ceiling file is stream-hashed on the
+                # host in bounded blocks instead of materializing whole
+                h = hashlib.sha256()
+                off = 0
+                blk = min(8 << 20, self.VERIFY_BATCH_BYTES)
+                while off < e.size:
+                    block = reader.read_file(e, off, blk)
+                    if not block:
+                        break
+                    h.update(block)
+                    off += len(block)
+                checked += 1
+                if h.digest() != e.digest:
+                    corrupt.append(e.path)
+                continue
+            batch.append(e)
+            batch_bytes += e.size
+            if batch_bytes >= self.VERIFY_BATCH_BYTES:
+                flush()
+        flush()
+        self.progress.verified = checked
+        if corrupt:
             raise RuntimeError(
-                f"commit verification failed for {len(res.corrupt)} files")
+                f"commit verification failed for {len(corrupt)} files")

@@ -60,7 +60,11 @@ class WriterStats:
 
 
 BatchHasher = Callable[[list[bytes]], list[bytes]]
-_HASH_BATCH_BYTES = 64 << 20
+# pending-hash ceiling: chunk copies held for the next batched sha256
+# dispatch.  16 MiB saturates the device hash kernel while keeping the
+# writer's peak memory ~2x this bound regardless of stream size (the
+# commit_memory_test analog in tests/test_commit_edges.py pins it)
+_HASH_BATCH_BYTES = 16 << 20
 _HASH_BATCH_COUNT = 512
 
 

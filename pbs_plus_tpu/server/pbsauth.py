@@ -137,9 +137,10 @@ def _split_ticket(raw: str) -> tuple[str | None, bytes]:
         return None, b""
     if sig_str.startswith(":"):
         sig_str = sig_str[1:]
-    # restore '+'→space mangling BEFORE trimming, or a signature whose
-    # first char is '+' loses it to the strip (review finding r3)
-    sig_str = sig_str.replace(" ", "+").strip("\t")
+    # trailing whitespace is proxy padding — trim it (reference trims
+    # both sides); a LEADING space is '+'-mangling of the signature's
+    # first char, so restore rather than strip it (review finding r3)
+    sig_str = sig_str.rstrip(" \t").lstrip("\t").replace(" ", "+")
     pad = "=" * (-len(sig_str) % 4)
     try:
         return left, base64.b64decode(sig_str + pad, validate=True)
@@ -164,6 +165,8 @@ class CSRFTokenValidator:
     PBS enforces this for its own API; the reference sidecar has no
     CSRF layer — a gap this build closes rather than inherits)."""
 
+    MIN_SECRET_BYTES = 16
+
     def __init__(self, secret: bytes, *,
                  lifetime_s: float = TICKET_LIFETIME_S):
         secret = secret.strip()
@@ -173,6 +176,12 @@ class CSRFTokenValidator:
                 secret = decoded
         except (binascii.Error, ValueError):
             pass
+        if len(secret) < self.MIN_SECRET_BYTES:
+            # an empty/placeholder csrf.key must disable cookie writes,
+            # not silently degrade to a forgeable HMAC key
+            raise ValueError(
+                f"CSRF secret too short ({len(secret)} bytes; "
+                f"need >= {self.MIN_SECRET_BYTES})")
         self._secret = secret
         self.lifetime_s = lifetime_s
 

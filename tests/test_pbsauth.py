@@ -107,6 +107,20 @@ def test_csrf_token_roundtrip():
     # base64-encoded secret file decodes to the same validator
     v2 = CSRFTokenValidator(base64.b64encode(b"csrf-secret-bytes"))
     assert v2.verify_token(tok, "root@pam")
+    # a placeholder/empty secret must not degrade to a forgeable key
+    import pytest
+    for bad in (b"", b"short", b"   \n"):
+        with pytest.raises(ValueError):
+            CSRFTokenValidator(bad)
+
+
+def test_load_csrf_validator_rejects_weak_key(tmp_path):
+    from pbs_plus_tpu.server.pbsauth import load_csrf_validator
+    p = tmp_path / "csrf.key"
+    p.write_bytes(b"")
+    assert load_csrf_validator(str(p)) is None     # writes stay disabled
+    p.write_bytes(os.urandom(32))
+    assert load_csrf_validator(str(p)) is not None
 
 
 def test_web_accepts_pbs_cookie(tmp_path):
