@@ -34,12 +34,14 @@ reads, NO chunking and NO hashing (matching the commit engine's reuse,
 commit_reuse.go); the reader session is only dialed for boundary chunks
 of non-aligned ranges and for decoding previous meta entries.
 
-One honest divergence from a stock PBS, stated in docs/architecture.md:
-Transport: stock PBS runs these endpoints over an HTTP/2 connection
-upgraded from the ``proxmox-backup-protocol-v1`` GET; this client sends
-the same vocabulary over plain HTTP/1.1 requests (a thin h2 bridge at
-the server edge adapts it — the in-process mock in tests/mock_pbs.py is
-the executable contract).
+Transport (round 3): the client auto-detects the server's answer to the
+protocol-upgrade GET.  A stock PBS replies ``101 Switching Protocols``
+and the session continues over real HTTP/2 on the same connection
+(``utils/h2lib``, libnghttp2 via ctypes — flow control/HPACK are the
+reference h2 implementation's); an HTTP/1.1 answer (the in-process mock
+in tests/mock_pbs.py) keeps the session on h1.  Both transports carry
+the identical endpoint vocabulary; tests/test_pbsstore_h2.py exercises
+the h2 side against an nghttp2 server bridge.
 """
 
 from __future__ import annotations
@@ -144,6 +146,12 @@ class _PBSHttp:
         # transparent reconnect is wrong: the fresh connection has no
         # session, so surface the transport failure instead (review r2)
         self.session_bound = False
+        # set when the server answers the protocol-upgrade GET with
+        # 101 Switching Protocols (stock PBS): all later requests ride
+        # HTTP/2 streams on the same connection (utils/h2lib via
+        # libnghttp2).  The in-process mock answers 200 and the session
+        # stays on HTTP/1.1 — both transports carry the same vocabulary.
+        self._h2 = None
 
     def _connect(self) -> http.client.HTTPConnection:
         if self._conn is not None:
@@ -181,12 +189,23 @@ class _PBSHttp:
             hdrs["Content-Type"] = "application/json"
         if headers:
             hdrs.update(headers)
+        if self._h2 is not None:
+            status, rhdrs, data = self._h2.request(
+                method, url, hdrs, body, authority=f"{self.host}:{self.port}",
+                scheme="https" if self.tls else "http")
+            return status, data, rhdrs.get("content-type", "")
         # pre-session requests may retry once on a stale keepalive; once
         # the session is connection-bound a reconnect can never succeed
         attempts = (0,) if self.session_bound else (0, 1)
         for attempt in attempts:
             conn = self._connect()
             try:
+                if "Upgrade" in hdrs:
+                    # protocol-establishment GET: a stock PBS answers
+                    # 101 and switches to h2, so the exchange must stay
+                    # OFF http.client — its buffered response reader
+                    # would swallow the server's first h2 frames
+                    return self._upgrade_exchange(conn, method, url, hdrs)
                 conn.request(method, url, body=body, headers=hdrs)
                 r = conn.getresponse()
                 data = r.read()
@@ -196,6 +215,78 @@ class _PBSHttp:
                 if attempt == attempts[-1]:
                     raise
         raise AssertionError("unreachable")
+
+    def _upgrade_exchange(self, conn: http.client.HTTPConnection,
+                          method: str, url: str,
+                          hdrs: dict) -> tuple[int, bytes, str]:
+        """Send the upgrade request raw on the connection's socket and
+        parse the response head ourselves.  101 → hand the socket (plus
+        any h2 bytes that rode the same segment) to H2ClientSession;
+        anything else (the HTTP/1.1 mock answers 200) → consume the
+        content-length body so the connection stays clean for
+        http.client's later requests."""
+        from ..utils import h2lib
+        if conn.sock is None:
+            conn.connect()
+        sock = conn.sock
+        lines = [f"{method} {url} HTTP/1.1",
+                 f"Host: {self.host}:{self.port}",
+                 "Connection: Upgrade"]
+        lines += [f"{k}: {v}" for k, v in hdrs.items()]
+        sock.sendall(("\r\n".join(lines) + "\r\n\r\n").encode())
+        first, rhdrs, rest = h2lib.read_h1_head(sock)
+        status = int(first.split(" ", 2)[1])
+        if status == 101:
+            conn.sock = None              # socket belongs to h2 now
+            self._conn = None
+            self._h2 = h2lib.H2ClientSession(sock, initial_data=rest)
+            return 101, b"", ""
+        ctype = rhdrs.get("content-type", "")
+        if "content-length" in rhdrs:
+            clen = int(rhdrs["content-length"])
+            while len(rest) < clen:
+                got = sock.recv(65536)
+                if not got:
+                    raise ConnectionError("connection closed reading body")
+                rest += got
+            return status, rest[:clen], ctype
+        # chunked / close-delimited non-101 answers: drain what we can,
+        # then drop the connection — its framing state is unknowable to
+        # http.client, so a clean re-dial beats a desynced keep-alive
+        if "chunked" in rhdrs.get("transfer-encoding", "").lower():
+            body = bytearray()
+            buf = rest
+            while True:
+                while b"\r\n" not in buf:
+                    got = sock.recv(65536)
+                    if not got:
+                        raise ConnectionError("connection closed mid-chunk")
+                    buf += got
+                size_ln, buf = buf.split(b"\r\n", 1)
+                n = int(size_ln.split(b";")[0], 16)
+                while len(buf) < n + 2:
+                    got = sock.recv(65536)
+                    if not got:
+                        raise ConnectionError("connection closed mid-chunk")
+                    buf += got
+                body += buf[:n]
+                buf = buf[n + 2:]
+                if n == 0:
+                    break
+            self.close()
+            return status, bytes(body), ctype
+        sock.settimeout(self.cfg.timeout_s)
+        body = bytearray(rest)
+        try:
+            while True:
+                got = sock.recv(65536)
+                if not got:
+                    break
+                body += got
+        except OSError:
+            pass
+        self.close()
+        return status, bytes(body), ctype
 
     def call(self, method: str, path: str, params: dict | None = None,
              body: bytes | None = None, json_body: dict | None = None,
@@ -213,6 +304,12 @@ class _PBSHttp:
         return data
 
     def close(self) -> None:
+        if self._h2 is not None:
+            try:
+                self._h2.close()
+            except Exception:
+                pass
+            self._h2 = None
         if self._conn is not None:
             try:
                 self._conn.close()

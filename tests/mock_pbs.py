@@ -390,3 +390,113 @@ class MockPBS:
         self.server.shutdown()
         self.server.server_close()
         self.thread.join(5)
+
+
+class H2UpgradeBridge:
+    """Stock-PBS transport front for the mock: answers the
+    ``proxmox-backup-protocol-v1`` / reader upgrade GET with
+    ``101 Switching Protocols`` and then speaks real HTTP/2 (libnghttp2
+    server side, ``utils/h2lib``), forwarding every h2 stream to the
+    HTTP/1.1 mock over one persistent connection per client — so the
+    mock's connection-bound session model is preserved and the
+    PBSStore client's h2 path is exercised against the reference h2
+    implementation, not a mirror of itself."""
+
+    def __init__(self, mock: MockPBS):
+        import socket as _socket
+
+        from pbs_plus_tpu.utils.h2lib import H2ServerSession
+
+        self.mock = mock
+        self._lsock = _socket.socket()
+        self._lsock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(16)
+        self.port = self._lsock.getsockname()[1]
+        self._stop = threading.Event()
+        self._H2ServerSession = H2ServerSession
+        self.upgrades = 0                    # 101s handed out (test probe)
+        self.thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self.thread.start()
+
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._lsock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(sock,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _read_h1_request(sock) -> tuple[str, str, dict]:
+        from pbs_plus_tpu.utils.h2lib import read_h1_head
+        first, headers, _ = read_h1_head(sock)
+        method, path, _ = first.split(" ", 2)
+        return method, path, headers
+
+    def _serve_conn(self, sock) -> None:
+        import http.client
+
+        upstream: http.client.HTTPConnection | None = None
+        try:
+            method, path, headers = self._read_h1_request(sock)
+            upgrade = headers.get("upgrade", "")
+            fwd = {"Authorization": headers.get("authorization", "")}
+            if upgrade:
+                fwd["Upgrade"] = upgrade
+            # ONE persistent upstream connection per client: the mock
+            # keys protocol sessions by client address
+            upstream = http.client.HTTPConnection("127.0.0.1",
+                                                  self.mock.port)
+            upstream.request(method, path, headers=fwd)
+            r = upstream.getresponse()
+            body = r.read()
+            if not upgrade or r.status != 200:
+                # establishment failed: relay the h1 error verbatim
+                ctype = r.getheader("Content-Type", "application/json")
+                sock.sendall(
+                    f"HTTP/1.1 {r.status} X\r\nContent-Type: {ctype}\r\n"
+                    f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+                return
+            sock.sendall(
+                b"HTTP/1.1 101 Switching Protocols\r\n"
+                b"Connection: Upgrade\r\n"
+                b"Upgrade: " + upgrade.encode() + b"\r\n\r\n")
+            self.upgrades += 1
+
+            def handler(m, p, hdrs, data):
+                up_h = {"Authorization": hdrs.get("authorization", "")}
+                if "content-type" in hdrs:
+                    up_h["Content-Type"] = hdrs["content-type"]
+                upstream.request(m, p, body=data or None, headers=up_h)
+                rr = upstream.getresponse()
+                rbody = rr.read()
+                return rr.status, {"content-type":
+                                   rr.getheader("Content-Type", "")}, rbody
+
+            self._H2ServerSession(sock, handler).serve()
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            if upstream is not None:
+                try:
+                    upstream.close()
+                except Exception:
+                    pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        self.thread.join(5)
