@@ -35,13 +35,22 @@ class RestoreResult:
 
 class RestoreEngine:
     def __init__(self, client: RemoteArchiveClient, dest: str, *,
-                 verify: bool = True, apply_ownership: bool | None = None):
+                 verify: bool = True, apply_ownership: bool | None = None,
+                 win_meta=None):
         self.c = client
         self.dest = os.path.abspath(dest)
         self.verify = verify
         # chown needs root; default to trying only when euid == 0
-        self.apply_ownership = (os.geteuid() == 0
-                                if apply_ownership is None else apply_ownership)
+        # (no euid on Windows — ownership rides the SDDL there)
+        if apply_ownership is None:
+            apply_ownership = getattr(os, "geteuid", lambda: -1)() == 0
+        self.apply_ownership = apply_ownership
+        # Windows metadata applier (restore_windows.go analog): active on
+        # win32, injectable everywhere for the seam tests
+        if win_meta is None and os.name == "nt":  # pragma: no cover
+            from .win.restore import WinMetaApplier
+            win_meta = WinMetaApplier()
+        self.win_meta = win_meta
         self.result = RestoreResult()
         self._hardlinks: list[tuple[str, str]] = []
         self._dir_meta: list[tuple[str, Entry]] = []
@@ -150,6 +159,8 @@ class RestoreEngine:
             except OSError:
                 pass
         for name, value in e.xattrs.items():
+            if name.startswith("win."):
+                continue        # Windows metadata is applied below
             try:
                 os.setxattr(path, name, value)
             except OSError:
@@ -158,6 +169,13 @@ class RestoreEngine:
             os.utime(path, ns=(e.mtime_ns, e.mtime_ns))
         except OSError:
             pass
+        if self.win_meta is not None and any(
+                k.startswith("win.") for k in e.xattrs):
+            # ACLs, attribute bits, ADS, then times (restore_windows.go
+            # applyMeta ordering)
+            n0 = len(self.win_meta.errors)
+            self.win_meta.apply(path, e.mtime_ns, e.xattrs)
+            self.result.errors.extend(self.win_meta.errors[n0:])
 
 
 async def run_restore_job(session, dest: str, *, verify: bool = True,

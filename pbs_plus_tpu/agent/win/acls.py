@@ -15,12 +15,14 @@ same way), so Linux↔Windows archives stay structurally identical."""
 from __future__ import annotations
 
 import re
+import struct
 import subprocess
 from typing import Callable
 
 Runner = Callable[..., "subprocess.CompletedProcess"]
 
 SDDL_XATTR = "win.sddl"
+SD_XATTR = "win.sd"            # binary self-relative SECURITY_DESCRIPTOR
 
 
 def _ps(script: str) -> list[str]:
@@ -49,11 +51,18 @@ class WinAcls:
     def apply(self, path: str, sddl: str) -> bool:
         """Apply an SDDL from an archive.  The SDDL is UNTRUSTED input
         (a tampered archive must not execute PowerShell as the agent):
-        allowlist the SDDL grammar's charset, then single-quote-escape."""
+        parse it with the structured codec and apply the CANONICAL
+        re-emission — only grammar-valid SDDL ever reaches PowerShell.
+        Descriptors beyond the codec's grammar (object/conditional
+        ACEs) fall back to a strict charset allowlist."""
         if not sddl:
             return False
-        if not re.fullmatch(r"[A-Za-z0-9:;()\-_. ]+", sddl):
-            return False
+        from .secdesc import SecurityDescriptor
+        try:
+            sddl = SecurityDescriptor.from_sddl(sddl).to_sddl()
+        except (ValueError, struct.error):
+            if not re.fullmatch(r"[A-Za-z0-9:;()\-_. ]+", sddl):
+                return False
         script = (f"$a = Get-Acl -LiteralPath {_q(path)}; "
                   f"$a.SetSecurityDescriptorSddlForm({_q(sddl)}); "
                   f"Set-Acl -LiteralPath {_q(path)} -AclObject $a")
@@ -65,9 +74,30 @@ class WinAcls:
             return False
 
     def to_xattrs(self, path: str) -> dict[str, bytes]:
+        """Archive form: the SDDL string, plus the binary descriptor
+        when the SDDL round-trips through the structured codec (so
+        restores can use either; inspection tools get typed ACEs)."""
         sddl = self.capture(path)
-        return {SDDL_XATTR: sddl.encode()} if sddl else {}
+        if not sddl:
+            return {}
+        out = {SDDL_XATTR: sddl.encode()}
+        from .secdesc import SecurityDescriptor
+        try:
+            out[SD_XATTR] = SecurityDescriptor.from_sddl(sddl).to_bytes()
+        except (ValueError, struct.error):
+            pass                     # beyond codec grammar: SDDL only
+        return out
 
     def from_xattrs(self, path: str, xattrs: dict[str, bytes]) -> bool:
+        """Restore precedence: binary descriptor (rendered to canonical
+        SDDL by the codec) over the raw SDDL string."""
+        raw_sd = xattrs.get(SD_XATTR)
+        if raw_sd:
+            from .secdesc import SecurityDescriptor
+            try:
+                return self.apply(
+                    path, SecurityDescriptor.from_bytes(raw_sd).to_sddl())
+            except (ValueError, struct.error):
+                pass                 # corrupt binary: try the string
         raw = xattrs.get(SDDL_XATTR)
         return self.apply(path, raw.decode()) if raw else False

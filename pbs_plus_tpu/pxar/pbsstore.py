@@ -190,9 +190,18 @@ class _PBSHttp:
         if headers:
             hdrs.update(headers)
         if self._h2 is not None:
-            status, rhdrs, data = self._h2.request(
-                method, url, hdrs, body, authority=f"{self.host}:{self.port}",
-                scheme="https" if self.tls else "http")
+            try:
+                status, rhdrs, data = self._h2.request(
+                    method, url, hdrs, body,
+                    authority=f"{self.host}:{self.port}",
+                    scheme="https" if self.tls else "http")
+            except (ConnectionError, OSError):
+                # a mid-stream transport failure leaves the h2 session
+                # desynced; like the session-bound h1 path, drop it and
+                # surface the failure (the session cannot be re-dialed
+                # transparently — it holds server-side state)
+                self.close()
+                raise
             return status, data, rhdrs.get("content-type", "")
         # pre-session requests may retry once on a stale keepalive; once
         # the session is connection-bound a reconnect can never succeed

@@ -404,9 +404,17 @@ class H2ClientSession(_SessionBase):
                     if not self._recv_some():
                         raise H2Error("connection closed mid-stream")
                     self._flush_send()
+            except BaseException:
+                # a half-pumped stream leaves nghttp2's state unknowable
+                # — poison the session so the owner re-dials instead of
+                # stalling on deferred DATA for the aborted stream
+                self.close()
+                raise
             finally:
                 self._send_body.pop(sid, None)
-            st = self.streams.pop(sid)
+                st = self.streams.pop(sid, None)
+            if st is None:
+                raise H2Error("stream state lost")
             if st.error:
                 raise H2Error(f"stream error {st.error}")
             status = int(st.headers.get(":status", "0"))

@@ -142,12 +142,13 @@ def test_win_registry_roundtrip_and_sealed_secrets():
 # -- ACLs ------------------------------------------------------------------
 
 def test_win_acl_capture_apply_roundtrip():
-    from pbs_plus_tpu.agent.win.acls import SDDL_XATTR, WinAcls
+    from pbs_plus_tpu.agent.win.acls import SD_XATTR, SDDL_XATTR, WinAcls
     sddl = "O:BAG:SYD:(A;;FA;;;SY)(A;;FA;;;BA)"
     run = FakeRun(outputs={"Get-Acl": sddl + "\n"})
     a = WinAcls(run=run)
     x = a.to_xattrs(r"C:\f.txt")
-    assert x == {SDDL_XATTR: sddl.encode()}
+    assert x[SDDL_XATTR] == sddl.encode()
+    assert SD_XATTR in x        # structured binary SD rides along
     assert "-LiteralPath 'C:\\f.txt'" in run.calls[0][-1]
 
     run2 = FakeRun()
