@@ -11,8 +11,16 @@
 // /root/reference/internal/pxarmount/commit_orchestrate.go:144 — this is
 // our CPU-baseline equivalent, and the thing the TPU kernels must beat.
 
+// The hash at position i depends ONLY on bytes [i-63, i] (64-byte window,
+// position-local recurrence), so the scan parallelizes exactly: segment
+// workers seed from the 63 bytes preceding their segment and produce
+// bit-identical candidates to a sequential scan — the same halo
+// discipline as the TPU segment-parallel chunker (parallel/sp_chunker.py).
+
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 static inline uint32_t rotl1(uint32_t x) { return (x << 1) | (x >> 31); }
 
@@ -71,6 +79,75 @@ int64_t pbs_buzhash_candidates(
     }
   }
   return count;
+}
+
+// Multi-threaded scan: bit-identical to the sequential scan (the hash is
+// position-local), segments seeded with the 63 bytes preceding them.
+// `threads <= 0` → hardware concurrency.  Returns total candidates or -1
+// if any worker overflowed its share of `out_ends` (caller retries with a
+// bigger buffer, as with the single-threaded entry).
+int64_t pbs_buzhash_candidates_mt(
+    const uint8_t* data, int64_t n,
+    const uint8_t* prefix, int64_t prefix_len,
+    const uint32_t* table, uint32_t mask, uint32_t magic,
+    int64_t global_offset,
+    int64_t* out_ends, int64_t out_cap,
+    int threads) {
+  const int64_t W = 64;
+  const int64_t MIN_SEG = 1 << 20;
+  if (threads <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    threads = hc ? static_cast<int>(hc) : 1;
+  }
+  int64_t max_t = n / MIN_SEG;
+  if (max_t < static_cast<int64_t>(threads)) threads = static_cast<int>(max_t);
+  if (threads <= 1) {
+    return pbs_buzhash_candidates(data, n, prefix, prefix_len, table, mask,
+                                  magic, global_offset, out_ends, out_cap);
+  }
+  const int64_t seg = n / threads;
+  const int64_t cap_each = out_cap / threads;
+  if (cap_each <= 0) return -1;
+  std::vector<std::vector<int64_t>> outs(threads);
+  std::vector<int64_t> counts(threads, 0);
+  std::vector<std::thread> pool;
+  pool.reserve(threads);
+  for (int t = 0; t < threads; ++t) {
+    const int64_t a = t * seg;
+    const int64_t b = (t == threads - 1) ? n : a + seg;
+    outs[t].resize(cap_each);
+    pool.emplace_back([&, t, a, b]() {
+      const uint8_t* seg_prefix;
+      int64_t seg_prefix_len;
+      if (a == 0) {
+        seg_prefix = prefix;
+        seg_prefix_len = prefix_len;
+      } else {
+        // halo: the 63 bytes of stream immediately before data[a]
+        seg_prefix_len = a < (W - 1) ? a : (W - 1);
+        seg_prefix = data + a - seg_prefix_len;
+        // (if a < 63 the caller prefix would also matter, but MIN_SEG
+        // guarantees a >= 1 MiB for every non-first segment)
+      }
+      counts[t] = pbs_buzhash_candidates(
+          data + a, b - a, seg_prefix, seg_prefix_len, table, mask, magic,
+          global_offset + a, outs[t].data(), cap_each);
+    });
+  }
+  for (auto& th : pool) th.join();
+  int64_t total = 0;
+  for (int t = 0; t < threads; ++t) {
+    if (counts[t] < 0) return -1;
+    total += counts[t];
+  }
+  if (total > out_cap) return -1;
+  int64_t pos = 0;
+  for (int t = 0; t < threads; ++t) {
+    std::memcpy(out_ends + pos, outs[t].data(),
+                counts[t] * sizeof(int64_t));
+    pos += counts[t];
+  }
+  return total;
 }
 
 }  // extern "C"

@@ -26,11 +26,8 @@ from __future__ import annotations
 
 import re
 import subprocess
-from typing import Callable
 
-from .acls import WinAcls, _q
-
-Runner = Callable[..., "subprocess.CompletedProcess"]
+from .acls import Runner, WinAcls, _ps, _q
 
 ATTRS_XATTR = "win.attrs"
 ADS_PREFIX = "win.ads."
@@ -38,10 +35,11 @@ ADS_PREFIX = "win.ads."
 ATTR_TOKENS = ("READONLY", "HIDDEN", "SYSTEM", "ARCHIVE")
 _ADS_NAME_RE = re.compile(r"[A-Za-z0-9_. \-]{1,255}\Z")
 
-
-def _ps(script: str) -> list[str]:
-    return ["powershell", "-NoProfile", "-NonInteractive", "-Command",
-            script]
+# byte-mode flags differ between Windows PowerShell 5.1 (-Encoding Byte)
+# and pwsh 6+ (-AsByteStream); the script branches at runtime so either
+# host works (restore_windows.go has no such problem — it calls Win32)
+_BYTE_FLAG = ("$bf = if ($PSVersionTable.PSVersion.Major -ge 6) "
+              "{ @{AsByteStream=$true} } else { @{Encoding='Byte'} }; ")
 
 
 class WinMetaApplier:
@@ -109,10 +107,11 @@ class WinMetaApplier:
             try:
                 os.write(fd, data)
                 os.close(fd)
-                script = (f"Set-Content -LiteralPath "
-                          f"{_q(path + ':' + name)} -Value "
+                script = (_BYTE_FLAG +
+                          f"Set-Content -LiteralPath {_q(path)} "
+                          f"-Stream {_q(name)} -Value "
                           f"(Get-Content -LiteralPath {_q(tmp)} "
-                          f"-AsByteStream -Raw) -AsByteStream -Force")
+                          f"-Raw @bf) -Force @bf")
                 if self._sh(f"write ADS {name}", path, script):
                     n += 1
             finally:
@@ -174,8 +173,9 @@ class WinMetaCapture:
                 if not name or not _ADS_NAME_RE.fullmatch(name):
                     continue
                 rb = self._run(_ps(
+                    _BYTE_FLAG +
                     f"[Convert]::ToBase64String((Get-Content -LiteralPath "
-                    f"{_q(path + ':' + name)} -AsByteStream -Raw))"),
+                    f"{_q(path)} -Stream {_q(name)} -Raw @bf))"),
                     check=True, capture_output=True, text=True, timeout=60)
                 import base64
                 out[ADS_PREFIX + name] = base64.b64decode(

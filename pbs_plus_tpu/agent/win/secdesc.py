@@ -216,6 +216,11 @@ class SecurityDescriptor:
     control: int = SE_SELF_RELATIVE | SE_DACL_PRESENT
     dacl: list[Ace] = field(default_factory=list)
     sacl: list[Ace] = field(default_factory=list)
+    # NULL DACL ≠ empty DACL: NULL means "no access control" (everyone
+    # has full access); empty means "deny everyone".  SDDL spells the
+    # former D:NO_ACCESS_CONTROL; conflating them would lock users out
+    # of restored files that were legitimately wide open.
+    null_dacl: bool = False
 
     # -- binary ----------------------------------------------------------
     def to_bytes(self) -> bytes:
@@ -239,8 +244,9 @@ class SecurityDescriptor:
         put("group", sid_to_bytes(self.group) if self.group else b"")
         if control & SE_SACL_PRESENT:
             put("sacl", _acl_bytes(self.sacl))
-        if control & SE_DACL_PRESENT:
+        if control & SE_DACL_PRESENT and not self.null_dacl:
             put("dacl", _acl_bytes(self.dacl))
+        # null_dacl: DACL_PRESENT stays set with OffsetDacl == 0
         hdr = struct.pack("<BBHIIII", 1, 0, control, offs["owner"],
                           offs["group"], offs["sacl"], offs["dacl"])
         return hdr + b"".join(chunks)
@@ -258,8 +264,11 @@ class SecurityDescriptor:
             sd.owner, _ = sid_from_bytes(raw, o_own)
         if o_grp:
             sd.group, _ = sid_from_bytes(raw, o_grp)
-        if control & SE_DACL_PRESENT and o_dacl:
-            sd.dacl = _acl_parse(raw, o_dacl)
+        if control & SE_DACL_PRESENT:
+            if o_dacl:
+                sd.dacl = _acl_parse(raw, o_dacl)
+            else:
+                sd.null_dacl = True       # present-but-NULL: everyone
         if control & SE_SACL_PRESENT and o_sacl:
             sd.sacl = _acl_parse(raw, o_sacl)
         return sd
@@ -271,7 +280,7 @@ class SecurityDescriptor:
             out.append(f"O:{_sid_sddl(self.owner)}")
         if self.group:
             out.append(f"G:{_sid_sddl(self.group)}")
-        if self.control & SE_DACL_PRESENT or self.dacl:
+        if self.control & SE_DACL_PRESENT or self.dacl or self.null_dacl:
             flags = ""
             if self.control & SE_DACL_PROTECTED:
                 flags += "P"
@@ -279,8 +288,11 @@ class SecurityDescriptor:
                 flags += "AR"
             if self.control & SE_DACL_AUTO_INHERITED:
                 flags += "AI"
-            out.append("D:" + flags
-                       + "".join(a.to_sddl() for a in self.dacl))
+            if self.null_dacl:
+                out.append("D:NO_ACCESS_CONTROL")
+            else:
+                out.append("D:" + flags
+                           + "".join(a.to_sddl() for a in self.dacl))
         if self.control & SE_SACL_PRESENT or self.sacl:
             flags = ""
             if self.control & SE_SACL_PROTECTED:
@@ -307,6 +319,13 @@ class SecurityDescriptor:
             elif key == "G":
                 sd.group = _sid_unsddl(body)
             elif key in ("D", "S"):
+                if key == "D" and body.upper().startswith(
+                        "NO_ACCESS_CONTROL"):
+                    if body.upper() != "NO_ACCESS_CONTROL":
+                        raise ValueError("junk after NO_ACCESS_CONTROL")
+                    sd.control |= SE_DACL_PRESENT
+                    sd.null_dacl = True
+                    continue
                 flags, aces = _parse_acl_sddl(body)
                 ctl = 0
                 if "P" in flags:

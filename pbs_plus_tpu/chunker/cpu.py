@@ -45,7 +45,8 @@ def position_hashes(data: bytes | np.ndarray, params: ChunkerParams,
 
 def candidates(data: bytes | np.ndarray, params: ChunkerParams, *,
                prefix: bytes | np.ndarray = b"",
-               global_offset: int = 0, force_numpy: bool = False) -> np.ndarray:
+               global_offset: int = 0, force_numpy: bool = False,
+               threads: int | None = None) -> np.ndarray:
     """Sorted absolute candidate END offsets inside ``data``.
 
     ``prefix`` supplies up to W-1 bytes of preceding stream context;
@@ -56,6 +57,8 @@ def candidates(data: bytes | np.ndarray, params: ChunkerParams, *,
     Dispatches to the C++ native scanner when available (same spec,
     bit-identical — tests/test_chunker.py::test_native_matches_numpy);
     the numpy path is the always-available reference implementation.
+    ``threads``: forwarded to the native scan (None → auto segment-
+    parallel on big buffers, 1 → sequential single-core).
     """
     if len(prefix) > global_offset:
         # context cannot exceed real stream history; keep the bytes
@@ -67,7 +70,7 @@ def candidates(data: bytes | np.ndarray, params: ChunkerParams, *,
             return native.candidates(
                 data, params,  # ndarray passes through zero-copy
                 prefix=bytes(prefix[-(WINDOW - 1):]),
-                global_offset=global_offset)
+                global_offset=global_offset, threads=threads)
     plen = len(prefix)
     if plen >= WINDOW:
         prefix = prefix[-(WINDOW - 1):]

@@ -30,7 +30,8 @@ def _build() -> bool:
     tmp = f"{_SO}.tmp.{os.getpid()}"
     try:
         subprocess.run(
-            ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", tmp, _SRC],
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
+             "-o", tmp, _SRC],
             check=True, capture_output=True, timeout=120,
         )
         os.replace(tmp, _SO)  # atomic: interrupted builds never corrupt _SO
@@ -73,6 +74,13 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_int64,                    # global_offset
             ctypes.c_void_p, ctypes.c_int64,   # out_ends, out_cap
         ]
+        try:
+            mt = lib.pbs_buzhash_candidates_mt
+        except AttributeError:                 # stale pre-mt .so
+            mt = None
+        if mt is not None:
+            mt.restype = ctypes.c_int64
+            mt.argtypes = fn.argtypes + [ctypes.c_int]
         _lib = lib
         return _lib
 
@@ -81,27 +89,42 @@ def available() -> bool:
     return _load() is not None
 
 
+# buffers below this size aren't worth thread spawn overhead
+_MT_THRESHOLD = 4 << 20
+
+
 def candidates(data: bytes | np.ndarray, params: ChunkerParams, *,
-               prefix: bytes = b"", global_offset: int = 0) -> np.ndarray:
-    """Native equivalent of chunker.cpu.candidates (bit-identical)."""
+               prefix: bytes = b"", global_offset: int = 0,
+               threads: int | None = None) -> np.ndarray:
+    """Native equivalent of chunker.cpu.candidates (bit-identical).
+
+    ``threads``: None → auto (multi-threaded segment scan for buffers
+    ≥ 4 MiB — deterministic: the hash is position-local, segments seed
+    from a 63-byte halo); 1 → force the sequential scan (bench's
+    declared single-core baseline uses this)."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native chunker unavailable")
     arr = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else np.ascontiguousarray(data, dtype=np.uint8)
     pfx = np.frombuffer(prefix, dtype=np.uint8)
     table = np.ascontiguousarray(params.table, dtype=np.uint32)
+    mt = getattr(lib, "pbs_buzhash_candidates_mt", None)
+    if threads is None:
+        threads = 0 if (mt is not None and len(arr) >= _MT_THRESHOLD) else 1
     # expected candidate density ~ n/avg; size output with 8x headroom + slack
     cap = max(1024, 8 * (len(arr) // params.avg_size + 1) + 64)
     while True:
         out = np.empty(cap, dtype=np.int64)
-        n = lib.pbs_buzhash_candidates(
-            arr.ctypes.data, len(arr),
-            pfx.ctypes.data if len(pfx) else None, len(pfx),
-            table.ctypes.data,
-            ctypes.c_uint32(params.mask), ctypes.c_uint32(params.magic),
-            global_offset,
-            out.ctypes.data, cap,
-        )
+        args = [arr.ctypes.data, len(arr),
+                pfx.ctypes.data if len(pfx) else None, len(pfx),
+                table.ctypes.data,
+                ctypes.c_uint32(params.mask), ctypes.c_uint32(params.magic),
+                global_offset,
+                out.ctypes.data, cap]
+        if threads != 1 and mt is not None:
+            n = mt(*args, ctypes.c_int(threads))
+        else:
+            n = lib.pbs_buzhash_candidates(*args)
         if n >= 0:
             return out[:n].copy()
         cap *= 4

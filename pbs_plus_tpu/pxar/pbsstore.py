@@ -195,12 +195,16 @@ class _PBSHttp:
                     method, url, hdrs, body,
                     authority=f"{self.host}:{self.port}",
                     scheme="https" if self.tls else "http")
-            except (ConnectionError, OSError):
-                # a mid-stream transport failure leaves the h2 session
-                # desynced; like the session-bound h1 path, drop it and
-                # surface the failure (the session cannot be re-dialed
-                # transparently — it holds server-side state)
-                self.close()
+            except Exception as e:
+                from ..utils.h2lib import H2StreamError
+                if isinstance(e, H2StreamError):
+                    raise          # one stream failed; connection healthy
+                if isinstance(e, (ConnectionError, OSError)):
+                    # a mid-stream transport failure leaves the h2
+                    # session desynced; like the session-bound h1 path,
+                    # drop it and surface the failure (the session holds
+                    # server-side state and cannot be re-dialed)
+                    self.close()
                 raise
             return status, data, rhdrs.get("content-type", "")
         # pre-session requests may retry once on a stale keepalive; once

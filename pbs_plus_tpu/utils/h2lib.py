@@ -157,6 +157,11 @@ class H2Error(ConnectionError):
     pass
 
 
+class H2StreamError(H2Error):
+    """A single stream failed (e.g. RST_STREAM); the CONNECTION is still
+    healthy — callers should not tear the session down for this."""
+
+
 def read_h1_head(sock, initial: bytes = b"") -> tuple[str, dict, bytes]:
     """Read one HTTP/1.1 message head off ``sock``: returns
     ``(first_line, {lower-name: value}, leftover_bytes)``.  Shared by
@@ -416,7 +421,7 @@ class H2ClientSession(_SessionBase):
             if st is None:
                 raise H2Error("stream state lost")
             if st.error:
-                raise H2Error(f"stream error {st.error}")
+                raise H2StreamError(f"stream error {st.error}")
             status = int(st.headers.get(":status", "0"))
             return status, st.headers, bytes(st.body)
 

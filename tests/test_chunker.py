@@ -171,3 +171,27 @@ def test_select_cuts_streaming_equivalence():
     ch = CpuChunker(P)
     inc = ch.feed(data) + ch.finalize()
     assert inc == cuts
+
+
+@pytest.mark.skipif(not native.available(), reason="native chunker unavailable")
+def test_native_mt_bit_identical():
+    """Segment-parallel native scan is bit-identical to the sequential
+    scan (position-local hash + 63-byte halo), across thread counts,
+    prefixes, and offsets — the CPU twin of the sp_chunker guarantee."""
+    data = _data(9 << 20, seed=21)           # crosses the 4 MiB MT gate
+    seq = native.candidates(data, P, threads=1)
+    assert len(seq) > 0
+    for t in (0, 2, 3, 8):                   # 0 = auto
+        mt = native.candidates(data, P, threads=t)
+        assert np.array_equal(seq, mt), f"threads={t} diverged"
+    # with stream context and non-zero offset
+    split = 1_234_567
+    seq2 = native.candidates(data[split:], P, prefix=data[:split][-63:],
+                             global_offset=split, threads=1)
+    mt2 = native.candidates(data[split:], P, prefix=data[:split][-63:],
+                            global_offset=split, threads=4)
+    assert np.array_equal(seq2, mt2)
+    # small buffers silently take the sequential path
+    small = _data(100_000, seed=22)
+    assert np.array_equal(native.candidates(small, P, threads=0),
+                          native.candidates(small, P, threads=1))

@@ -75,10 +75,11 @@ def test_sddl_roundtrip_full_grammar():
         "O:BAG:SYD:(A;;FA;;;WD)",
         "O:BAG:BAD:P(A;OICI;FA;;;BA)(A;OICIID;FR;;;BU)(D;;FW;;;AN)",
         "D:(A;;0x1301bf;;;AU)",                    # hex rights
-        "O:S-1-5-21-1-2-3-512G:BU"                 # raw SID + no DACL
-        "D:(A;CI;GR;;;WD)",
+        "O:S-1-5-21-1-2-3-512G:BU",                # raw SID, no DACL
+        "O:S-1-5-21-1-2-3-512D:(A;CI;GR;;;WD)",    # raw SID + DACL
         "O:SYD:PAI(A;ID;FA;;;SY)S:(AU;SA;FA;;;WD)",  # SACL with audit
         "O:SYS:P(AU;FA;FA;;;BA)",                    # protected SACL
+        "O:BAD:NO_ACCESS_CONTROL",                   # NULL DACL
     ]
     for sddl in cases:
         sd = SecurityDescriptor.from_sddl(sddl)
@@ -87,10 +88,30 @@ def test_sddl_roundtrip_full_grammar():
         assert again.dacl == sd.dacl and again.sacl == sd.sacl, sddl
         # control flags (P/AR/AI on both ACLs) survive canonicalization
         assert again.control == sd.control, sddl
+        assert again.null_dacl == sd.null_dacl, sddl
         # binary round-trip preserves everything too
         back = SecurityDescriptor.from_bytes(sd.to_bytes())
         assert back.dacl == sd.dacl and back.sacl == sd.sacl, sddl
         assert back.control & ~0x8000 == sd.control & ~0x8000, sddl
+        assert back.null_dacl == sd.null_dacl, sddl
+
+
+def test_null_dacl_distinct_from_empty():
+    """NULL DACL (everyone full access) must never be rendered as an
+    empty DACL (deny everyone) — conflating them locks users out."""
+    null_sd = SecurityDescriptor.from_sddl("O:BAD:NO_ACCESS_CONTROL")
+    assert null_sd.null_dacl and null_sd.to_sddl().endswith(
+        "D:NO_ACCESS_CONTROL")
+    raw = null_sd.to_bytes()
+    _, _, control, _, _, _, o_dacl = struct.unpack_from("<BBHIIII", raw, 0)
+    assert control & SE_DACL_PRESENT and o_dacl == 0   # present-but-NULL
+    back = SecurityDescriptor.from_bytes(raw)
+    assert back.null_dacl and not back.dacl
+    empty = SecurityDescriptor.from_sddl("O:BAD:")
+    assert not empty.null_dacl and empty.dacl == []
+    assert "NO_ACCESS_CONTROL" not in empty.to_sddl()
+    with pytest.raises(ValueError):
+        SecurityDescriptor.from_sddl("D:NO_ACCESS_CONTROL(A;;FA;;;WD)")
 
 
 def test_sddl_structured_ace_surface():
