@@ -59,11 +59,18 @@ def _cpu_baseline(mib: int = 256) -> dict:
         s = e
     dt = time.perf_counter() - t0
     out = {"mib_s": mib / dt, "chunks": len(cuts), "seconds": dt}
+    # scan-vs-scan comparison (apples to apples: the full-loop mib_s above
+    # also includes select_cuts + sha256, so it cannot be the denominator
+    # for the MT-scan speedup)
+    t0 = time.perf_counter()
+    ends_st = candidates(data, params, threads=1)
+    dt_st = time.perf_counter() - t0
     t0 = time.perf_counter()
     ends_mt = candidates(data, params)               # auto multi-threaded
     dt_mt = time.perf_counter() - t0
-    if not np.array_equal(ends, ends_mt):
+    if not (np.array_equal(ends, ends_mt) and np.array_equal(ends, ends_st)):
         raise AssertionError("mt scan diverged from single-core scan")
+    out["scan_st_mib_s"] = mib / dt_st
     out["scan_mt_mib_s"] = mib / dt_mt
     import os as _os
     out["cores"] = _os.cpu_count()

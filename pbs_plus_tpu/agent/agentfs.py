@@ -47,6 +47,8 @@ def _entry_map(name: str, st: os.stat_result, link_target: str = "") -> dict:
         kind = "p"
     elif statmod.S_ISSOCK(m):
         kind = "s"
+    elif statmod.S_ISBLK(m):
+        kind = "b"
     else:
         kind = "c"
     return {

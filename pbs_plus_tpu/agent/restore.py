@@ -12,11 +12,12 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import os
+import stat as statmod
 from dataclasses import dataclass, field
 
 from ..pxar.format import (
-    Entry, KIND_DEVICE, KIND_DIR, KIND_FIFO, KIND_FILE, KIND_HARDLINK,
-    KIND_SOCKET, KIND_SYMLINK,
+    Entry, KIND_BLOCKDEV, KIND_DEVICE, KIND_DIR, KIND_FIFO, KIND_FILE,
+    KIND_HARDLINK, KIND_SOCKET, KIND_SYMLINK,
 )
 from ..pxar.remote import RemoteArchiveClient
 from ..utils.log import L
@@ -66,13 +67,20 @@ class RestoreEngine:
         os.makedirs(self.dest, exist_ok=True)
         self._dir_meta.append((self.dest, root))
         await self._restore_dir("")
-        # hardlinks after all targets exist
+        # hardlinks after all targets exist (follow_symlinks=False so a
+        # hardlink TO a symlink links the symlink itself, not its target)
         for link_rel, target_rel in self._hardlinks:
             try:
                 lp, tp = self._target(link_rel), self._target(target_rel)
-                if os.path.exists(lp):
+                if os.path.lexists(lp):
                     os.unlink(lp)
-                os.link(tp, lp)
+                try:
+                    os.link(tp, lp, follow_symlinks=False)
+                except NotImplementedError:
+                    # platform without the flag: plain link (follows a
+                    # symlink target — best effort); real OSErrors must
+                    # surface below, not silently change semantics
+                    os.link(tp, lp)
             except OSError as e:
                 self.result.errors.append(f"hardlink {link_rel}: {e}")
         # directory metadata deepest-first (mtimes would be clobbered by
@@ -109,20 +117,26 @@ class RestoreEngine:
             if os.path.lexists(path):
                 os.unlink(path)
             os.symlink(e.link_target, path)
-            if self.apply_ownership:
-                try:
-                    os.lchown(path, e.uid, e.gid)
-                except OSError:
-                    pass
+            self._apply_meta(path, e, symlink=True)
         elif e.kind == KIND_HARDLINK:
             self._hardlinks.append((rel, e.link_target))
         elif e.kind == KIND_FIFO:
-            if not os.path.exists(path):
+            if not os.path.lexists(path):
                 os.mkfifo(path, e.mode)
             self._apply_meta(path, e)
-        elif e.kind in (KIND_SOCKET, KIND_DEVICE):
-            # sockets are recreated by their owners; devices need root+mknod
-            pass
+        elif e.kind in (KIND_SOCKET, KIND_DEVICE, KIND_BLOCKDEV):
+            # recreate the node itself (rsync --specials/--devices parity);
+            # device nodes need CAP_MKNOD — record the failure, don't abort
+            ifmt = {KIND_SOCKET: statmod.S_IFSOCK,
+                    KIND_DEVICE: statmod.S_IFCHR,
+                    KIND_BLOCKDEV: statmod.S_IFBLK}[e.kind]
+            try:
+                if os.path.lexists(path):
+                    os.unlink(path)
+                os.mknod(path, ifmt | e.mode, e.rdev)
+                self._apply_meta(path, e)
+            except OSError as ex:
+                self.result.errors.append(f"{rel}: mknod: {ex}")
 
     async def _restore_file(self, rel: str, e: Entry, path: str) -> None:
         h = hashlib.sha256() if (self.verify and e.digest) else None
@@ -148,25 +162,30 @@ class RestoreEngine:
         self.result.files += 1
         self.result.bytes += e.size
 
-    def _apply_meta(self, path: str, e: Entry) -> None:
-        try:
-            os.chmod(path, e.mode, follow_symlinks=True)
-        except OSError:
-            pass
+    def _apply_meta(self, path: str, e: Entry, *, symlink: bool = False) -> None:
+        # chown BEFORE chmod: on Linux chown() clears setuid/setgid even for
+        # root, so the reverse order strips the bits off restored binaries
+        # (restore_unix.go applies ownership first for the same reason)
         if self.apply_ownership:
             try:
-                os.chown(path, e.uid, e.gid)
+                os.chown(path, e.uid, e.gid, follow_symlinks=not symlink)
+            except OSError:
+                pass
+        if not symlink:       # symlink modes are ignored on Linux (no lchmod)
+            try:
+                os.chmod(path, e.mode, follow_symlinks=True)
             except OSError:
                 pass
         for name, value in e.xattrs.items():
             if name.startswith("win."):
                 continue        # Windows metadata is applied below
             try:
-                os.setxattr(path, name, value)
+                os.setxattr(path, name, value, follow_symlinks=not symlink)
             except OSError:
                 pass
         try:
-            os.utime(path, ns=(e.mtime_ns, e.mtime_ns))
+            os.utime(path, ns=(e.mtime_ns, e.mtime_ns),
+                     follow_symlinks=not symlink)
         except OSError:
             pass
         if self.win_meta is not None and any(

@@ -289,7 +289,10 @@ class SecurityDescriptor:
             if self.control & SE_DACL_AUTO_INHERITED:
                 flags += "AI"
             if self.null_dacl:
-                out.append("D:NO_ACCESS_CONTROL")
+                # keep P/AR/AI: D:PNO_ACCESS_CONTROL is valid SDDL and
+                # dropping SE_DACL_PROTECTED would change semantics on a
+                # round-trip
+                out.append(f"D:{flags}NO_ACCESS_CONTROL")
             else:
                 out.append("D:" + flags
                            + "".join(a.to_sddl() for a in self.dacl))
@@ -319,14 +322,24 @@ class SecurityDescriptor:
             elif key == "G":
                 sd.group = _sid_unsddl(body)
             elif key in ("D", "S"):
-                if key == "D" and body.upper().startswith(
-                        "NO_ACCESS_CONTROL"):
-                    if body.upper() != "NO_ACCESS_CONTROL":
-                        raise ValueError("junk after NO_ACCESS_CONTROL")
+                null_dacl = False
+                if key == "D" and body.upper().endswith("NO_ACCESS_CONTROL"):
+                    # ACL control flags may precede the token (D:P...)
+                    body = body[:-len("NO_ACCESS_CONTROL")]
+                    null_dacl = True
+                flags, aces = _parse_acl_sddl(body)
+                if null_dacl:
+                    if aces:
+                        raise ValueError("ACEs with NO_ACCESS_CONTROL")
                     sd.control |= SE_DACL_PRESENT
+                    if "P" in flags:
+                        sd.control |= SE_DACL_PROTECTED
+                    if "AR" in flags:
+                        sd.control |= SE_DACL_AUTO_INHERIT_REQ
+                    if "AI" in flags:
+                        sd.control |= SE_DACL_AUTO_INHERITED
                     sd.null_dacl = True
                     continue
-                flags, aces = _parse_acl_sddl(body)
                 ctl = 0
                 if "P" in flags:
                     ctl |= SE_DACL_PROTECTED if key == "D" \

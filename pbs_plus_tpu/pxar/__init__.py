@@ -17,7 +17,7 @@ split pxar (.mpxar.didx/.ppxar.didx), clean-room layout.
 
 from .format import (
     Entry, KIND_FILE, KIND_DIR, KIND_SYMLINK, KIND_HARDLINK, KIND_FIFO,
-    KIND_SOCKET, KIND_DEVICE, entry_from_stat,
+    KIND_SOCKET, KIND_DEVICE, KIND_BLOCKDEV, entry_from_stat,
 )
 from .datastore import ChunkStore, DynamicIndex, Datastore, SnapshotRef
 from .transfer import SessionWriter, SplitReader, DedupWriter
@@ -25,7 +25,8 @@ from .backupproxy import LocalStore, BackupSession, PreviousBackupRef
 
 __all__ = [
     "Entry", "KIND_FILE", "KIND_DIR", "KIND_SYMLINK", "KIND_HARDLINK",
-    "KIND_FIFO", "KIND_SOCKET", "KIND_DEVICE", "entry_from_stat",
+    "KIND_FIFO", "KIND_SOCKET", "KIND_DEVICE", "KIND_BLOCKDEV",
+    "entry_from_stat",
     "ChunkStore", "DynamicIndex", "Datastore", "SnapshotRef",
     "SessionWriter", "SplitReader", "DedupWriter",
     "LocalStore", "BackupSession", "PreviousBackupRef",

@@ -29,7 +29,9 @@ KIND_SYMLINK = "l"
 KIND_HARDLINK = "h"
 KIND_FIFO = "p"
 KIND_SOCKET = "s"
-KIND_DEVICE = "c"
+KIND_DEVICE = "c"          # character device
+KIND_BLOCKDEV = "b"        # block device (same Entry shape; rdev carries
+                           # the device number for both)
 
 _LEN = struct.Struct("<I")
 MAX_ENTRY_SIZE = 16 << 20  # sanity cap for one metadata record
@@ -155,8 +157,10 @@ def entry_from_stat(path: str, st: os.stat_result, *,
         kind = KIND_FIFO
     elif statmod.S_ISSOCK(m):
         kind = KIND_SOCKET
-    elif statmod.S_ISCHR(m) or statmod.S_ISBLK(m):
+    elif statmod.S_ISCHR(m):
         kind = KIND_DEVICE
+    elif statmod.S_ISBLK(m):
+        kind = KIND_BLOCKDEV
     else:
         kind = KIND_FILE
     return Entry(
@@ -164,5 +168,5 @@ def entry_from_stat(path: str, st: os.stat_result, *,
         uid=st.st_uid, gid=st.st_gid, mtime_ns=st.st_mtime_ns,
         size=st.st_size if kind == KIND_FILE else 0,
         link_target=link_target,
-        rdev=st.st_rdev if kind == KIND_DEVICE else 0,
+        rdev=st.st_rdev if kind in (KIND_DEVICE, KIND_BLOCKDEV) else 0,
     )

@@ -35,7 +35,9 @@ def iter_tree(root: str, *, exclude: ExcludeFn | None = None,
     root_dev = st_root.st_dev
     seen_inodes: dict[tuple[int, int], str] = {}
 
-    yield entry_from_stat("", st_root), None
+    root_entry = entry_from_stat("", st_root)
+    root_entry.xattrs = read_xattrs(root)
+    yield root_entry, None
 
     def walk(dir_abs: str, dir_rel: str) -> Iterator[tuple[Entry, str | None]]:
         try:
@@ -85,8 +87,10 @@ def iter_tree(root: str, *, exclude: ExcludeFn | None = None,
                     e.xattrs = read_xattrs(abs_p)
                     yield e, abs_p
             else:
-                # fifo / socket / device — metadata only
-                yield entry_from_stat(rel_p, st), None
+                # fifo / socket / char+block device — metadata only
+                e = entry_from_stat(rel_p, st)
+                e.xattrs = read_xattrs(abs_p)
+                yield e, None
 
     yield from walk(root, "")
 

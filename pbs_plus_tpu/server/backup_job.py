@@ -37,8 +37,8 @@ from ..arpc.agents_manager import AgentsManager
 from ..chunker import ChunkerParams, CpuChunker
 from ..pxar.backupproxy import BackupSession, LocalStore
 from ..pxar.format import (
-    Entry, KIND_DEVICE, KIND_DIR, KIND_FIFO, KIND_FILE, KIND_HARDLINK,
-    KIND_SOCKET, KIND_SYMLINK,
+    Entry, KIND_BLOCKDEV, KIND_DEVICE, KIND_DIR, KIND_FIFO, KIND_FILE,
+    KIND_HARDLINK, KIND_SOCKET, KIND_SYMLINK,
 )
 from ..utils.log import L
 from . import database
@@ -282,7 +282,8 @@ class RemoteTreeBackup:
                     if m.get("nlink", 1) > 1:
                         seen_inodes[key] = child
                     await self._stream_file(child, e)
-            elif kind in (KIND_SYMLINK, KIND_FIFO, KIND_SOCKET, KIND_DEVICE):
+            elif kind in (KIND_SYMLINK, KIND_FIFO, KIND_SOCKET, KIND_DEVICE,
+                          KIND_BLOCKDEV):
                 await self._put(("entry", e, None))
             self.result.entries += 1
 

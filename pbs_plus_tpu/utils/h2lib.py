@@ -149,6 +149,8 @@ if _lib is not None:
         ctypes.c_size_t, ctypes.POINTER(_DataProvider)]
     _lib.nghttp2_submit_window_update.argtypes = [
         ctypes.c_void_p, ctypes.c_uint8, ctypes.c_int32, ctypes.c_int32]
+    _lib.nghttp2_submit_rst_stream.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint8, ctypes.c_int32, ctypes.c_uint32]
     _lib.nghttp2_strerror.restype = ctypes.c_char_p
     _lib.nghttp2_strerror.argtypes = [ctypes.c_int]
 
@@ -160,6 +162,16 @@ class H2Error(ConnectionError):
 class H2StreamError(H2Error):
     """A single stream failed (e.g. RST_STREAM); the CONNECTION is still
     healthy — callers should not tear the session down for this."""
+
+
+class H2ResetStream(Exception):
+    """Raised by a server handler to RST_STREAM the current request
+    instead of answering it (the client sees H2StreamError while the
+    connection stays up).  ``error_code`` is the h2 error code sent."""
+
+    def __init__(self, error_code: int = 0x2):       # INTERNAL_ERROR
+        super().__init__(f"reset stream (error {error_code})")
+        self.error_code = error_code
 
 
 def read_h1_head(sock, initial: bytes = b"") -> tuple[str, dict, bytes]:
@@ -469,6 +481,12 @@ class H2ServerSession(_SessionBase):
         try:
             status, hdrs, body = self.handler(method, path, plain,
                                               bytes(st.body))
+        except H2ResetStream as rst:    # per-stream failure, session lives
+            rv = _lib.nghttp2_submit_rst_stream(
+                self._session, 0, sid, rst.error_code)
+            if rv:
+                raise H2Error(f"submit_rst_stream: {_err(rv)}")
+            return
         except Exception as e:      # handler crash → 500, keep serving
             status, hdrs, body = 500, {"content-type": "text/plain"}, \
                 str(e).encode()

@@ -416,6 +416,8 @@ class H2UpgradeBridge:
         self._stop = threading.Event()
         self._H2ServerSession = H2ServerSession
         self.upgrades = 0                    # 101s handed out (test probe)
+        self.reset_once: set[str] = set()    # paths to RST_STREAM one time
+        self.resets = 0                      # streams actually reset
         self.thread = threading.Thread(target=self._accept_loop, daemon=True)
         self.thread.start()
 
@@ -470,6 +472,14 @@ class H2UpgradeBridge:
             self.upgrades += 1
 
             def handler(m, p, hdrs, data):
+                bare = p.split("?", 1)[0]
+                hit = next((t for t in self.reset_once
+                            if bare.endswith(t)), None)
+                if hit is not None:
+                    from pbs_plus_tpu.utils.h2lib import H2ResetStream
+                    self.reset_once.discard(hit)
+                    self.resets += 1
+                    raise H2ResetStream()
                 up_h = {"Authorization": hdrs.get("authorization", "")}
                 if "content-type" in hdrs:
                     up_h["Content-Type"] = hdrs["content-type"]

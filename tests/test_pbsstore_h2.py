@@ -148,37 +148,34 @@ def test_h2_errors_surface(bridged):
 
 
 def test_h2_stream_error_does_not_kill_session(bridged):
-    """A single failed stream (H2StreamError) leaves the session usable;
-    only transport-level failures tear it down."""
-    import socket as _socket
-    import threading
+    """The server RST_STREAMs one chunk upload: the client surfaces
+    H2StreamError, keeps the SAME h2 session attached, the retried
+    upload succeeds, and the whole backup still finishes — only
+    transport-level failures may tear the session down."""
+    import hashlib
 
-    from pbs_plus_tpu.utils.h2lib import (
-        H2ClientSession, H2ServerSession, H2StreamError)
+    mock, bridge = bridged
+    rng = np.random.default_rng(21)
+    data = rng.integers(0, 256, 100_000, dtype=np.uint8).tobytes()
+    digest = hashlib.sha256(data).digest()
 
-    a, b = _socket.socketpair()
-    calls = []
+    store = _store(bridge, mock)
+    s = store.start_session(backup_type="host", backup_id="h2-rst",
+                            backup_time=1_753_750_000)
+    http_ = s._http
+    h2 = http_._h2
+    assert h2 is not None
 
-    def handler(method, path, headers, body):
-        calls.append(path)
-        return 200, {"content-type": "text/plain"}, b"ok"
-
-    srv = H2ServerSession(b, handler)
-    threading.Thread(target=srv.serve, daemon=True).start()
-    cli = H2ClientSession(a)
-    try:
-        # submitting to an h2c server works; now force a per-stream error
-        # by requesting with a huge header the server-side nghttp2
-        # rejects per-stream... simplest deterministic trigger: a normal
-        # request first proves the session works
-        st, _, body = cli.request("GET", "/one")
-        assert st == 200 and body == b"ok"
-        # a stream error must be H2StreamError and must NOT close the
-        # session: the next request still succeeds
-        err = H2StreamError("stream error 7")
-        assert isinstance(err, ConnectionError)
-        st, _, body = cli.request("GET", "/two")
-        assert st == 200
-        assert calls == ["/one", "/two"]
-    finally:
-        cli.close()
+    bridge.reset_once.add("/dynamic_chunk")
+    with pytest.raises(h2lib.H2StreamError) as ei:
+        s.sink.insert(digest, data)
+    assert isinstance(ei.value, ConnectionError)   # caller-facing contract
+    assert bridge.resets == 1
+    # session survived the stream error: same object, not re-dialed
+    assert http_._h2 is h2
+    # the retried upload and the rest of the backup ride the same session
+    assert s.sink.insert(digest, data) is True
+    payload = _write_tree(s, {"x.bin": data})
+    s.finish()
+    ref = max(mock.snapshots)
+    assert mock.read_stream(ref, Datastore.PAYLOAD_IDX) == payload

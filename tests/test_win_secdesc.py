@@ -112,6 +112,23 @@ def test_null_dacl_distinct_from_empty():
     assert "NO_ACCESS_CONTROL" not in empty.to_sddl()
     with pytest.raises(ValueError):
         SecurityDescriptor.from_sddl("D:NO_ACCESS_CONTROL(A;;FA;;;WD)")
+    with pytest.raises(ValueError):
+        SecurityDescriptor.from_sddl("D:P(A;;FA;;;WD)NO_ACCESS_CONTROL")
+
+
+def test_protected_null_dacl_keeps_control_flags():
+    """Windows emits D:PNO_ACCESS_CONTROL for a protected NULL DACL;
+    the P (and AR/AI) control flags must survive both the parse and the
+    re-render, or a round-trip silently drops SE_DACL_PROTECTED."""
+    sd = SecurityDescriptor.from_sddl("O:BAD:PNO_ACCESS_CONTROL")
+    assert sd.null_dacl
+    assert sd.control & SE_DACL_PROTECTED
+    assert sd.to_sddl().endswith("D:PNO_ACCESS_CONTROL")
+    back = SecurityDescriptor.from_bytes(sd.to_bytes())
+    assert back.null_dacl and back.control & SE_DACL_PROTECTED
+    assert back.to_sddl().endswith("D:PNO_ACCESS_CONTROL")
+    ai = SecurityDescriptor.from_sddl("D:ARAINO_ACCESS_CONTROL")
+    assert ai.null_dacl and "ARAI" in ai.to_sddl()
 
 
 def test_sddl_structured_ace_surface():
