@@ -11,7 +11,8 @@ hot loop, SURVEY §3.2):
 
     agentfs.stat_fs   {}                          → {total, free, files}
     agentfs.attr      {path}                      → entry map
-    agentfs.read_dir  {path}                      → {entries: [entry map]}
+    agentfs.read_dir  {path, start?, max?}        → {entries: [entry map],
+                                                     next?: name token}
     agentfs.read_link {path}                      → {target}
     agentfs.xattrs    {path}                      → {xattrs: {name: bytes}}
     agentfs.open      {path}                      → {handle}
@@ -22,6 +23,7 @@ hot loop, SURVEY §3.2):
 
 from __future__ import annotations
 
+import bisect
 import os
 import stat as statmod
 from typing import Any
@@ -33,6 +35,11 @@ from ..pxar.format import read_xattrs
 from ..utils.log import L
 
 MAX_READ = 32 << 20
+MAX_HANDLES = 512          # open-fd ceiling per snapshot session: a leaky
+                           # or compromised server must not exhaust the
+                           # agent's fd table
+READDIR_PAGE = 4096        # entries per read_dir response; larger dirs
+                           # page via the `start` continuation token
 
 
 def _entry_map(name: str, st: os.stat_result, link_target: str = "") -> dict:
@@ -66,6 +73,7 @@ class AgentFSServer:
 
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
+        self._realroot = os.path.realpath(self.root)
         self._handles: dict[int, Any] = {}
         self._next_handle = 1
         self.stats = {"reads": 0, "bytes": 0, "opens": 0}
@@ -117,6 +125,14 @@ class AgentFSServer:
             raise HandlerError("not a directory", status=400)
         except OSError as e:
             raise HandlerError(f"listdir: {e}", status=404)
+        # paging: resume strictly after the `start` name so one response
+        # never has to carry a 100k-entry directory (the continuation is
+        # a name, not an index — stable under concurrent unlinks)
+        start = req.payload.get("start", "")
+        if start:
+            names = names[bisect.bisect_right(names, start):]
+        page = min(int(req.payload.get("max", READDIR_PAGE)), READDIR_PAGE)
+        names, more = names[:page], len(names) > page
         entries = []
         for name in names:
             try:
@@ -137,7 +153,10 @@ class AgentFSServer:
                 if x:
                     e["xattrs"] = x
             entries.append(e)
-        return {"entries": entries}
+        out = {"entries": entries}
+        if more and names:
+            out["next"] = names[-1]
+        return out
 
     async def _read_link(self, req, ctx):
         p = self._resolve(req.payload["path"])
@@ -152,10 +171,38 @@ class AgentFSServer:
 
     async def _open(self, req, ctx):
         p = self._resolve(req.payload["path"])
+        if len(self._handles) >= MAX_HANDLES:
+            raise HandlerError(
+                f"too many open handles ({MAX_HANDLES})", status=429)
+        # O_NONBLOCK: an open() on a fifo blocks until a writer appears —
+        # a raced or hostile path must not hang the agent's event loop
         try:
-            f = open(p, "rb", buffering=0)
+            fd = os.open(p, os.O_RDONLY | getattr(os, "O_NONBLOCK", 0))
         except OSError as e:
             raise HandlerError(f"open: {e}", status=404)
+        try:
+            st = os.fstat(fd)
+            if not statmod.S_ISREG(st.st_mode):
+                raise HandlerError("not a regular file", status=400)
+            # containment is checked on the OPENED fd (not a pre-open
+            # realpath, which a concurrent rename could invalidate): an
+            # in-tree symlink pointing outside the snapshot root must not
+            # hand the peer arbitrary agent files.  /proc/self/fd gives
+            # the fully-resolved path of what was actually opened.
+            proc = f"/proc/self/fd/{fd}"
+            rp = os.path.realpath(proc) if os.path.exists(proc) \
+                else os.path.realpath(p)
+            if rp != self._realroot and \
+                    not rp.startswith(self._realroot + os.sep):
+                raise HandlerError(f"symlink escapes root: "
+                                   f"{req.payload['path']!r}", status=400)
+            f = os.fdopen(fd, "rb", buffering=0)
+        except HandlerError:
+            os.close(fd)
+            raise
+        except OSError as e:
+            os.close(fd)
+            raise HandlerError(f"open: {e}", status=400)
         h = self._next_handle
         self._next_handle += 1
         self._handles[h] = f
@@ -227,7 +274,17 @@ class AgentFSClient:
         return (await self.s.call("agentfs.attr", {"path": path})).data
 
     async def read_dir(self, path: str) -> list[dict]:
-        return (await self.s.call("agentfs.read_dir", {"path": path})).data["entries"]
+        entries: list[dict] = []
+        start = ""
+        while True:
+            payload = {"path": path}
+            if start:
+                payload["start"] = start
+            d = (await self.s.call("agentfs.read_dir", payload)).data
+            entries.extend(d["entries"])
+            start = d.get("next", "")
+            if not start:
+                return entries
 
     async def read_link(self, path: str) -> str:
         return (await self.s.call("agentfs.read_link", {"path": path})).data["target"]

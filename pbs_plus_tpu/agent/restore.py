@@ -108,6 +108,11 @@ class RestoreEngine:
     async def _restore_entry(self, rel: str, e: Entry) -> None:
         path = self._target(rel)
         if e.kind == KIND_DIR:
+            # conflicting non-dir (incl. a symlink TO a dir — keeping it
+            # would alias writes outside this subtree) is replaced
+            if os.path.lexists(path) and (
+                    os.path.islink(path) or not os.path.isdir(path)):
+                os.unlink(path)
             os.makedirs(path, exist_ok=True)
             self._dir_meta.append((path, e))
             await self._restore_dir(rel)
@@ -121,8 +126,10 @@ class RestoreEngine:
         elif e.kind == KIND_HARDLINK:
             self._hardlinks.append((rel, e.link_target))
         elif e.kind == KIND_FIFO:
-            if not os.path.lexists(path):
-                os.mkfifo(path, e.mode)
+            if os.path.lexists(path):
+                os.unlink(path)       # conflicting node: replace, like
+                                      # every other kind branch
+            os.mkfifo(path, e.mode)
             self._apply_meta(path, e)
         elif e.kind in (KIND_SOCKET, KIND_DEVICE, KIND_BLOCKDEV):
             # recreate the node itself (rsync --specials/--devices parity);

@@ -31,7 +31,11 @@ KIND_FIFO = "p"
 KIND_SOCKET = "s"
 KIND_DEVICE = "c"          # character device
 KIND_BLOCKDEV = "b"        # block device (same Entry shape; rdev carries
-                           # the device number for both)
+                           # the device number for both).  Format history:
+                           # before "b" existed, block devices were encoded
+                           # as "c" and never recreated on restore; the
+                           # tpxar format has no released archives, so no
+                           # version guard is needed for that era
 
 _LEN = struct.Struct("<I")
 MAX_ENTRY_SIZE = 16 << 20  # sanity cap for one metadata record

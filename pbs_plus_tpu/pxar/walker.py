@@ -60,6 +60,18 @@ def iter_tree(root: str, *, exclude: ExcludeFn | None = None,
             if one_file_system and st.st_dev != root_dev:
                 continue
             if statmod.S_ISLNK(st.st_mode):
+                # multiply-linked symlinks are hardlink entries too (rsync
+                # -H parity): the restore side links the symlink node
+                # itself via link(follow_symlinks=False)
+                key = (st.st_dev, st.st_ino)
+                if st.st_nlink > 1 and key in seen_inodes:
+                    e = entry_from_stat(rel_p, st)
+                    e.kind = KIND_HARDLINK
+                    e.link_target = seen_inodes[key]
+                    yield e, None
+                    continue
+                if st.st_nlink > 1:
+                    seen_inodes[key] = rel_p
                 try:
                     target = os.readlink(abs_p)
                 except OSError as e:

@@ -1,0 +1,268 @@
+"""agentfs deep battery: the remote-FS protocol the agent serves during a
+backup, driven over real TLS loopback sessions.
+
+Reference: internal/agent/agentfs/agentfs_test.go (1087 LoC — readdir at
+scale, handle lifecycle/limits, concurrent reads, error surfaces, seek
+semantics).  Scenarios here mirror that battery on the Linux surface:
+paged readdir over a 10k-entry directory, the open-handle ceiling, sparse
+SEEK_DATA/SEEK_HOLE, symlink-escape containment, concurrent ranged reads,
+and raced-unlink robustness.
+"""
+
+import asyncio
+import os
+import socket as socketmod
+import stat
+
+import pytest
+
+from pbs_plus_tpu.agent.agentfs import (
+    MAX_HANDLES, READDIR_PAGE, AgentFSClient, AgentFSServer,
+)
+from pbs_plus_tpu.arpc import (
+    Router, Session, TlsClientConfig, TlsServerConfig, connect_to_server,
+    serve,
+)
+from pbs_plus_tpu.arpc.call import CallError
+from pbs_plus_tpu.utils import mtls
+
+
+@pytest.fixture(scope="module")
+def pki(tmp_path_factory):
+    d = tmp_path_factory.mktemp("pki")
+    cm = mtls.CertManager(str(d))
+    cm.load_or_create_ca()
+    cm.ensure_server_identity("server.test")
+    cert, key = cm.issue("agent-fs")
+    cp, kp = str(d / "agent.pem"), str(d / "agent.key")
+    open(cp, "wb").write(cert)
+    open(kp, "wb").write(key)
+    return {"ca": cm.ca_cert_path, "server_cert": cm.server_cert_path,
+            "server_key": cm.server_key_path, "client": (cp, kp)}
+
+
+class Harness:
+    """One agentfs server on a snapshot root + one connected client."""
+
+    def __init__(self, pki, root):
+        self.pki = pki
+        self.root = root
+        self.fs = AgentFSServer(str(root))
+
+    async def __aenter__(self):
+        router = Router()
+        self.fs.register(router)
+
+        async def on_conn(conn, peer, headers):
+            await router.serve_connection(conn)
+
+        tls = TlsServerConfig(self.pki["server_cert"],
+                              self.pki["server_key"], self.pki["ca"])
+        self.srv = await serve("127.0.0.1", 0, tls, on_connection=on_conn)
+        port = self.srv.sockets[0].getsockname()[1]
+        cp, kp = self.pki["client"]
+        self.conn = await connect_to_server(
+            "127.0.0.1", port, TlsClientConfig(cp, kp, self.pki["ca"]))
+        return AgentFSClient(Session(self.conn))
+
+    async def __aexit__(self, *exc):
+        await self.conn.close()
+        self.srv.close()
+        await self.srv.wait_closed()
+        self.fs.close_all()
+
+
+def test_readdir_pages_large_directory(pki, tmp_path):
+    """10k entries arrive complete and sorted through >2 pages, and the
+    continuation token survives a concurrent unlink of the token entry."""
+    big = tmp_path / "big"
+    big.mkdir()
+    names = [f"f{i:05d}" for i in range(10_000)]
+    for n in names:
+        (big / n).write_bytes(b"")
+
+    async def main():
+        async with Harness(pki, tmp_path) as c:
+            got = await c.read_dir("big")
+            assert [e["name"] for e in got] == names
+            # raw page surface: first page caps at READDIR_PAGE and
+            # carries a continuation
+            d = (await c.s.call("agentfs.read_dir", {"path": "big"})).data
+            assert len(d["entries"]) == READDIR_PAGE
+            assert d["next"] == names[READDIR_PAGE - 1]
+            # resuming after a now-deleted token entry must not skip or
+            # duplicate surviving names (token is a name, not an index)
+            os.unlink(big / d["next"])
+            d2 = (await c.s.call(
+                "agentfs.read_dir",
+                {"path": "big", "start": d["next"]})).data
+            assert d2["entries"][0]["name"] == names[READDIR_PAGE]
+            # client-side max is clamped server-side
+            d3 = (await c.s.call(
+                "agentfs.read_dir",
+                {"path": "big", "max": 10 * READDIR_PAGE})).data
+            assert len(d3["entries"]) == READDIR_PAGE
+    asyncio.run(main())
+
+
+def test_handle_lifecycle_and_ceiling(pki, tmp_path):
+    (tmp_path / "x").write_bytes(b"payload")
+
+    async def main():
+        async with Harness(pki, tmp_path) as c:
+            handles = [await c.open("x") for _ in range(MAX_HANDLES)]
+            with pytest.raises(CallError) as ei:
+                await c.open("x")
+            assert ei.value.response.status == 429
+            # closing one frees a slot
+            await c.close(handles.pop())
+            h = await c.open("x")
+            assert await c.read_at(h, 0, 7) == b"payload"
+            # double-close is idempotent; stale handle read is a clean 400
+            await c.close(h)
+            await c.close(h)
+            with pytest.raises(CallError) as ei:
+                await c.read_at(h, 0, 1)
+            assert ei.value.response.status == 400
+            for hh in handles:
+                await c.close(hh)
+    asyncio.run(main())
+
+
+def test_symlink_escape_refused_in_tree_allowed(pki, tmp_path):
+    """open() must follow symlinks only within the snapshot root."""
+    snap = tmp_path / "snap"
+    snap.mkdir()
+    (snap / "real.txt").write_bytes(b"inside")
+    os.symlink("real.txt", snap / "ok-link")
+    outside = tmp_path / "secret.txt"
+    outside.write_bytes(b"outside")
+    os.symlink(str(outside), snap / "evil-abs")
+    os.symlink("../secret.txt", snap / "evil-rel")
+
+    async def main():
+        async with Harness(pki, snap) as c:
+            h = await c.open("ok-link")
+            assert await c.read_at(h, 0, 6) == b"inside"
+            await c.close(h)
+            for bad in ("evil-abs", "evil-rel", "../secret.txt"):
+                with pytest.raises(CallError) as ei:
+                    await c.open(bad)
+                assert ei.value.response.status == 400, bad
+    asyncio.run(main())
+
+
+def test_sparse_seek_data_hole(pki, tmp_path):
+    """SEEK_DATA/SEEK_HOLE pass through so the server can skip holes the
+    way the reference's lseek surface does."""
+    p = tmp_path / "sparse.bin"
+    with open(p, "wb") as f:
+        f.write(b"A" * 4096)
+        f.seek(1 << 20)
+        f.write(b"B" * 4096)
+
+    async def main():
+        async with Harness(pki, tmp_path) as c:
+            h = await c.open("sparse.bin")
+            r = (await c.s.call("agentfs.lseek",
+                                {"handle": h, "off": 0,
+                                 "whence": os.SEEK_DATA})).data
+            assert r["pos"] == 0
+            try:
+                r = (await c.s.call("agentfs.lseek",
+                                    {"handle": h, "off": 0,
+                                     "whence": os.SEEK_HOLE})).data
+            except CallError:
+                return              # fs without hole support: clean error
+            # hole starts at or after the first data extent
+            assert 4096 <= r["pos"] <= (1 << 20)
+            await c.close(h)
+    asyncio.run(main())
+
+
+def test_concurrent_ranged_reads_one_handle(pki, tmp_path):
+    """50 concurrent pread slices over one handle: offsets never bleed
+    (pread is stateless) and every slice is bit-exact."""
+    data = os.urandom(1 << 20)
+    (tmp_path / "blob").write_bytes(data)
+
+    async def main():
+        async with Harness(pki, tmp_path) as c:
+            h = await c.open("blob")
+            offs = [(i * 37_321) % (len(data) - 8192) for i in range(50)]
+
+            async def slice_(off):
+                return off, await c.read_at(h, off, 8192)
+
+            for off, got in await asyncio.gather(*map(slice_, offs)):
+                assert got == data[off:off + 8192], off
+            await c.close(h)
+    asyncio.run(main())
+
+
+def test_open_fifo_refused_not_hung(pki, tmp_path):
+    """open() on a fifo must return a clean 400 instead of blocking the
+    agent event loop waiting for a writer (O_NONBLOCK + fstat gate)."""
+    os.mkfifo(tmp_path / "pipe")
+    (tmp_path / "dir").mkdir()
+
+    async def main():
+        async with Harness(pki, tmp_path) as c:
+            for special in ("pipe", "dir"):
+                with pytest.raises(CallError) as ei:
+                    await asyncio.wait_for(c.open(special), timeout=5)
+                assert ei.value.response.status in (400, 404), special
+    asyncio.run(main())
+
+
+def test_attr_and_error_surfaces(pki, tmp_path):
+    (tmp_path / "f").write_bytes(b"x" * 123)
+    os.mkfifo(tmp_path / "pipe")
+    os.symlink("f", tmp_path / "lnk")
+
+    async def main():
+        async with Harness(pki, tmp_path) as c:
+            a = await c.attr("f")
+            assert a["kind"] == "f" and a["size"] == 123
+            assert stat.S_IMODE(os.lstat(tmp_path / "f").st_mode) == a["mode"]
+            assert (await c.attr("pipe"))["kind"] == "p"
+            lnk = await c.attr("lnk")
+            assert lnk["kind"] == "l" and lnk["target"] == "f"
+            assert await c.read_link("lnk") == "f"
+            with pytest.raises(CallError) as ei:
+                await c.attr("nope")
+            assert ei.value.response.status == 404
+            with pytest.raises(CallError) as ei:
+                await c.read_dir("f")
+            assert ei.value.response.status == 400
+            with pytest.raises(CallError) as ei:
+                await c.open("nope")
+            assert ei.value.response.status == 404
+            # oversize read is refused, not truncated
+            h = await c.open("f")
+            with pytest.raises(CallError) as ei:
+                await c.read_at(h, 0, (64 << 20))
+            assert ei.value.response.status == 400
+            await c.close(h)
+    asyncio.run(main())
+
+
+def test_statfs_and_raced_unlink(pki, tmp_path):
+    """read_dir skips entries unlinked between listdir and lstat instead
+    of failing the whole listing."""
+    d = tmp_path / "d"
+    d.mkdir()
+    for i in range(5):
+        (d / f"k{i}").write_bytes(b"")
+
+    async def main():
+        async with Harness(pki, tmp_path) as c:
+            sv = await c.stat_fs()
+            assert sv["total"] > 0 and sv["free"] >= 0
+            # drop one file mid-walk by patching listdir timing is racy to
+            # stage; the protocol contract is simply that a missing entry
+            # is skipped — emulate by listing after unlink
+            os.unlink(d / "k2")
+            names = [e["name"] for e in await c.read_dir("d")]
+            assert names == ["k0", "k1", "k3", "k4"]
+    asyncio.run(main())

@@ -123,6 +123,11 @@ def make_exotic_tree(root) -> str:
     os.link(d("hl-a"), d("hl-b"))
     os.link(d("hl-a"), d("docs", "hl-c"))
     os.link(d("perm", "setuid-tool"), d("perm", "setuid-alias"))
+    # hardlinked SYMLINK pair (rsync -H parity: link the symlink node)
+    try:
+        os.link(d("rel-link"), d("rel-link-twin"), follow_symlinks=False)
+    except (NotImplementedError, OSError):
+        pass                        # fs without symlink hardlinks
 
     os.mkfifo(d("pipe"), 0o640)
 
@@ -218,6 +223,9 @@ def rsync_compare(src: str, dst: str) -> list[str]:
             if os.readlink(sp) != os.readlink(dp):
                 diffs.append(f"{rel}: symlink target "
                              f"{os.readlink(sp)!r} != {os.readlink(dp)!r}")
+            if sa.st_nlink > 1:
+                src_links.setdefault((sa.st_dev, sa.st_ino), []).append(rel)
+                dst_links.setdefault((sb.st_dev, sb.st_ino), []).append(rel)
         elif stat.S_ISCHR(sa.st_mode) or stat.S_ISBLK(sa.st_mode):
             if sa.st_rdev != sb.st_rdev:
                 diffs.append(f"{rel}: rdev {sa.st_rdev} != {sb.st_rdev}")
@@ -372,6 +380,8 @@ def test_restore_over_existing_tree(tmp_path):
     (dest / "rel-link").write_text("was a file, should become a symlink")
     os.symlink("bogus", dest / "name with  spaces")
     (dest / "docs" / "readme.txt").write_text("stale content")
+    (dest / "pipe").write_text("was a file, should become a fifo")
+    os.symlink("nowhere", dest / "empty-dir")   # dangling link vs dir
     _, res = backup_restore(tmp_path, tree)
     assert res.errors == []
     assert rsync_compare(tree, str(dest)) == []
