@@ -85,6 +85,22 @@ class AgentFSServer:
             raise HandlerError(f"path escapes root: {rel!r}", status=400)
         return p
 
+    def _check_contained(self, p: str, rel: str, *,
+                         follow_final: bool) -> None:
+        """Refuse paths whose symlink resolution leaves the snapshot root.
+
+        follow_final=True when the operation itself follows the final
+        component (listdir); False when it operates on the node itself
+        (lstat/readlink/lgetxattr) — there only the PARENT traversal can
+        escape.  Best-effort for metadata; content reads get the stronger
+        post-open fd gate in _open."""
+        target = p if (follow_final or p == self.root) \
+            else (os.path.dirname(p) or p)
+        rp = os.path.realpath(target)
+        if rp != self._realroot and \
+                not rp.startswith(self._realroot + os.sep):
+            raise HandlerError(f"symlink escapes root: {rel!r}", status=400)
+
     def register(self, router: Router) -> None:
         router.handle("agentfs.stat_fs", self._stat_fs)
         router.handle("agentfs.attr", self._attr)
@@ -105,6 +121,7 @@ class AgentFSServer:
 
     async def _attr(self, req, ctx):
         p = self._resolve(req.payload["path"])
+        self._check_contained(p, req.payload["path"], follow_final=False)
         try:
             st = os.lstat(p)
         except OSError as e:
@@ -119,6 +136,7 @@ class AgentFSServer:
 
     async def _read_dir(self, req, ctx):
         p = self._resolve(req.payload["path"])
+        self._check_contained(p, req.payload["path"], follow_final=True)
         try:
             names = sorted(os.listdir(p))
         except NotADirectoryError:
@@ -129,9 +147,17 @@ class AgentFSServer:
         # never has to carry a 100k-entry directory (the continuation is
         # a name, not an index — stable under concurrent unlinks)
         start = req.payload.get("start", "")
+        if not isinstance(start, str):
+            raise HandlerError("start must be a name string", status=400)
         if start:
             names = names[bisect.bisect_right(names, start):]
-        page = min(int(req.payload.get("max", READDIR_PAGE)), READDIR_PAGE)
+        try:
+            page = int(req.payload.get("max", READDIR_PAGE))
+        except (TypeError, ValueError):
+            raise HandlerError("max must be an integer", status=400)
+        # clamp BOTH ends: max<=0 must not read as "empty directory" on
+        # the client (no next token would end its loop early)
+        page = max(1, min(page, READDIR_PAGE))
         names, more = names[:page], len(names) > page
         entries = []
         for name in names:
@@ -160,6 +186,7 @@ class AgentFSServer:
 
     async def _read_link(self, req, ctx):
         p = self._resolve(req.payload["path"])
+        self._check_contained(p, req.payload["path"], follow_final=False)
         try:
             return {"target": os.readlink(p)}
         except OSError as e:
@@ -167,6 +194,7 @@ class AgentFSServer:
 
     async def _xattrs(self, req, ctx):
         p = self._resolve(req.payload["path"])
+        self._check_contained(p, req.payload["path"], follow_final=False)
         return {"xattrs": read_xattrs(p)}
 
     async def _open(self, req, ctx):

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import os
+import shutil
 import stat as statmod
 from dataclasses import dataclass, field
 
@@ -56,6 +57,19 @@ class RestoreEngine:
         self._hardlinks: list[tuple[str, str]] = []
         self._dir_meta: list[tuple[str, Entry]] = []
 
+    @staticmethod
+    def _clear_conflict(path: str) -> None:
+        """Remove whatever occupies ``path`` so the archive's node kind
+        wins — including a conflicting directory tree (restore is
+        authoritative for the destination, like rsync with a changed
+        entry type)."""
+        if not os.path.lexists(path):
+            return
+        if os.path.isdir(path) and not os.path.islink(path):
+            shutil.rmtree(path)
+        else:
+            os.unlink(path)
+
     def _target(self, rel: str) -> str:
         p = os.path.normpath(os.path.join(self.dest, rel)) if rel else self.dest
         if p != self.dest and not p.startswith(self.dest + os.sep):
@@ -72,8 +86,7 @@ class RestoreEngine:
         for link_rel, target_rel in self._hardlinks:
             try:
                 lp, tp = self._target(link_rel), self._target(target_rel)
-                if os.path.lexists(lp):
-                    os.unlink(lp)
+                self._clear_conflict(lp)
                 try:
                     os.link(tp, lp, follow_symlinks=False)
                 except NotImplementedError:
@@ -119,16 +132,13 @@ class RestoreEngine:
         elif e.kind == KIND_FILE:
             await self._restore_file(rel, e, path)
         elif e.kind == KIND_SYMLINK:
-            if os.path.lexists(path):
-                os.unlink(path)
+            self._clear_conflict(path)
             os.symlink(e.link_target, path)
             self._apply_meta(path, e, symlink=True)
         elif e.kind == KIND_HARDLINK:
             self._hardlinks.append((rel, e.link_target))
         elif e.kind == KIND_FIFO:
-            if os.path.lexists(path):
-                os.unlink(path)       # conflicting node: replace, like
-                                      # every other kind branch
+            self._clear_conflict(path)
             os.mkfifo(path, e.mode)
             self._apply_meta(path, e)
         elif e.kind in (KIND_SOCKET, KIND_DEVICE, KIND_BLOCKDEV):
@@ -138,8 +148,7 @@ class RestoreEngine:
                     KIND_DEVICE: statmod.S_IFCHR,
                     KIND_BLOCKDEV: statmod.S_IFBLK}[e.kind]
             try:
-                if os.path.lexists(path):
-                    os.unlink(path)
+                self._clear_conflict(path)
                 os.mknod(path, ifmt | e.mode, e.rdev)
                 self._apply_meta(path, e)
             except OSError as ex:
@@ -164,6 +173,8 @@ class RestoreEngine:
                 os.unlink(tmp)
                 raise IOError("content digest mismatch after restore")
             self.result.verified += 1
+        if os.path.isdir(path) and not os.path.islink(path):
+            self._clear_conflict(path)    # os.replace cannot evict a dir
         os.replace(tmp, path)
         self._apply_meta(path, e)
         self.result.files += 1

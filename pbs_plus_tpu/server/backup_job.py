@@ -282,7 +282,17 @@ class RemoteTreeBackup:
                     if m.get("nlink", 1) > 1:
                         seen_inodes[key] = child
                     await self._stream_file(child, e)
-            elif kind in (KIND_SYMLINK, KIND_FIFO, KIND_SOCKET, KIND_DEVICE,
+            elif kind == KIND_SYMLINK:
+                # multiply-linked symlinks are hardlink entries here too
+                # (same rsync -H parity as pxar/walker.py's local walk)
+                key = (m.get("dev", 0), m.get("ino", 0))
+                if m.get("nlink", 1) > 1 and key in seen_inodes:
+                    e.kind = KIND_HARDLINK
+                    e.link_target = seen_inodes[key]
+                elif m.get("nlink", 1) > 1:
+                    seen_inodes[key] = child
+                await self._put(("entry", e, None))
+            elif kind in (KIND_FIFO, KIND_SOCKET, KIND_DEVICE,
                           KIND_BLOCKDEV):
                 await self._put(("entry", e, None))
             self.result.entries += 1

@@ -42,6 +42,7 @@ from typing import TYPE_CHECKING
 from aiohttp import web
 
 from ..utils.log import L
+from ..utils.singleflight import SingleFlight
 from . import database
 from .metrics import MetricsRegistry
 
@@ -773,10 +774,21 @@ echo "  --bootstrap-token <token_id:secret>"
 """
         return web.Response(text=script, content_type="text/x-shellscript")
 
+    # release-artifact work is singleflighted: a fleet-wide update makes
+    # every agent hit these at once, and the pyz build + Ed25519 signing
+    # must run once per stampede, not once per agent (reference:
+    # web/api/plus.go downloadFlight)
+    release_flight = SingleFlight()
+    server.release_flight = release_flight          # test/metrics probe
+
+    def _in_executor(fn, *args):
+        return asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
     async def agent_pyz(request):
         """Zipapp of this package — the runnable 'agent binary'."""
-        pyz = await asyncio.get_running_loop().run_in_executor(
-            None, _build_agent_pyz, server.config.state_dir)
+        pyz = await release_flight.do(
+            "pyz", lambda: _in_executor(_build_agent_pyz,
+                                        server.config.state_dir))
         return web.FileResponse(
             pyz, headers={"Content-Disposition":
                           'attachment; filename="pbs-plus-tpu-agent.pyz"'})
@@ -786,15 +798,15 @@ echo "  --bootstrap-token <token_id:secret>"
         hash), sha256, Ed25519 signature over the artifact (reference:
         the server's agent version endpoint + signed binary download the
         updater/binswap consumes)."""
-        info = await asyncio.get_running_loop().run_in_executor(
-            None, _agent_release_info, server)
+        info = await release_flight.do(
+            "version", lambda: _in_executor(_agent_release_info, server))
         return web.json_response(info)
 
     async def agent_signer_pub(request):
         """The release-signing public key (fetched at install time;
         pinned by the agent thereafter)."""
-        pub = await asyncio.get_running_loop().run_in_executor(
-            None, _signer_keys, server)
+        pub = await release_flight.do(
+            "signer", lambda: _in_executor(_signer_keys, server))
         return web.Response(body=pub[1],
                             content_type="application/x-pem-file")
 

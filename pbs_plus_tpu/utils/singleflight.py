@@ -1,0 +1,61 @@
+"""Async singleflight: coalesce concurrent calls for the same key.
+
+Reference capability: the duplicate-suppression on hot API work
+(/root/reference/internal/server/web/api/plus.go:44,107-111 — concurrent
+agent-binary downloads share ONE download+verify via
+singleflight.Group.Do; contract proven by plus_singleflight_test.go).
+
+asyncio-native redesign: the first caller for a key runs the factory as
+a task; every concurrent caller for the same key awaits that same task's
+result (or exception).  The key is released once the flight lands, so
+later callers re-execute — this is stampede suppression, not a cache
+(layer a cache on top where staleness policy belongs, e.g. web.py's
+release cache).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Awaitable, Callable
+
+
+class SingleFlight:
+    def __init__(self) -> None:
+        self._flights: dict[Any, asyncio.Future] = {}
+        self.stats = {"calls": 0, "executions": 0, "shared": 0}
+
+    async def do(self, key: Any,
+                 factory: Callable[[], Awaitable[Any]]) -> Any:
+        """Return factory()'s result, running it at most once across all
+        concurrent callers with this key.  Exceptions propagate to every
+        waiter.  Cancellation of a WAITER does not cancel the flight;
+        cancellation of the RUNNER cancels all waiters (they re-raise)."""
+        self.stats["calls"] += 1
+        fut = self._flights.get(key)
+        if fut is not None:
+            self.stats["shared"] += 1
+            # shield: one waiter's cancellation must not tear down the
+            # shared flight under the other callers
+            return await asyncio.shield(fut)
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._flights[key] = fut
+        self.stats["executions"] += 1
+        try:
+            result = await factory()
+        except BaseException as e:
+            if not fut.cancelled():
+                fut.set_exception(e)
+                # a Future exception nobody else awaits must not warn;
+                # the runner re-raises it below either way
+                fut.exception()
+            raise
+        else:
+            if not fut.cancelled():
+                fut.set_result(result)
+            return result
+        finally:
+            self._flights.pop(key, None)
+
+    def in_flight(self, key: Any) -> bool:
+        return key in self._flights

@@ -152,6 +152,67 @@ def test_symlink_escape_refused_in_tree_allowed(pki, tmp_path):
     asyncio.run(main())
 
 
+def test_metadata_calls_refuse_symlink_escape(pki, tmp_path):
+    """read_dir/attr/xattrs must not traverse in-tree symlinks out of the
+    snapshot root either — metadata disclosure is still disclosure."""
+    snap = tmp_path / "snap"
+    outside = tmp_path / "outside"
+    (outside / "sub").mkdir(parents=True)
+    (outside / "sub" / "leak.txt").write_bytes(b"secret")
+    snap.mkdir()
+    os.symlink(str(outside), snap / "evil")
+    (snap / "indir").mkdir()
+    (snap / "indir" / "ok.txt").write_bytes(b"fine")
+    os.symlink("indir", snap / "good")
+
+    async def main():
+        async with Harness(pki, snap) as c:
+            # listing THROUGH an escaping symlink dir: refused
+            for call, payload in [
+                ("agentfs.read_dir", {"path": "evil"}),
+                ("agentfs.read_dir", {"path": "evil/sub"}),
+                ("agentfs.attr", {"path": "evil/sub/leak.txt"}),
+                ("agentfs.xattrs", {"path": "evil/sub/leak.txt"}),
+                ("agentfs.read_link", {"path": "evil/sub"}),
+            ]:
+                with pytest.raises(CallError) as ei:
+                    await c.s.call(call, payload)
+                assert ei.value.response.status == 400, (call, payload)
+            # the symlink NODE itself is still stat-able (walkers need it)
+            a = await c.attr("evil")
+            assert a["kind"] == "l"
+            # in-tree symlinked dirs keep working
+            names = [e["name"] for e in await c.read_dir("good")]
+            assert names == ["ok.txt"]
+            assert (await c.attr("good/ok.txt"))["size"] == 4
+    asyncio.run(main())
+
+
+def test_readdir_max_param_validation(pki, tmp_path):
+    """max<=0 clamps to one entry (never a silent empty page) and bad
+    types are clean 400s, not 500s."""
+    d = tmp_path / "d"
+    d.mkdir()
+    for i in range(3):
+        (d / f"e{i}").write_bytes(b"")
+
+    async def main():
+        async with Harness(pki, tmp_path) as c:
+            r = (await c.s.call("agentfs.read_dir",
+                                {"path": "d", "max": 0})).data
+            assert [e["name"] for e in r["entries"]] == ["e0"]
+            assert r["next"] == "e0"
+            r = (await c.s.call("agentfs.read_dir",
+                                {"path": "d", "max": -5})).data
+            assert len(r["entries"]) == 1 and r["next"] == "e0"
+            for bad in ({"max": "lots"}, {"start": 7}):
+                with pytest.raises(CallError) as ei:
+                    await c.s.call("agentfs.read_dir",
+                                   {"path": "d", **bad})
+                assert ei.value.response.status == 400, bad
+    asyncio.run(main())
+
+
 def test_sparse_seek_data_hole(pki, tmp_path):
     """SEEK_DATA/SEEK_HOLE pass through so the server can skip holes the
     way the reference's lseek surface does."""

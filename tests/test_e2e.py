@@ -33,6 +33,10 @@ def _build_tree(root):
     (root / "skip.tmp").write_text("excluded")
     os.symlink("docs/readme.txt", root / "link")
     os.link(root / "docs" / "readme.txt", root / "hard")
+    try:   # multiply-linked symlink (rsync -H parity through the agent)
+        os.link(root / "link", root / "link-twin", follow_symlinks=False)
+    except (NotImplementedError, OSError):
+        pass
 
 
 def _tree_digest(root, *, exclude=()):
@@ -122,6 +126,8 @@ def test_backup_restore_roundtrip(env, tmp_path):
         # hardlink represented
         kinds = {by["hard"].kind, by["docs/readme.txt"].kind}
         assert "h" in kinds and "f" in kinds
+        if "link-twin" in by:     # symlink hardlink pair rode the agent
+            assert {by["link"].kind, by["link-twin"].kind} == {"l", "h"}
 
         # restore to a fresh destination via the agent protocol
         dest = tmp_path / "restored"

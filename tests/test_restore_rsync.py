@@ -382,6 +382,9 @@ def test_restore_over_existing_tree(tmp_path):
     (dest / "docs" / "readme.txt").write_text("stale content")
     (dest / "pipe").write_text("was a file, should become a fifo")
     os.symlink("nowhere", dest / "empty-dir")   # dangling link vs dir
+    # a whole directory TREE where the archive has a file and a fifo
+    os.makedirs(dest / "hl-a" / "nested")
+    (dest / "hl-a" / "nested" / "junk").write_text("evict me")
     _, res = backup_restore(tmp_path, tree)
     assert res.errors == []
     assert rsync_compare(tree, str(dest)) == []

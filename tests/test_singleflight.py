@@ -1,0 +1,131 @@
+"""Singleflight: duplicate suppression on hot API work.
+
+Reference: /root/reference/internal/server/web/api/plus.go:44,107-111 and
+its contract test plus_singleflight_test.go (50 concurrent callers share
+ONE download+verify).  Here: unit contract for the asyncio group, then
+the web-level stampede — concurrent agent release requests build and
+sign the artifact once.
+"""
+
+import asyncio
+
+import pytest
+from aiohttp import ClientSession
+
+from pbs_plus_tpu.utils.singleflight import SingleFlight
+
+from test_web import _mk_server
+
+
+def test_concurrent_callers_share_one_execution():
+    async def main():
+        sf = SingleFlight()
+        runs = 0
+        gate = asyncio.Event()
+
+        async def work():
+            nonlocal runs
+            runs += 1
+            await gate.wait()
+            return "result"
+
+        tasks = [asyncio.create_task(sf.do("k", work)) for _ in range(50)]
+        await asyncio.sleep(0.05)       # all callers queued on the flight
+        gate.set()
+        assert await asyncio.gather(*tasks) == ["result"] * 50
+        assert runs == 1
+        assert sf.stats == {"calls": 50, "executions": 1, "shared": 49}
+        # the key is released: a later call re-executes (stampede
+        # suppression, not a cache)
+        assert await sf.do("k", work) == "result"
+        assert runs == 2
+    asyncio.run(main())
+
+
+def test_errors_propagate_to_every_waiter_and_key_releases():
+    async def main():
+        sf = SingleFlight()
+        gate = asyncio.Event()
+
+        async def boom():
+            await gate.wait()
+            raise ValueError("flight failed")
+
+        tasks = [asyncio.create_task(sf.do("k", boom)) for _ in range(10)]
+        await asyncio.sleep(0.05)
+        gate.set()
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+        assert all(isinstance(r, ValueError) for r in results)
+        assert not sf.in_flight("k")
+
+        async def ok():
+            return 42
+        assert await sf.do("k", ok) == 42
+    asyncio.run(main())
+
+
+def test_distinct_keys_do_not_coalesce():
+    async def main():
+        sf = SingleFlight()
+        ran = []
+
+        async def work(tag):
+            ran.append(tag)
+            await asyncio.sleep(0.02)
+            return tag
+
+        a, b = await asyncio.gather(sf.do("a", lambda: work("a")),
+                                    sf.do("b", lambda: work("b")))
+        assert (a, b) == ("a", "b") and sorted(ran) == ["a", "b"]
+    asyncio.run(main())
+
+
+def test_waiter_cancellation_does_not_kill_flight():
+    async def main():
+        sf = SingleFlight()
+        gate = asyncio.Event()
+
+        async def work():
+            await gate.wait()
+            return "ok"
+
+        t1 = asyncio.create_task(sf.do("k", work))
+        await asyncio.sleep(0.02)
+        t2 = asyncio.create_task(sf.do("k", work))
+        await asyncio.sleep(0.02)
+        t2.cancel()
+        await asyncio.sleep(0.02)
+        gate.set()
+        assert await t1 == "ok"
+        with pytest.raises(asyncio.CancelledError):
+            await t2
+    asyncio.run(main())
+
+
+def test_release_stampede_builds_once(tmp_path):
+    """The reference contract carried to this server: 50 concurrent
+    version requests (fleet-wide updater poll) sign the release once."""
+    async def main():
+        server, runner, port, tid, secret = await _mk_server(tmp_path)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with ClientSession() as http:
+                rs = await asyncio.gather(*[
+                    http.get(f"{base}/plus/agent/version")
+                    for _ in range(50)])
+                bodies = [await r.json() for r in rs]
+            assert all(r.status == 200 for r in rs)
+            # every caller saw the SAME signed release
+            assert len({b["sha256"] for b in bodies}) == 1
+            assert len({b["signature"] for b in bodies}) == 1
+            fl = server.release_flight.stats
+            assert fl["calls"] >= 50
+            # the pyz build + signing ran far fewer times than callers;
+            # aiohttp may deliver a few requests after the first flight
+            # lands, so allow a handful of executions, not one per call
+            assert fl["executions"] <= 5
+            assert fl["shared"] >= 40
+        finally:
+            await runner.cleanup()
+            await server.stop()
+    asyncio.run(main())
