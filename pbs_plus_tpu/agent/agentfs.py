@@ -85,6 +85,13 @@ class AgentFSServer:
             raise HandlerError(f"path escapes root: {rel!r}", status=400)
         return p
 
+    def _within_realroot(self, rp: str) -> bool:
+        """THE containment predicate — every gate (metadata pre-checks
+        and _open's post-open fd check) must use this one definition or
+        they drift apart."""
+        return rp == self._realroot or \
+            rp.startswith(self._realroot + os.sep)
+
     def _check_contained(self, p: str, rel: str, *,
                          follow_final: bool) -> None:
         """Refuse paths whose symlink resolution leaves the snapshot root.
@@ -96,9 +103,7 @@ class AgentFSServer:
         post-open fd gate in _open."""
         target = p if (follow_final or p == self.root) \
             else (os.path.dirname(p) or p)
-        rp = os.path.realpath(target)
-        if rp != self._realroot and \
-                not rp.startswith(self._realroot + os.sep):
+        if not self._within_realroot(os.path.realpath(target)):
             raise HandlerError(f"symlink escapes root: {rel!r}", status=400)
 
     def register(self, router: Router) -> None:
@@ -220,8 +225,7 @@ class AgentFSServer:
             proc = f"/proc/self/fd/{fd}"
             rp = os.path.realpath(proc) if os.path.exists(proc) \
                 else os.path.realpath(p)
-            if rp != self._realroot and \
-                    not rp.startswith(self._realroot + os.sep):
+            if not self._within_realroot(rp):
                 raise HandlerError(f"symlink escapes root: "
                                    f"{req.payload['path']!r}", status=400)
             f = os.fdopen(fd, "rb", buffering=0)

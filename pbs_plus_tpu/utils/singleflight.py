@@ -21,39 +21,36 @@ from typing import Any, Awaitable, Callable
 
 class SingleFlight:
     def __init__(self) -> None:
-        self._flights: dict[Any, asyncio.Future] = {}
+        self._flights: dict[Any, asyncio.Task] = {}
         self.stats = {"calls": 0, "executions": 0, "shared": 0}
 
     async def do(self, key: Any,
                  factory: Callable[[], Awaitable[Any]]) -> Any:
         """Return factory()'s result, running it at most once across all
         concurrent callers with this key.  Exceptions propagate to every
-        waiter.  Cancellation of a WAITER does not cancel the flight;
-        cancellation of the RUNNER cancels all waiters (they re-raise)."""
+        waiter.  The flight runs as a DETACHED task: cancelling any
+        caller — including the one that started it — cancels only that
+        caller's wait, never the shared flight (the Go reference's
+        Group.Do likewise outlives its first caller)."""
         self.stats["calls"] += 1
-        fut = self._flights.get(key)
-        if fut is not None:
-            self.stats["shared"] += 1
-            # shield: one waiter's cancellation must not tear down the
-            # shared flight under the other callers
-            return await asyncio.shield(fut)
-        loop = asyncio.get_running_loop()
-        fut = loop.create_future()
-        self._flights[key] = fut
-        self.stats["executions"] += 1
-        try:
-            result = await factory()
-        except BaseException as e:
-            if not fut.cancelled():
-                fut.set_exception(e)
-                # a Future exception nobody else awaits must not warn;
-                # the runner re-raises it below either way
-                fut.exception()
-            raise
+        task = self._flights.get(key)
+        if task is None:
+            self.stats["executions"] += 1
+            task = asyncio.get_running_loop().create_task(
+                self._run(key, factory))
+            # if every waiter is cancelled the exception would otherwise
+            # log "never retrieved" at GC time
+            task.add_done_callback(
+                lambda t: t.cancelled() or t.exception())
+            self._flights[key] = task
         else:
-            if not fut.cancelled():
-                fut.set_result(result)
-            return result
+            self.stats["shared"] += 1
+        return await asyncio.shield(task)
+
+    async def _run(self, key: Any,
+                   factory: Callable[[], Awaitable[Any]]) -> Any:
+        try:
+            return await factory()
         finally:
             self._flights.pop(key, None)
 

@@ -1,0 +1,274 @@
+"""Journal unit battery: direct CRUD/edge coverage of the mutation
+journal, mirroring the reference's journal-level suite
+(/root/reference/internal/pxarmount/journal_test.go, 1698 LoC — schema,
+root invariants, node CRUD, edge ordering, whiteout idempotence, xattr
+CRUD, orphan cleanup, reopen idempotence).  The overlay-semantics layer
+above it (resolve, copy-up, rename chains) is covered by test_mount.py
+and test_commit_edges.py; this battery pins the journal contract those
+layers stand on.
+"""
+
+import sqlite3
+
+import pytest
+
+from pbs_plus_tpu.mount.journal import ROOT_ID, Journal, Node
+
+
+@pytest.fixture
+def j(tmp_path):
+    jj = Journal(str(tmp_path / "journal.db"))
+    yield jj
+    jj.close()
+
+
+def _mknode(j, kind="f", **kw) -> Node:
+    n = Node(id=0, kind=kind, **kw)
+    j.put_node(n)
+    return n
+
+
+# --- open / schema ------------------------------------------------------
+
+def test_open_creates_schema_and_root(j):
+    root = j.get_node(ROOT_ID)
+    assert root is not None and root.kind == "d"
+    assert root.mode == 0o755
+    assert j.stats() == {"nodes": 1, "edges": 0, "whiteouts": 0, "xattrs": 0}
+    assert j.verify_integrity() == []
+
+
+def test_open_idempotent(tmp_path):
+    p = str(tmp_path / "j.db")
+    j1 = Journal(p)
+    n = Node(id=0, kind="f", size=7)
+    j1.put_node(n)
+    j1.set_edge(ROOT_ID, "a", n.id)
+    j1.close()
+    j2 = Journal(p)
+    try:
+        assert j2.get_node(n.id).size == 7
+        assert j2.edges(ROOT_ID) == [("a", n.id)]
+        j3 = Journal(p)          # third open, same file, while j2 lives
+        assert j3.get_node(ROOT_ID) is not None
+        j3.close()
+    finally:
+        j2.close()
+
+
+def test_open_recreates_root_if_missing(tmp_path):
+    p = str(tmp_path / "j.db")
+    j1 = Journal(p)
+    j1.close()
+    conn = sqlite3.connect(p)
+    with conn:
+        conn.execute("DELETE FROM nodes WHERE id=?", (ROOT_ID,))
+    conn.close()
+    j2 = Journal(p)
+    try:
+        root = j2.get_node(ROOT_ID)
+        assert root is not None and root.kind == "d"
+        assert j2.verify_integrity() == []
+    finally:
+        j2.close()
+
+
+# --- node CRUD ----------------------------------------------------------
+
+def test_create_get_update_node(j):
+    n = _mknode(j, kind="f", mode=0o640, uid=3, gid=4, mtime_ns=12345,
+                size=99, content_path="cp/0001")
+    assert n.id > ROOT_ID
+    got = j.get_node(n.id)
+    assert (got.kind, got.mode, got.uid, got.gid, got.mtime_ns, got.size,
+            got.content_path) == ("f", 0o640, 3, 4, 12345, 99, "cp/0001")
+    got.size = 128
+    got.mode = 0o600
+    j.put_node(got)
+    again = j.get_node(n.id)
+    assert again.size == 128 and again.mode == 0o600
+    assert j.verify_integrity() == []     # checksum rewritten on update
+
+
+def test_get_node_nonexistent(j):
+    assert j.get_node(99_999) is None
+
+
+def test_base_path_none_vs_empty_distinct(j):
+    """base_path=None (fresh node) and '' (copied up from archive root)
+    are different states and must checksum differently."""
+    a = _mknode(j, base_path=None)
+    b = _mknode(j, base_path="")
+    assert j.get_node(a.id).base_path is None
+    assert j.get_node(b.id).base_path == ""
+    assert Node(1, "f", base_path=None).checksum != \
+        Node(1, "f", base_path="").checksum
+
+
+def test_checksum_detects_out_of_band_tamper(tmp_path):
+    p = str(tmp_path / "j.db")
+    j1 = Journal(p)
+    n = _mknode(j1, size=10)
+    j1.close()
+    conn = sqlite3.connect(p)
+    with conn:
+        conn.execute("UPDATE nodes SET size=999 WHERE id=?", (n.id,))
+    conn.close()
+    j2 = Journal(p)
+    try:
+        problems = j2.verify_integrity()
+        assert any(f"node {n.id}" in pr for pr in problems)
+    finally:
+        j2.close()
+
+
+# --- edges --------------------------------------------------------------
+
+def test_edges_ordered_by_name(j):
+    ids = {}
+    for name in ("zeta", "alpha", "mid", "Alpha", "1num"):
+        n = _mknode(j)
+        j.set_edge(ROOT_ID, name, n.id)
+        ids[name] = n.id
+    assert [name for name, _ in j.edges(ROOT_ID)] == \
+        sorted(["zeta", "alpha", "mid", "Alpha", "1num"])
+
+
+def test_edge_replace_and_delete(j):
+    a, b = _mknode(j), _mknode(j)
+    j.set_edge(ROOT_ID, "x", a.id)
+    j.set_edge(ROOT_ID, "x", b.id)         # replace, not duplicate
+    assert j.edges(ROOT_ID) == [("x", b.id)]
+    assert j.get_edge(ROOT_ID, "x") == b.id
+    j.del_edge(ROOT_ID, "x")
+    assert j.get_edge(ROOT_ID, "x") is None
+    j.del_edge(ROOT_ID, "x")               # delete is idempotent
+    assert j.edges(ROOT_ID) == []
+
+
+def test_edges_scoped_to_parent(j):
+    d = _mknode(j, kind="d")
+    f1, f2 = _mknode(j), _mknode(j)
+    j.set_edge(ROOT_ID, "d", d.id)
+    j.set_edge(d.id, "inner", f1.id)
+    j.set_edge(ROOT_ID, "top", f2.id)
+    assert [n for n, _ in j.edges(d.id)] == ["inner"]
+    assert [n for n, _ in j.edges(ROOT_ID)] == ["d", "top"]
+
+
+# --- whiteouts ----------------------------------------------------------
+
+def test_whiteout_add_list_idempotent(j):
+    j.add_whiteout(ROOT_ID, "gone")
+    j.add_whiteout(ROOT_ID, "gone")        # idempotent
+    j.add_whiteout(ROOT_ID, "also-gone")
+    assert j.whiteouts(ROOT_ID) == {"gone", "also-gone"}
+    assert j.is_whiteout(ROOT_ID, "gone")
+    assert not j.is_whiteout(ROOT_ID, "here")
+    assert j.stats()["whiteouts"] == 2
+
+
+def test_whiteout_and_edge_mutually_exclusive(j):
+    """An entry is either overlaid or deleted, never both: setting one
+    clears the other (resurrection = whiteout removed by the new edge)."""
+    n = _mknode(j)
+    j.set_edge(ROOT_ID, "name", n.id)
+    j.add_whiteout(ROOT_ID, "name")
+    assert j.get_edge(ROOT_ID, "name") is None
+    assert j.is_whiteout(ROOT_ID, "name")
+    j.set_edge(ROOT_ID, "name", n.id)      # resurrect
+    assert j.get_edge(ROOT_ID, "name") == n.id
+    assert not j.is_whiteout(ROOT_ID, "name")
+
+
+# --- xattrs -------------------------------------------------------------
+
+def test_xattr_crud_multiple_names(j):
+    n = _mknode(j)
+    j.set_xattr(n.id, "user.a", b"1")
+    j.set_xattr(n.id, "user.b", b"\x00\xff")
+    j.set_xattr(n.id, "user.a", b"2")       # overwrite
+    assert j.xattrs(n.id) == {"user.a": b"2", "user.b": b"\x00\xff"}
+    assert j.xattr(n.id, "user.b") == b"\x00\xff"
+    j.del_xattr(n.id, "user.a")
+    assert j.xattr(n.id, "user.a") is None
+    assert j.xattrs(n.id) == {"user.b": b"\x00\xff"}
+    j.del_xattr(n.id, "user.zz")            # idempotent
+
+
+def test_xattr_on_nonexistent_node_is_none(j):
+    assert j.xattr(99_999, "user.foo") is None
+    assert j.xattrs(99_999) == {}
+
+
+def test_xattrs_scoped_per_node(j):
+    a, b = _mknode(j), _mknode(j)
+    j.set_xattr(a.id, "user.k", b"A")
+    j.set_xattr(b.id, "user.k", b"B")
+    assert j.xattr(a.id, "user.k") == b"A"
+    assert j.xattr(b.id, "user.k") == b"B"
+
+
+# --- maintenance --------------------------------------------------------
+
+def test_orphan_edge_detection_and_gc(j):
+    n = _mknode(j)
+    j.set_edge(ROOT_ID, "ok", n.id)
+    # fabricate orphans out-of-band (crash artifacts)
+    with j._conn:
+        j._conn.execute("INSERT INTO edges VALUES (?,?,?)",
+                        (ROOT_ID, "dangling", 777))
+        j._conn.execute("INSERT INTO edges VALUES (?,?,?)",
+                        (888, "lost-parent", n.id))
+    problems = j.verify_integrity()
+    assert any("orphan child" in p for p in problems)
+    assert any("orphan parent" in p for p in problems)
+    assert j.gc_orphan_edges() == 2
+    assert j.verify_integrity() == []
+    assert j.edges(ROOT_ID) == [("ok", n.id)]
+
+
+def test_clear_resets_overlay_keeps_root(j):
+    n = _mknode(j)
+    j.set_edge(ROOT_ID, "x", n.id)
+    j.add_whiteout(ROOT_ID, "y")
+    j.set_xattr(n.id, "user.k", b"v")
+    j.clear()
+    assert j.stats() == {"nodes": 1, "edges": 0, "whiteouts": 0, "xattrs": 0}
+    assert j.get_node(ROOT_ID) is not None
+    assert j.verify_integrity() == []
+
+
+def test_survives_reopen_after_unsynced_writes(tmp_path):
+    """WAL journal: rows written without an explicit sync() are visible
+    after close+reopen (durability contract the hot-swap path relies on)."""
+    p = str(tmp_path / "j.db")
+    j1 = Journal(p)
+    made = [_mknode(j1, size=i).id for i in range(50)]
+    for i, nid in enumerate(made):
+        j1.set_edge(ROOT_ID, f"n{i:03d}", nid)
+    j1.close()                              # no sync() on purpose
+    j2 = Journal(p)
+    try:
+        assert len(j2.edges(ROOT_ID)) == 50
+        assert j2.verify_integrity() == []
+    finally:
+        j2.close()
+
+
+def test_many_nodes_edge_listing_not_quadratic(j):
+    """2k-entry directory: listing must stay one indexed query
+    (reference: TestReadDirPlusLargeDirNotQuadratic)."""
+    import time
+    d = _mknode(j, kind="d")
+    j.set_edge(ROOT_ID, "big", d.id)
+    for i in range(2000):
+        n = _mknode(j)
+        j.set_edge(d.id, f"e{i:05d}", n.id)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        es = j.edges(d.id)
+    dt = time.perf_counter() - t0
+    assert len(es) == 2000
+    assert es[0][0] == "e00000" and es[-1][0] == "e01999"
+    assert dt < 2.0       # 20 listings of 2k entries: far under quadratic

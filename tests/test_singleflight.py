@@ -102,6 +102,35 @@ def test_waiter_cancellation_does_not_kill_flight():
     asyncio.run(main())
 
 
+def test_first_caller_cancellation_does_not_kill_flight():
+    """The flight is a detached task: cancelling the request that
+    STARTED it (client disconnect mid-build) must not fail the other
+    coalesced callers."""
+    async def main():
+        sf = SingleFlight()
+        gate = asyncio.Event()
+        runs = 0
+
+        async def work():
+            nonlocal runs
+            runs += 1
+            await gate.wait()
+            return "shared"
+
+        first = asyncio.create_task(sf.do("k", work))
+        await asyncio.sleep(0.02)
+        rest = [asyncio.create_task(sf.do("k", work)) for _ in range(5)]
+        await asyncio.sleep(0.02)
+        first.cancel()
+        await asyncio.sleep(0.02)
+        gate.set()
+        assert await asyncio.gather(*rest) == ["shared"] * 5
+        assert runs == 1
+        with pytest.raises(asyncio.CancelledError):
+            await first
+    asyncio.run(main())
+
+
 def test_release_stampede_builds_once(tmp_path):
     """The reference contract carried to this server: 50 concurrent
     version requests (fleet-wide updater poll) sign the release once."""
