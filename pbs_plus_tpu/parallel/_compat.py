@@ -1,0 +1,8 @@
+"""jax API compatibility shims shared by the parallel modules."""
+
+try:                                  # jax >= 0.8 top-level API
+    from jax import shard_map
+except ImportError:                   # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["shard_map"]

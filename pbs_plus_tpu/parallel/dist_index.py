@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from ._compat import shard_map
 
 from ..ops.cuckoo import SLOTS, _MIX, CuckooIndex, _digest_words
 

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from ._compat import shard_map
 
 from ..chunker.spec import WINDOW, ChunkerParams, select_cuts
 from ..ops.rolling_hash import _candidate_mask_impl, device_tables
