@@ -231,14 +231,14 @@ def _cmd_mount(args: argparse.Namespace) -> int:
     from .mount import ArchiveView, CommitEngine, Journal, MutableFS
     from .mount.control import MountControl
     from .pxar import LocalStore
-    from .pxar.datastore import SnapshotRef
+    from .pxar.datastore import parse_snapshot_ref
 
     async def main():
         store = LocalStore(args.store, ChunkerParams(avg_size=args.chunk_avg),
                            pbs_format=args.datastore_format == "pbs")
         previous = None
         if args.snapshot:
-            previous = SnapshotRef(*args.snapshot.strip("/").split("/"))
+            previous = parse_snapshot_ref(args.snapshot)
             view = ArchiveView(store.open_snapshot(previous))
         else:
             view = ArchiveView(None)     # init mode: empty archive

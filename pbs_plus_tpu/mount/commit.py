@@ -179,7 +179,9 @@ class CommitEngine:
             prog.emit("prepare")
             session = self.store.start_session(
                 backup_type=self.backup_type, backup_id=self.backup_id,
-                previous=self.previous)
+                previous=self.previous,
+                namespace=(self.previous.namespace or None)
+                if self.previous else None)
             prev_entries: dict[str, Entry] = {}
             if session.previous_reader is not None:
                 prev_entries = {e.path: e

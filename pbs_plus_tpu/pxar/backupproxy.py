@@ -15,6 +15,7 @@ half-snapshot visible (crash-safety rule from SURVEY §5.3).
 
 from __future__ import annotations
 
+import dataclasses
 import datetime as _dt
 import os
 import shutil
@@ -57,6 +58,7 @@ class BackupSession:
             chunker_factory=chunker_factory,
             batch_hasher=store.batch_hasher,
         )
+        store.datastore.ensure_group_dir(ref)   # ns chain (PBS chown 34)
         self._final_dir = store.datastore.snapshot_dir(ref)
         # unique staging dir: concurrent same-second sessions must never
         # share (or rmtree) each other's in-progress state
@@ -93,9 +95,8 @@ class BackupSession:
                 t = _dt.datetime.strptime(
                     self.ref.backup_time, "%Y-%m-%dT%H:%M:%SZ"
                 ).replace(tzinfo=_dt.timezone.utc).timestamp() + 1.0
-                self.ref = SnapshotRef(self.ref.backup_type,
-                                       self.ref.backup_id,
-                                       format_backup_time(t))
+                self.ref = dataclasses.replace(
+                    self.ref, backup_time=format_backup_time(t))
                 self._final_dir = ds.snapshot_dir(self.ref)
             manifest = write_manifest(
                 os.path.join(self._tmp_dir, ds.MANIFEST),
@@ -157,10 +158,12 @@ class LocalStore:
     def start_session(self, *, backup_type: str, backup_id: str,
                       backup_time: float | None = None,
                       previous: SnapshotRef | PreviousBackupRef | None = None,
-                      auto_previous: bool = True) -> BackupSession:
+                      auto_previous: bool = True,
+                      namespace: str | None = None) -> BackupSession:
         """Open a session.  ``previous`` enables ref-dedup against that
-        snapshot; by default the latest snapshot of the same group is used.
-        Same-second collisions bump the timestamp +1 s (reference behavior,
+        snapshot; by default the latest snapshot of the same group (same
+        ``namespace``) is used.  Same-second collisions bump the timestamp
+        +1 s (reference behavior,
         /root/reference/internal/pxarmount/commit_orchestrate.go: same-second
         commits bump timestamp)."""
         parse_backup_type(backup_type)
@@ -168,10 +171,13 @@ class LocalStore:
         # later parse_snapshot_ref must accept it — reject traversal and
         # argv-unsafe ids HERE so no unreachable snapshot can be created
         validate.snapshot_component(backup_id)
+        namespace = namespace or ""     # callers may pass None for root
+        validate.namespace_path(namespace)
         if isinstance(previous, PreviousBackupRef):
             previous = previous.ref
         if previous is None and auto_previous:
-            previous = self.datastore.last_snapshot(backup_type, backup_id)
+            previous = self.datastore.last_snapshot(backup_type, backup_id,
+                                                    namespace)
         if previous is not None:
             # refuse ref-dedup across chunk-format/param changes — cuts
             # would not line up and the link would silently destroy dedup
@@ -188,10 +194,12 @@ class LocalStore:
             except OSError:
                 previous = None
         t = backup_time if backup_time is not None else time.time()
-        ref = SnapshotRef(backup_type, backup_id, format_backup_time(t))
+        ref = SnapshotRef(backup_type, backup_id, format_backup_time(t),
+                          namespace)
         while os.path.exists(self.datastore.snapshot_dir(ref)):
             t += 1.0
-            ref = SnapshotRef(backup_type, backup_id, format_backup_time(t))
+            ref = dataclasses.replace(ref,
+                                      backup_time=format_backup_time(t))
         return BackupSession(self, ref, previous, self._chunker_factory)
 
     def open_snapshot(self, ref: SnapshotRef, **kw) -> SplitReader:

@@ -56,12 +56,20 @@ def parse_snapshot_ref(s: str) -> "SnapshotRef":
     BACKUP_TYPES.  The same validator guards mint time (start_session,
     target create) so no unreachable snapshot can exist."""
     parts = s.strip("/").split("/")
+    ns_parts: list[str] = []
+    while len(parts) > 3 and parts[0] == "ns":
+        if len(ns_parts) >= MAX_NAMESPACE_DEPTH:
+            raise ValueError(f"namespace too deep in {s!r}")
+        validate.snapshot_component(parts[1])
+        ns_parts.append(parts[1])
+        parts = parts[2:]
     if len(parts) != 3:
-        raise ValueError(f"bad snapshot ref {s!r} (want type/id/time)")
+        raise ValueError(f"bad snapshot ref {s!r} "
+                         f"(want [ns/<n>/...]type/id/time)")
     for p in parts:
         validate.snapshot_component(p)
     parse_backup_type(parts[0])
-    return SnapshotRef(*parts)
+    return SnapshotRef(*parts, namespace="/".join(ns_parts))
 
 
 def parse_backup_time(ts: str) -> int:
@@ -343,13 +351,27 @@ class SnapshotRef:
     backup_type: str
     backup_id: str
     backup_time: str           # rfc3339 UTC
+    namespace: str = ""        # "a/b" → dirs ns/a/ns/b/ (PBS layout,
+                               # reference: ensureNamespaceDir,
+                               # commit_orchestrate.go:307-326)
+
+    @property
+    def ns_rel(self) -> str:
+        if not self.namespace:
+            return ""
+        return "/".join(f"ns/{p}"
+                        for p in self.namespace.split("/")) + "/"
 
     @property
     def rel_dir(self) -> str:
-        return f"{self.backup_type}/{self.backup_id}/{self.backup_time}"
+        return (f"{self.ns_rel}{self.backup_type}/"
+                f"{self.backup_id}/{self.backup_time}")
 
     def __str__(self) -> str:
         return self.rel_dir
+
+
+MAX_NAMESPACE_DEPTH = 7        # PBS's own namespace depth limit
 
 
 class Datastore:
@@ -397,29 +419,84 @@ class Datastore:
     def snapshot_dir(self, ref: SnapshotRef) -> str:
         return os.path.join(self.base, ref.rel_dir)
 
-    def list_snapshots(self, backup_type: str | None = None,
-                       backup_id: str | None = None) -> list[SnapshotRef]:
-        out: list[SnapshotRef] = []
-        types = [backup_type] if backup_type else [
-            t for t in BACKUP_TYPES if os.path.isdir(os.path.join(self.base, t))]
-        for t in types:
-            tdir = os.path.join(self.base, t)
-            if not os.path.isdir(tdir):
-                continue
-            ids = [backup_id] if backup_id else sorted(os.listdir(tdir))
-            for bid in ids:
-                iddir = os.path.join(tdir, bid)
-                if not os.path.isdir(iddir):
-                    continue
-                for ts in sorted(os.listdir(iddir)):
-                    snap = os.path.join(iddir, ts)
-                    if os.path.exists(os.path.join(snap, self.MANIFEST)):
-                        out.append(SnapshotRef(t, bid, ts))
+    def namespaces(self) -> list[str]:
+        """All namespaces with a directory, root ("") first, depth-first
+        sorted, bounded at MAX_NAMESPACE_DEPTH."""
+        out = [""]
+
+        def walk(dir_: str, prefix: str, depth: int) -> None:
+            if depth >= MAX_NAMESPACE_DEPTH:
+                return
+            nsdir = os.path.join(dir_, "ns")
+            if not os.path.isdir(nsdir):
+                return
+            for name in sorted(os.listdir(nsdir)):
+                sub = os.path.join(nsdir, name)
+                if os.path.isdir(sub):
+                    full = f"{prefix}/{name}" if prefix else name
+                    out.append(full)
+                    walk(sub, full, depth + 1)
+
+        walk(self.base, "", 0)
         return out
 
-    def last_snapshot(self, backup_type: str, backup_id: str) -> SnapshotRef | None:
-        snaps = self.list_snapshots(backup_type, backup_id)
+    def _ns_base(self, namespace: str) -> str:
+        if not namespace:
+            return self.base
+        return os.path.join(self.base, *(
+            p for part in namespace.split("/") for p in ("ns", part)))
+
+    def list_snapshots(self, backup_type: str | None = None,
+                       backup_id: str | None = None, *,
+                       namespace: str = "",
+                       all_namespaces: bool = False) -> list[SnapshotRef]:
+        spaces = self.namespaces() if all_namespaces else [namespace]
+        out: list[SnapshotRef] = []
+        for ns in spaces:
+            base = self._ns_base(ns)
+            types = [backup_type] if backup_type else [
+                t for t in BACKUP_TYPES
+                if os.path.isdir(os.path.join(base, t))]
+            for t in types:
+                tdir = os.path.join(base, t)
+                if not os.path.isdir(tdir):
+                    continue
+                ids = [backup_id] if backup_id else sorted(os.listdir(tdir))
+                for bid in ids:
+                    iddir = os.path.join(tdir, bid)
+                    if not os.path.isdir(iddir):
+                        continue
+                    for ts in sorted(os.listdir(iddir)):
+                        snap = os.path.join(iddir, ts)
+                        if os.path.exists(os.path.join(snap, self.MANIFEST)):
+                            out.append(SnapshotRef(t, bid, ts, ns))
+        return out
+
+    def last_snapshot(self, backup_type: str, backup_id: str,
+                      namespace: str = "") -> SnapshotRef | None:
+        snaps = self.list_snapshots(backup_type, backup_id,
+                                    namespace=namespace)
         return snaps[-1] if snaps else None
+
+    def ensure_group_dir(self, ref: SnapshotRef) -> None:
+        """Create the namespace chain + group dir for ``ref``.  In PBS
+        layout each ns component is chowned to uid/gid 34 (the `backup`
+        user) best-effort, so a stock PBS on the same host can manage
+        what this build writes (reference: ensureNamespaceDir,
+        commit_orchestrate.go:307-326)."""
+        cur = self.base
+        for part in (ref.namespace.split("/") if ref.namespace else []):
+            cur = os.path.join(cur, "ns", part)
+            fresh = not os.path.isdir(cur)
+            os.makedirs(cur, exist_ok=True)
+            if self.pbs_format and fresh:
+                try:
+                    os.chown(cur, 34, 34)
+                    os.chown(os.path.dirname(cur), 34, 34)
+                except OSError:
+                    pass               # not root / no backup user: fine
+        os.makedirs(os.path.join(
+            cur, ref.backup_type, ref.backup_id), exist_ok=True)
 
     def load_manifest(self, ref: SnapshotRef) -> dict:
         with open(os.path.join(self.snapshot_dir(ref), self.MANIFEST)) as f:

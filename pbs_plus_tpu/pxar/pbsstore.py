@@ -375,12 +375,13 @@ class PBSReaderSource:
     use: a fully-spliced unchanged tree never dials it for payload."""
 
     def __init__(self, cfg: PBSConfig, backup_type: str, backup_id: str,
-                 backup_time: int):
+                 backup_time: int, namespace: str | None = None):
         self.cfg = cfg
+        ns = cfg.namespace if namespace is None else namespace
         self._params = {"store": cfg.datastore, "backup-type": backup_type,
                         "backup-id": backup_id, "backup-time": backup_time}
-        if cfg.namespace:
-            self._params["ns"] = cfg.namespace
+        if ns:
+            self._params["ns"] = ns
         self._http: _PBSHttp | None = None
         self._dctx = zstandard.ZstdDecompressor()
         self.chunks_fetched = 0
@@ -611,8 +612,9 @@ class PBSStore:
             params = {"backup-type": ref.backup_type,
                       "backup-id": ref.backup_id,
                       "backup-time": parse_backup_time(ref.backup_time)}
-            if self.cfg.namespace:
-                params["ns"] = self.cfg.namespace
+            ns = ref.namespace or self.cfg.namespace
+            if ns:
+                params["ns"] = ns
             h.call("DELETE",
                    f"/api2/json/admin/datastore/{self.cfg.datastore}"
                    f"/snapshots", params=params)
@@ -626,22 +628,26 @@ class PBSStore:
 
     def start_session(self, *, backup_type: str, backup_id: str,
                       backup_time: float | None = None,
-                      previous=None, auto_previous: bool = True
-                      ) -> PBSBackupSession:
+                      previous=None, auto_previous: bool = True,
+                      namespace: str | None = None) -> PBSBackupSession:
         parse_backup_type(backup_type)
         validate.snapshot_component(backup_id)
+        ns = self.cfg.namespace if namespace is None else namespace
+        if ns:
+            for part in ns.split("/"):
+                validate.snapshot_component(part)
         t = backup_time if backup_time is not None else time.time()
         http_ = _PBSHttp(self.cfg)
         params = {"store": self.cfg.datastore, "backup-type": backup_type,
                   "backup-id": backup_id, "backup-time": int(t)}
-        if self.cfg.namespace:
-            params["ns"] = self.cfg.namespace
+        if ns:
+            params["ns"] = ns
         http_.call("GET", "/api2/json/backup", params=params,
                    headers={"Upgrade": PROTOCOL_UPGRADE})
         http_.session_bound = True
         try:
             return self._init_session(http_, backup_type, backup_id, t,
-                                      auto_previous)
+                                      auto_previous, ns)
         except BaseException:
             # a failure between session establish and a usable session
             # must release the connection — it holds the server-side
@@ -651,7 +657,7 @@ class PBSStore:
 
     def _init_session(self, http_: _PBSHttp, backup_type: str,
                       backup_id: str, t: float,
-                      auto_previous: bool) -> PBSBackupSession:
+                      auto_previous: bool, ns: str = "") -> PBSBackupSession:
         known: set[bytes] = set()
         previous = None
         if auto_previous:
@@ -677,21 +683,23 @@ class PBSStore:
                             idxs[name] = idx
                             for i in range(len(idx.ends)):
                                 known.add(idx.digests[i].tobytes())
-                    previous = self._previous_reader(http_, idxs,
-                                                     backup_type, backup_id)
+                    previous = self._previous_reader(
+                        http_, idxs, backup_type, backup_id, ns)
                 else:
                     L.warning("previous PBS snapshot uses different chunk "
                               "format/params; full upload")
             except PBSError as e:
                 if e.status != 404:
                     raise
-        ref = SnapshotRef(backup_type, backup_id, format_backup_time(t))
+        ref = SnapshotRef(backup_type, backup_id, format_backup_time(t),
+                          ns)
         return PBSBackupSession(self, ref, http_, known,
                                 self._chunker_factory, previous=previous)
 
     def _previous_reader(self, http_: _PBSHttp,
                          idxs: dict[str, DynamicIndex],
-                         backup_type: str, backup_id: str):
+                         backup_type: str, backup_id: str,
+                         ns: str = ""):
         """SplitReader over the previous snapshot, chunk-sourced from a
         lazy PBS reader session — enables write_entry_ref splicing with
         zero chunk IO for aligned (whole-chunk) ranges."""
@@ -702,6 +710,7 @@ class PBSStore:
             prev_t = int(http_.call("GET", "/previous_backup_time"))
         except (PBSError, TypeError, ValueError):
             return None                # server without reader support
-        source = PBSReaderSource(self.cfg, backup_type, backup_id, prev_t)
+        source = PBSReaderSource(self.cfg, backup_type, backup_id,
+                                 prev_t, namespace=ns)
         return SplitReader(idxs[Datastore.META_IDX],
                            idxs[Datastore.PAYLOAD_IDX], source)

@@ -456,7 +456,8 @@ async def run_local_backup(row: database.BackupJobRow, *, db, store,
         snap = snaps.create(src)
         try:
             session = store.start_session(
-                backup_type="host", backup_id=row.backup_id or row.target)
+                backup_type="host", backup_id=row.backup_id or row.target,
+                namespace=row.namespace or None)
             try:
                 counters = {"files": 0, "bytes": 0}
                 n = backup_tree(
@@ -495,7 +496,8 @@ async def run_s3_backup(row: database.BackupJobRow, *, db, store,
     result = BackupResult()
     session = await asyncio.get_running_loop().run_in_executor(
         None, lambda: store.start_session(
-            backup_type="host", backup_id=row.backup_id or row.target))
+            backup_type="host", backup_id=row.backup_id or row.target,
+            namespace=row.namespace or None))
     try:
         async with aiohttp.ClientSession() as http:
             client = S3Client(http, S3Config(
@@ -564,7 +566,8 @@ async def run_backup_job(row: database.BackupJobRow, *,
         # establish, previous-index downloads) — keep it off the event loop
         session = await asyncio.get_running_loop().run_in_executor(
             None, lambda: store.start_session(
-                backup_type="host", backup_id=row.backup_id or row.target))
+                backup_type="host", backup_id=row.backup_id or row.target,
+                namespace=row.namespace or None))
         try:
             pump = RemoteTreeBackup(
                 fs, session,

@@ -158,6 +158,10 @@ _MIGRATIONS: list[str] = [
         updated_at REAL NOT NULL
     );
     """,
+    # 006 — PBS-style namespaces on backup jobs
+    """
+    ALTER TABLE backup_jobs ADD COLUMN namespace TEXT NOT NULL DEFAULT '';
+    """,
 ]
 
 
@@ -168,6 +172,7 @@ class BackupJobRow:
     source_path: str
     store: str = ""
     backup_id: str = ""
+    namespace: str = ""        # PBS-style ns/a/ns/b grouping
     schedule: str = ""
     retry: int = 0
     retry_interval_s: int = 60
@@ -214,12 +219,15 @@ class Database:
         with self._lock, self._conn:
             self._conn.execute(
                 """INSERT INTO backup_jobs (id,target,source_path,store,
-                   backup_id,schedule,retry,retry_interval_s,exclusions,
-                   chunker,pre_script,post_script,enabled,created_at)
-                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)
+                   backup_id,namespace,schedule,retry,retry_interval_s,
+                   exclusions,chunker,pre_script,post_script,enabled,
+                   created_at)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)
                    ON CONFLICT(id) DO UPDATE SET target=excluded.target,
                      source_path=excluded.source_path, store=excluded.store,
-                     backup_id=excluded.backup_id, schedule=excluded.schedule,
+                     backup_id=excluded.backup_id,
+                     namespace=excluded.namespace,
+                     schedule=excluded.schedule,
                      retry=excluded.retry,
                      retry_interval_s=excluded.retry_interval_s,
                      exclusions=excluded.exclusions, chunker=excluded.chunker,
@@ -227,14 +235,15 @@ class Database:
                      post_script=excluded.post_script,
                      enabled=excluded.enabled""",
                 (j.id, j.target, j.source_path, j.store, j.backup_id,
-                 j.schedule, j.retry, j.retry_interval_s,
+                 j.namespace, j.schedule, j.retry, j.retry_interval_s,
                  json.dumps(j.exclusions), j.chunker, j.pre_script,
                  j.post_script, int(j.enabled), time.time()))
 
     def _row_to_job(self, r: sqlite3.Row) -> BackupJobRow:
         return BackupJobRow(
             id=r["id"], target=r["target"], source_path=r["source_path"],
-            store=r["store"], backup_id=r["backup_id"], schedule=r["schedule"],
+            store=r["store"], backup_id=r["backup_id"],
+            namespace=r["namespace"], schedule=r["schedule"],
             retry=r["retry"], retry_interval_s=r["retry_interval_s"],
             exclusions=json.loads(r["exclusions"]), chunker=r["chunker"],
             pre_script=r["pre_script"], post_script=r["post_script"],

@@ -82,7 +82,7 @@ class MetricsRegistry:
         gauge("pbs_plus_agents_connected", "Connected agent sessions",
               [({}, float(len(s.agents.sessions())))])
 
-        snaps = s.datastore.datastore.list_snapshots()
+        snaps = s.datastore.datastore.list_snapshots(all_namespaces=True)
         gauge("pbs_plus_snapshots_total", "Snapshots in the datastore",
               [({}, float(len(snaps)))])
         per_group: dict[str, int] = {}

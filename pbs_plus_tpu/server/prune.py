@@ -97,7 +97,7 @@ def mark_live_chunks(ds: Datastore) -> int:
     many snapshots; per-entry utime would be millions of redundant
     syscalls)."""
     live: set[bytes] = set()
-    for ref in ds.list_snapshots():
+    for ref in ds.list_snapshots(all_namespaces=True):
         try:
             indexes = ds.load_indexes(ref)
         except OSError:
@@ -116,10 +116,12 @@ def run_prune(ds: Datastore, policy: PrunePolicy, *,
     """Apply ``policy`` to every snapshot group, then (optionally)
     mark-and-sweep the chunk store."""
     report = PruneReport(dry_run=dry_run)
-    groups: dict[tuple[str, str], list[SnapshotRef]] = {}
-    for ref in ds.list_snapshots():
-        groups.setdefault((ref.backup_type, ref.backup_id), []).append(ref)
-    for (_t, _b), snaps in sorted(groups.items()):
+    groups: dict[tuple[str, str, str], list[SnapshotRef]] = {}
+    for ref in ds.list_snapshots(all_namespaces=True):
+        groups.setdefault(
+            (ref.namespace, ref.backup_type, ref.backup_id),
+            []).append(ref)
+    for (_ns, _t, _b), snaps in sorted(groups.items()):
         keep = select_keep(snaps, policy)
         for ref in snaps:
             if ref in keep:

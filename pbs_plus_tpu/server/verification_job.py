@@ -24,7 +24,7 @@ def pick_snapshots(server, *, store_filter: str = "",
     """Weighted-random selection by staleness: older unverified snapshots
     first (reference: weighted-random by staleness)."""
     ds = server.datastore.datastore
-    snaps = ds.list_snapshots()
+    snaps = ds.list_snapshots(all_namespaces=True)
     if not snaps:
         return []
     weights = []

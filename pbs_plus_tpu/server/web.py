@@ -267,7 +267,8 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
     def _job_dict(j: database.BackupJobRow) -> dict:
         return {
             "id": j.id, "target": j.target, "source_path": j.source_path,
-            "backup_id": j.backup_id, "schedule": j.schedule,
+            "backup_id": j.backup_id, "namespace": j.namespace,
+            "schedule": j.schedule,
             "retry": j.retry, "retry_interval_s": j.retry_interval_s,
             "exclusions": j.exclusions, "chunker": j.chunker,
             "store": j.store,
@@ -302,6 +303,7 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
             store="pbs" if store_kind == "pbs" else "",
             backup_id=validate.snapshot_component(b["backup_id"])
             if b.get("backup_id") else "",
+            namespace=validate.namespace_path(b.get("namespace", "")),
             schedule=b.get("schedule", ""), retry=int(b.get("retry", 0)),
             retry_interval_s=int(b.get("retry_interval_s", 60)),
             exclusions=list(b.get("exclusions", [])),
@@ -375,9 +377,11 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
     async def snapshots(request):
         ds = server.datastore.datastore
         out = []
-        for ref in ds.list_snapshots():
+        for ref in ds.list_snapshots(all_namespaces=True):
             item = {"snapshot": str(ref), "type": ref.backup_type,
                     "id": ref.backup_id, "time": ref.backup_time}
+            if ref.namespace:
+                item["ns"] = ref.namespace
             try:
                 man = ds.load_manifest(ref)
                 item.update(entries=man.get("entries"),
@@ -928,15 +932,15 @@ echo "  --bootstrap-token <token_id:secret>"
 
     async def snapshot_delete(request):
         from ..pxar.datastore import parse_snapshot_ref
-        snap = "{bt}/{bid}/{ts}".format(
-            bt=request.match_info["bt"], bid=request.match_info["bid"],
-            ts=request.match_info["ts"])
+        # tail match: namespaced refs are ns/a/.../type/id/time — more
+        # than three segments, parsed (and traversal-checked) as a whole
+        snap = request.match_info["snap"]
         try:
             ref = parse_snapshot_ref(snap)
         except ValueError as e:
             return web.json_response({"error": str(e)}, status=400)
         ds = server.datastore.datastore
-        if ref not in ds.list_snapshots():
+        if ref not in ds.list_snapshots(all_namespaces=True):
             return web.json_response({"error": "unknown snapshot"},
                                      status=404)
         async with server._prune_lock:      # never race a GC mark phase
@@ -970,7 +974,7 @@ echo "  --bootstrap-token <token_id:secret>"
     app.router.add_get("/plus/agent/signer.pub", agent_signer_pub)
     app.router.add_get("/plus/ui", ui_page)
     app.router.add_post("/api2/json/d2d/prune", prune_run)
-    app.router.add_delete("/api2/json/d2d/snapshots/{bt}/{bid}/{ts}",
+    app.router.add_delete("/api2/json/d2d/snapshots/{snap:.+}",
                           snapshot_delete)
     app.router.add_get("/api2/json/d2d/snapshot-filetree",
                        snapshot_filetree)

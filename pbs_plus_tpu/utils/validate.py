@@ -48,6 +48,19 @@ def snapshot_component(value: str) -> str:
     return value
 
 
+def namespace_path(value: str, *, max_depth: int = 7) -> str:
+    """A PBS-style namespace ("a/b/c"): each segment a safe component,
+    bounded depth (PBS's own limit is 7).  Empty = root namespace."""
+    if not value:
+        return value
+    parts = value.split("/")
+    if len(parts) > max_depth:
+        raise ValidationError(f"namespace too deep: {value!r}")
+    for p in parts:
+        snapshot_component(p)
+    return value
+
+
 def safe_rel_path(value: str) -> str:
     """Reject traversal / absolute components in archive-relative paths."""
     if value.startswith("/") or "\x00" in value:

@@ -1,0 +1,233 @@
+"""PBS-style namespace battery: `ns/<a>/ns/<b>/type/id/time` grouping
+through the datastore, sessions, prune/GC, and the server job path.
+
+Reference: namespace dirs with backup-user ownership
+(/root/reference/internal/pxarmount/commit_orchestrate.go:307-326
+ensureNamespaceDir — mkdir + chown 34:34 per component) and the ns
+request parameter the PBS protocol carries; SURVEY §7 hard parts lists
+this as part of the drop-in PBS-host surface.
+"""
+
+import asyncio
+import io
+import os
+
+import numpy as np
+import pytest
+
+from pbs_plus_tpu.chunker import ChunkerParams
+from pbs_plus_tpu.pxar import Entry, KIND_DIR, KIND_FILE, LocalStore
+from pbs_plus_tpu.pxar.datastore import parse_snapshot_ref
+from pbs_plus_tpu.server import database
+from pbs_plus_tpu.server.prune import PrunePolicy, run_prune
+
+P = ChunkerParams(avg_size=4 << 10)
+IS_ROOT = getattr(os, "geteuid", lambda: 1)() == 0
+
+
+def _write(store, ns, bid="box", seed=0, t=1_753_750_000):
+    s = store.start_session(backup_type="host", backup_id=bid,
+                            namespace=ns, backup_time=t)
+    s.writer.write_entry(Entry(path="", kind=KIND_DIR))
+    data = np.random.default_rng(seed).integers(
+        0, 256, 50_000, dtype=np.uint8).tobytes()
+    s.writer.write_entry_reader(Entry(path="f.bin", kind=KIND_FILE),
+                                io.BytesIO(data))
+    s.finish()
+    return s.ref, data
+
+
+def test_parse_snapshot_ref_namespaces():
+    r = parse_snapshot_ref("ns/tenant-a/ns/prod/host/web01/"
+                           "2026-01-02T03:04:05Z")
+    assert r.namespace == "tenant-a/prod"
+    assert r.backup_type == "host" and r.backup_id == "web01"
+    assert str(r) == ("ns/tenant-a/ns/prod/host/web01/"
+                      "2026-01-02T03:04:05Z")
+    assert parse_snapshot_ref(str(r)) == r           # round-trip
+    plain = parse_snapshot_ref("host/a/2026-01-02T03:04:05Z")
+    assert plain.namespace == ""
+    for bad in (
+        "ns/../host/a/2026-01-02T03:04:05Z",         # traversal
+        "ns/x/host/a",                               # too few parts
+        "ns/" + "/ns/".join("abcdefgh") + "/host/a/t",   # depth 8 > 7
+        "ns/x/notatype/a/2026-01-02T03:04:05Z",      # bad type
+    ):
+        with pytest.raises(ValueError):
+            parse_snapshot_ref(bad)
+
+
+def test_sessions_group_per_namespace(tmp_path):
+    """auto_previous must scope to the namespace: same type/id in two
+    namespaces are different groups with independent incrementals."""
+    store = LocalStore(str(tmp_path / "ds"), P)
+    ra, data_a = _write(store, "tenant-a", seed=1)
+    rb, data_b = _write(store, "tenant-b", seed=2)
+    r0, data_0 = _write(store, "", seed=3)
+    assert ra.namespace == "tenant-a" and r0.namespace == ""
+    ds = store.datastore
+    assert os.path.isdir(os.path.join(str(tmp_path / "ds"),
+                                      "ns", "tenant-a", "host", "box"))
+    # per-ns listing sees only its own group; all_namespaces sees all
+    assert [r.namespace for r in ds.list_snapshots()] == [""]
+    assert sorted(r.namespace for r in
+                  ds.list_snapshots(all_namespaces=True)) == \
+        ["", "tenant-a", "tenant-b"]
+    assert ds.namespaces() == ["", "tenant-a", "tenant-b"]
+    # incremental within tenant-a links to tenant-a's previous only
+    s2 = store.start_session(backup_type="host", backup_id="box",
+                             namespace="tenant-a",
+                             backup_time=1_753_753_600)
+    assert s2.previous_ref == ra
+    s2.abort()
+    # content readable through the namespaced ref
+    reader = store.open_snapshot(ra)
+    by = {e.path: e for e in reader.entries()}
+    assert reader.read_file(by["f.bin"]) == data_a
+
+
+def test_namespace_validation(tmp_path):
+    store = LocalStore(str(tmp_path / "ds"), P)
+    for bad in ("..", "a/../b", "a//b", "x" * 300,
+                "/".join("abcdefgh")):        # depth 8
+        with pytest.raises(ValueError):
+            store.start_session(backup_type="host", backup_id="b",
+                                namespace=bad)
+
+
+@pytest.mark.skipif(not IS_ROOT, reason="chown needs root")
+def test_pbs_layout_ns_dirs_owned_by_backup_user(tmp_path):
+    """PBS layout: each ns path component is chowned to 34:34 (the PBS
+    `backup` user) so a stock PBS on the host can manage the tree."""
+    store = LocalStore(str(tmp_path / "ds"), P, pbs_format=True)
+    _write(store, "tenant-a/prod", seed=4)
+    nsdir = os.path.join(str(tmp_path / "ds"), "ns", "tenant-a")
+    inner = os.path.join(nsdir, "ns", "prod")
+    assert os.path.isdir(inner)
+    assert os.stat(nsdir).st_uid == 34 and os.stat(nsdir).st_gid == 34
+    assert os.stat(inner).st_uid == 34
+
+
+def test_gc_marks_all_namespaces(tmp_path):
+    """Chunks referenced only by namespaced snapshots must survive a
+    mark-and-sweep — a root-only mark would destroy tenant data."""
+    store = LocalStore(str(tmp_path / "ds"), P)
+    ra, data_a = _write(store, "tenant-a", seed=5)
+    report = run_prune(store.datastore, PrunePolicy(keep_last=10),
+                       gc=True, gc_grace_s=0.0)
+    assert str(ra) in report.kept
+    reader = store.open_snapshot(ra)
+    by = {e.path: e for e in reader.entries()}
+    assert reader.read_file(by["f.bin"]) == data_a     # chunks survived
+
+
+def test_prune_retention_groups_per_namespace(tmp_path):
+    """keep_last=1 keeps the newest snapshot of EACH (ns, type, id)
+    group — namespaces never compete inside one retention group."""
+    store = LocalStore(str(tmp_path / "ds"), P)
+    for ns in ("tenant-a", "tenant-b", ""):
+        for i, t in enumerate((1_753_750_000, 1_753_753_600)):
+            _write(store, ns, seed=10 + i, t=t)
+    report = run_prune(store.datastore, PrunePolicy(keep_last=1),
+                       gc=False, dry_run=False)
+    kept = sorted(report.kept)
+    assert len(kept) == 3 and len(report.removed) == 3
+    assert {parse_snapshot_ref(k).namespace for k in kept} == \
+        {"", "tenant-a", "tenant-b"}
+    for k in kept:       # the newer one survived in every group
+        assert k.endswith("2025-07-29T01:46:40Z"), k
+
+
+def test_web_api_namespace_roundtrip_and_delete(tmp_path):
+    """API surface: the job namespace field round-trips through
+    POST/GET /backup, the ns-aware listing emits it, and the delete
+    route addresses slash-bearing namespaced refs."""
+    async def main():
+        import aiohttp
+
+        from pbs_plus_tpu.server.store import Server, ServerConfig
+        from pbs_plus_tpu.server.web import start_web
+        server = Server(ServerConfig(
+            state_dir=str(tmp_path / "st"), cert_dir=str(tmp_path / "c"),
+            datastore_dir=str(tmp_path / "ds"), chunk_avg=1 << 14,
+            max_concurrent=2))
+        await server.start()
+        runner, port = await start_web(server)
+        base = f"http://127.0.0.1:{port}"
+        sec = os.urandom(12).hex().encode()
+        server.db.put_token("api1", sec, kind="api")
+        hdr = {"Authorization": f"Bearer api1:{sec.decode()}"}
+        src = tmp_path / "s"
+        src.mkdir()
+        (src / "x").write_bytes(b"data")
+        server.db.upsert_target("srv-local", "local", root_path=str(src))
+        try:
+            async with aiohttp.ClientSession() as http:
+                r = await http.post(f"{base}/api2/json/d2d/backup",
+                                    headers=hdr, json={
+                                        "id": "nsj", "target": "srv-local",
+                                        "source_path": str(src),
+                                        "namespace": "tenant-a"})
+                assert r.status == 200
+                r = await http.get(f"{base}/api2/json/d2d/backup",
+                                   headers=hdr)
+                jobs = (await r.json())["data"]
+                assert jobs[0]["namespace"] == "tenant-a"
+                # run it, then list + delete the namespaced snapshot
+                server.enqueue_backup("nsj")
+                await server.jobs.wait("backup:nsj", timeout=60)
+                r = await http.get(f"{base}/api2/json/d2d/snapshots",
+                                   headers=hdr)
+                snaps = (await r.json())["data"]
+                assert snaps and snaps[0]["ns"] == "tenant-a"
+                snap = snaps[0]["snapshot"]
+                assert snap.startswith("ns/tenant-a/")
+                r = await http.delete(
+                    f"{base}/api2/json/d2d/snapshots/{snap}", headers=hdr)
+                assert r.status == 200, await r.text()
+                r = await http.get(f"{base}/api2/json/d2d/snapshots",
+                                   headers=hdr)
+                assert (await r.json())["data"] == []
+        finally:
+            await runner.cleanup()
+            await server.stop()
+    asyncio.run(main())
+
+
+def test_backup_job_with_namespace(tmp_path):
+    """Server job path: a job row carrying namespace publishes into the
+    ns tree, records the full ns ref, and stays incrementally linked."""
+    async def main():
+        from pbs_plus_tpu.server.store import Server, ServerConfig
+        server = Server(ServerConfig(
+            state_dir=str(tmp_path / "st"), cert_dir=str(tmp_path / "c"),
+            datastore_dir=str(tmp_path / "ds"), chunk_avg=1 << 16,
+            max_concurrent=2))
+        await server.start()
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "data.bin").write_bytes(os.urandom(200_000))
+        server.db.upsert_target("srv-local", "local", root_path=str(src))
+        server.db.upsert_backup_job(database.BackupJobRow(
+            id="nsjob", target="srv-local", source_path=str(src),
+            namespace="tenant-a/prod"))
+        server.enqueue_backup("nsjob")
+        await server.jobs.wait("backup:nsjob", timeout=60)
+        row = server.db.get_backup_job("nsjob")
+        assert row.last_status == database.STATUS_SUCCESS, row.last_error
+        assert row.last_snapshot.startswith("ns/tenant-a/ns/prod/host/")
+        ref = parse_snapshot_ref(row.last_snapshot)
+        r = server.datastore.open_snapshot(ref)
+        by = {e.path: e for e in r.entries()}
+        assert r.read_file(by["data.bin"]) == \
+            (src / "data.bin").read_bytes()
+        # second run: incremental against the namespaced previous
+        server.enqueue_backup("nsjob")
+        await server.jobs.wait("backup:nsjob", timeout=60)
+        row2 = server.db.get_backup_job("nsjob")
+        man2 = server.datastore.datastore.load_manifest(
+            parse_snapshot_ref(row2.last_snapshot))
+        assert man2["stats"]["new_chunks"] == 0
+        assert man2["previous"] == row.last_snapshot
+        await server.stop()
+    asyncio.run(main())
