@@ -233,9 +233,23 @@ def _cmd_mount(args: argparse.Namespace) -> int:
     from .pxar import LocalStore
     from .pxar.datastore import parse_snapshot_ref
 
+    if not args.store and not args.pbs_url:
+        raise SystemExit("mount: one of --store / --pbs-url is required")
+
     async def main():
-        store = LocalStore(args.store, ChunkerParams(avg_size=args.chunk_avg),
-                           pbs_format=args.datastore_format == "pbs")
+        params = ChunkerParams(avg_size=args.chunk_avg)
+        if args.pbs_url:
+            # mount + commit straight against a PBS server (the
+            # reference's primary pxar-mount workflow: serve a PBS
+            # snapshot mutable, commit re-snapshots to the same PBS)
+            from .pxar.pbsstore import PBSConfig, PBSStore
+            store = PBSStore(PBSConfig(
+                base_url=args.pbs_url, datastore=args.pbs_datastore,
+                auth_token=args.pbs_token, namespace=args.pbs_namespace,
+                fingerprint=args.pbs_fingerprint), params)
+        else:
+            store = LocalStore(args.store, params,
+                               pbs_format=args.datastore_format == "pbs")
         previous = None
         if args.snapshot:
             previous = parse_snapshot_ref(args.snapshot)
@@ -391,9 +405,16 @@ def main(argv: list[str] | None = None) -> int:
     aj.set_defaults(fn=_cmd_agent_job)
 
     m = sub.add_parser("mount", help="serve a mutable archive mount")
-    m.add_argument("--store", required=True)
+    m.add_argument("--store", default="",
+                   help="local datastore dir (or use --pbs-url)")
     m.add_argument("--snapshot", default="",
-                   help="type/id/time (omit for init mode)")
+                   help="[ns/<n>/...]type/id/time (omit for init mode)")
+    m.add_argument("--pbs-url", default="",
+                   help="mount against a PBS server instead of --store")
+    m.add_argument("--pbs-datastore", default="")
+    m.add_argument("--pbs-token", default="")
+    m.add_argument("--pbs-namespace", default="")
+    m.add_argument("--pbs-fingerprint", default="")
     m.add_argument("--mount-state", required=True)
     m.add_argument("--socket", required=True)
     m.add_argument("--backup-id", default="")

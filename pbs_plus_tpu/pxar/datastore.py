@@ -371,7 +371,8 @@ class SnapshotRef:
         return self.rel_dir
 
 
-MAX_NAMESPACE_DEPTH = 7        # PBS's own namespace depth limit
+MAX_NAMESPACE_DEPTH = validate.MAX_NAMESPACE_DEPTH   # one constant rules
+                                                     # mint + parse limits
 
 
 class Datastore:

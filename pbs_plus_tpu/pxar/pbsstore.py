@@ -599,7 +599,8 @@ class PBSStore:
         index download + digest-addressed chunk fetch) — the LocalStore
         surface the commit engine hot-swaps onto after a commit."""
         source = PBSReaderSource(self.cfg, ref.backup_type, ref.backup_id,
-                                 parse_backup_time(ref.backup_time))
+                                 parse_backup_time(ref.backup_time),
+                                 namespace=ref.namespace or None)
         midx = index_from_bytes(source.download(Datastore.META_IDX))
         pidx = index_from_bytes(source.download(Datastore.PAYLOAD_IDX))
         return SplitReader(midx, pidx, source, **kw)

@@ -88,7 +88,8 @@ class MetricsRegistry:
         per_group: dict[str, int] = {}
         size_per_group: dict[str, int] = {}
         for ref in snaps:
-            key = f"{ref.backup_type}/{ref.backup_id}"
+            # ns-prefixed so tenants' same-named groups never merge
+            key = f"{ref.ns_rel}{ref.backup_type}/{ref.backup_id}"
             per_group[key] = per_group.get(key, 0) + 1
             try:
                 man = s.datastore.datastore.load_manifest(ref)

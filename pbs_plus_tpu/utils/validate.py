@@ -48,13 +48,18 @@ def snapshot_component(value: str) -> str:
     return value
 
 
-def namespace_path(value: str, *, max_depth: int = 7) -> str:
+MAX_NAMESPACE_DEPTH = 7     # PBS's own limit; THE constant — datastore's
+                            # parser re-exports it so mint-time and
+                            # parse-time limits cannot diverge
+
+
+def namespace_path(value: str) -> str:
     """A PBS-style namespace ("a/b/c"): each segment a safe component,
-    bounded depth (PBS's own limit is 7).  Empty = root namespace."""
+    bounded depth.  Empty = root namespace."""
     if not value:
         return value
     parts = value.split("/")
-    if len(parts) > max_depth:
+    if len(parts) > MAX_NAMESPACE_DEPTH:
         raise ValidationError(f"namespace too deep: {value!r}")
     for p in parts:
         snapshot_component(p)

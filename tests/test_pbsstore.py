@@ -338,3 +338,81 @@ def test_finish_requires_closed_writers(pbs):
     with pytest.raises(PBSError):
         http_.call("POST", "/finish")
     http_.close()
+
+
+def test_cli_mount_commit_against_pbs(pbs, tmp_path):
+    """CLI end-to-end: `mount --pbs-url` serves a PBS snapshot through a
+    kernel FUSE mountpoint; an edit through the kernel and a
+    `commit --socket` publish a new snapshot back to the PBS server
+    (the reference's pxar-mount serve/commit workflow, cmd/pxar-mount)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    if not (os.path.exists("/dev/fuse")
+            and os.access("/dev/fuse", os.R_OK | os.W_OK)):
+        pytest.skip("/dev/fuse unavailable")
+
+    rng = np.random.default_rng(23)
+    files = {"keep.bin": rng.integers(0, 256, 120_000,
+                                      dtype=np.uint8).tobytes(),
+             "edit.txt": b"original content\n"}
+    store = _store(pbs)
+    s0 = store.start_session(backup_type="host", backup_id="climc",
+                             backup_time=1_753_750_000)
+    _write_tree(s0, files)
+    s0.finish()
+
+    mp = tmp_path / "mnt"
+    mp.mkdir()
+    sock = str(tmp_path / "ctl.sock")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pbs_plus_tpu", "mount",
+         "--pbs-url", pbs.base_url, "--pbs-datastore", "tank",
+         "--pbs-token", pbs.token, "--snapshot", str(s0.ref),
+         "--mount-state", str(tmp_path / "state"), "--socket", sock,
+         "--chunk-avg", str(PARAMS.avg_size), "--mountpoint", str(mp)],
+        cwd=repo, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = _time.time() + 60
+        while _time.time() < deadline:
+            if (mp / "edit.txt").exists():
+                break
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"mount exited rc={proc.returncode}:\n"
+                    f"{proc.stdout.read()}")
+            _time.sleep(0.2)
+        else:
+            raise AssertionError("mount never became ready")
+        assert (mp / "keep.bin").read_bytes() == files["keep.bin"]
+        # mutate through the kernel
+        (mp / "edit.txt").write_text("EDITED through the kernel\n")
+        (mp / "brand-new").write_bytes(b"hello pbs")
+        r = subprocess.run(
+            [sys.executable, "-m", "pbs_plus_tpu", "commit",
+             "--socket", sock], cwd=repo, env=env,
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert len(pbs.snapshots) == 2
+        new_ref = max(pbs.snapshots)
+        reader = store.open_snapshot(
+            __import__("pbs_plus_tpu.pxar.datastore",
+                       fromlist=["parse_snapshot_ref"]
+                       ).parse_snapshot_ref(new_ref))
+        by = {e.path: e for e in reader.entries()}
+        assert reader.read_file(by["keep.bin"]) == files["keep.bin"]
+        assert reader.read_file(by["edit.txt"]) == \
+            b"EDITED through the kernel\n"
+        assert reader.read_file(by["brand-new"]) == b"hello pbs"
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
