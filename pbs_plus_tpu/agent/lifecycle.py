@@ -54,6 +54,15 @@ class AgentConfig:
     # periodic volume-inventory push over the control session
     # (reference: cmd/agent/main_unix.go:118-148); 0 disables
     drive_update_interval_s: float = 300.0
+    # self-update (reference: internal/agent/{updater,binswap}) — all four
+    # must be set to enable; the signer key is PINNED (fetched once at
+    # install, never over the update channel)
+    update_base_url: str = ""          # server web base URL
+    update_binary_path: str = ""       # the live artifact (.pyz) to swap
+    update_state_dir: str = ""         # staging + rollback markers
+    update_signer_pub: bytes = b""     # Ed25519 release key (PEM)
+    update_interval_s: float = 3600.0  # poll cadence; 0 = RPC-only
+    update_ca_path: str = ""           # CA for the update HTTPS endpoint
 
 
 class AgentLifecycle:
@@ -67,6 +76,7 @@ class AgentLifecycle:
         self.jobs: dict[str, ActiveJob] = {}
         self.conn: MuxConnection | None = None
         self._stop = asyncio.Event()
+        self._update_lock: asyncio.Lock | None = None   # made on first use
         self._register_handlers()
         self.log = L.with_scope(agent=config.hostname)
 
@@ -86,6 +96,7 @@ class AgentLifecycle:
         # reference internal/agent/cli/entry.go:59-79)
         from ..utils.profiling import profile_rpc
         r.handle("profile", profile_rpc)
+        r.handle("update_now", self._update_now)
 
     async def _drives(self, req, ctx):
         from .drives import enumerate_drives
@@ -272,16 +283,121 @@ class AgentLifecycle:
             raise HandlerError(str(e), status=404)
         return {"sha256": digest}
 
+    # -- self-update (reference: internal/agent/updater + binswap) ---------
+    @property
+    def _update_configured(self) -> bool:
+        c = self.config
+        return bool(c.update_base_url and c.update_binary_path
+                    and c.update_state_dir and c.update_signer_pub)
+
+    async def _update_once(self) -> dict:
+        """One poll→verify→stage→swap cycle.  The swapped artifact takes
+        effect on the next service start; the boot-time Watchdog rolls
+        back if the new version never reaches a healthy connect.
+        Serialized: concurrent pushes/poller ticks must never run two
+        swap cycles over one state dir (the second would clobber the
+        rollback copy with the new binary)."""
+        if not self._update_configured:
+            return {"updated": False, "message": "updates not configured"}
+        if self._update_lock is None:
+            self._update_lock = asyncio.Lock()
+        async with self._update_lock:
+            return await self._update_once_locked()
+
+    async def _update_once_locked(self) -> dict:
+        import hashlib
+        import ssl
+
+        import aiohttp
+
+        from .updater import BinSwap, SwapState, Updater
+        c = self.config
+        cur = "unknown"
+        try:
+            with open(c.update_binary_path, "rb") as f:
+                cur = hashlib.sha256(f.read()).hexdigest()[:16]
+        except OSError:
+            pass
+        swap = BinSwap(SwapState(c.update_binary_path, c.update_state_dir))
+        up = Updater(swap, current_version=cur,
+                     signing_pubkey_pem=c.update_signer_pub)
+        connector = None
+        if c.update_ca_path:
+            connector = aiohttp.TCPConnector(
+                ssl=ssl.create_default_context(cafile=c.update_ca_path))
+        try:
+            async with aiohttp.ClientSession(connector=connector) as http:
+                version = await up.check_and_stage(http, c.update_base_url)
+            if version is None:
+                return {"updated": False, "version": cur,
+                        "message": "up to date"}
+            swap.swap()
+            return {"updated": True, "version": version,
+                    "message": "staged + swapped; effective on restart"}
+        except Exception as e:
+            return {"updated": False, "version": cur,
+                    "message": f"update failed: {type(e).__name__}: {e}"}
+
+    async def _update_now(self, req, ctx):
+        """Server-pushed immediate update (reference: push_update.go →
+        the agent's update RPC)."""
+        res = await self._update_once()
+        self.log.info("push update: %s", res["message"])
+        return res
+
+    async def _update_poller(self) -> None:
+        while not self._stop.is_set():
+            await asyncio.sleep(self.config.update_interval_s)
+            res = await self._update_once()
+            if res.get("updated"):
+                self.log.info("auto-update: %s", res["message"])
+            elif "up to date" not in res.get("message", ""):
+                # recurring silent failures would leave the fleet
+                # quietly unpatched — surface every failed cycle
+                self.log.warning("auto-update: %s", res["message"])
+
+    def _update_watchdog_on_boot(self) -> "object | None":
+        """Run the rollback watchdog before the first connect; returns
+        the Watchdog so a healthy connect can commit the update."""
+        if not self._update_configured:
+            return None
+        from .updater import BinSwap, SwapState, Watchdog
+        wd = Watchdog(BinSwap(SwapState(self.config.update_binary_path,
+                                        self.config.update_state_dir)))
+        state = wd.on_boot()
+        if state != "no-pending":
+            self.log.info("update watchdog: %s", state)
+        return wd
+
     # -- connection loop ---------------------------------------------------
     async def run(self) -> None:
         """Reconnect loop with exponential backoff + jitter."""
         backoff = BACKOFF_MIN_S
+        watchdog = self._update_watchdog_on_boot()
+        updater_task = None
+        if self._update_configured and self.config.update_interval_s > 0:
+            updater_task = asyncio.create_task(self._update_poller())
+        try:
+            await self._run_loop(backoff, watchdog)
+        finally:
+            if updater_task is not None:
+                updater_task.cancel()
+                try:
+                    await updater_task
+                except (asyncio.CancelledError, Exception):
+                    pass
+
+    async def _run_loop(self, backoff: float, watchdog) -> None:
         while not self._stop.is_set():
             try:
                 self.conn = await connect_to_server(
                     self.config.server_host, self.config.server_port,
                     self.config.tls)
                 self.log.info("control session connected")
+                if watchdog is not None:
+                    # healthy connect on the new binary: commit the swap
+                    watchdog.mark_healthy()
+                    watchdog = None
                 backoff = BACKOFF_MIN_S
                 pusher = None
                 if self.config.drive_update_interval_s > 0:

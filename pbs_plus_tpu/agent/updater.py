@@ -143,12 +143,19 @@ class Watchdog:
         state = m.get("state")
         if state != "swapped":
             return "no-pending"
-        if time.time() - m.get("swapped_at", 0) > self.grace_s:
+        # the grace clock starts at the FIRST BOOT of the new binary, not
+        # at swap time: a long-running service may swap hours before its
+        # next restart, and that delay says nothing about binary health
+        now = time.time()
+        first_boot = m.get("first_boot_at")
+        if first_boot is not None and now - first_boot > self.grace_s:
             return "rolled-back" if self.swap.rollback() else "rollback-failed"
         boots = m.get("boots", 0) + 1
         if boots >= 3:                      # crash-looping on the new binary
             return "rolled-back" if self.swap.rollback() else "rollback-failed"
         m["boots"] = boots
+        if first_boot is None:
+            m["first_boot_at"] = now
         self.swap._write_marker(m)
         return "grace"
 

@@ -235,6 +235,8 @@ def _cmd_mount(args: argparse.Namespace) -> int:
 
     if not args.store and not args.pbs_url:
         raise SystemExit("mount: one of --store / --pbs-url is required")
+    if args.pbs_url and not args.pbs_datastore:
+        raise SystemExit("mount: --pbs-datastore is required with --pbs-url")
 
     async def main():
         params = ChunkerParams(avg_size=args.chunk_avg)

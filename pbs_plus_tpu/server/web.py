@@ -139,7 +139,8 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
         # static shell whose API calls carry the operator's token
         open_paths = ("/plus/healthz", "/plus/readyz", "/plus/metrics",
                       "/plus/agent/bootstrap", "/plus/agent/renew",
-                      "/plus/agent/install.sh", "/plus/agent/pyz",
+                      "/plus/agent/install.sh", "/plus/agent/install.ps1",
+                      "/plus/agent/pyz",
                       "/plus/agent/binary", "/plus/agent/version",
                       "/plus/agent/signer.pub", "/plus/ui")
         if not require_auth or request.path in open_paths:
@@ -720,6 +721,124 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
             headers={"Content-Disposition":
                      f'attachment; filename="verify-{v["id"]}.csv"'})
 
+    async def verification_aggregate(request):
+        """Fleet-wide verification health in one response (reference:
+        VerificationAggregateHandler, verification_handlers.go:518-551)."""
+        jobs = server.db.list_verification_jobs()
+        agg = {"total_jobs": len(jobs), "passed": 0, "failed": 0,
+               "never_run": 0, "snapshots_checked": 0,
+               "corrupt_files": 0, "last_run_at": None}
+        for v in jobs:
+            if not v.get("last_run_at"):
+                agg["never_run"] += 1
+                continue
+            rep = json.loads(v.get("last_report") or "{}")
+            status = v.get("last_status") or ""
+            agg["passed" if status == database.STATUS_SUCCESS
+                else "failed"] += 1
+            agg["snapshots_checked"] += len(rep.get("snapshots", []))
+            agg["corrupt_files"] += len(rep.get("corrupt", []))
+            if agg["last_run_at"] is None or \
+                    v["last_run_at"] > agg["last_run_at"]:
+                agg["last_run_at"] = v["last_run_at"]
+        return web.json_response({"data": agg})
+
+    async def backup_export_csv(request):
+        """CSV export of every backup job + last-run state (reference:
+        ExtJsBackupCSVExportHandler, export_handlers.go:15-45)."""
+        import csv
+        import io
+        jobs = server.db.list_backup_jobs()
+        if not jobs:
+            return web.Response(status=204)
+        buf = io.StringIO()
+        w = csv.writer(buf)
+        w.writerow(["id", "store", "ns", "target", "source_path",
+                    "schedule", "chunker", "enabled", "last_run_at",
+                    "last_status", "last_error", "last_snapshot"])
+        for j in jobs:
+            w.writerow([j.id, j.store or "local", j.namespace, j.target,
+                        j.source_path, j.schedule, j.chunker,
+                        int(j.enabled), j.last_run_at or "",
+                        j.last_status or "", j.last_error or "",
+                        j.last_snapshot or ""])
+        return web.Response(
+            text=buf.getvalue(), content_type="text/csv",
+            headers={"Content-Disposition":
+                     'attachment; filename="disk-backups.csv"'})
+
+    async def push_update(request):
+        """Push an immediate self-update to connected agents (reference:
+        ExtJsPushUpdateHandler, push_update.go — TargetSvc.PushUpdate
+        fanned out over the agents' update RPC)."""
+        from ..arpc import Session
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        # dedupe: a host with live job sessions appears once per session
+        # in sessions(), and duplicate RPCs would race the agent's swap
+        hostnames = list(dict.fromkeys(
+            body.get("hostnames")
+            or sorted({s.cn for s in server.agents.sessions()})))
+        timeout = float(body.get("timeout") or 30.0)
+
+        async def one(host: str) -> dict:
+            sess = server.agents.get(host)
+            if sess is None:
+                return {"hostname": host, "updated": False,
+                        "message": "agent offline"}
+            try:
+                resp = await Session(sess.conn).call(
+                    "update_now", {}, timeout=timeout)
+                return {"hostname": host, **resp.data}
+            except Exception as e:
+                return {"hostname": host, "updated": False,
+                        "message": f"{type(e).__name__}: {e}"}
+
+        results = await asyncio.gather(*(one(h) for h in hostnames))
+        return web.json_response({
+            "data": list(results),
+            "success": all(r.get("updated") is not False or
+                           "up to date" in r.get("message", "")
+                           for r in results)})
+
+    async def agent_install_ps1(request):
+        """Windows install script (reference: AgentInstallScriptHandler,
+        /plus/agent/install/win) — mirrors install.sh: fetch the pyz +
+        pinned signer key over pinned TLS, register the service."""
+        base = f"https://{request.host}"
+        from cryptography import x509
+
+        from ..utils import mtls as _mtls
+        with open(server.certs.server_cert_path, "rb") as f:
+            fp = _mtls.cert_fingerprint(
+                x509.load_pem_x509_certificate(f.read()))
+        script = f"""# pbs-plus-tpu agent install (Windows)
+$ErrorActionPreference = "Stop"
+$Base = "{base}"
+$Dest = "$Env:ProgramFiles\\pbs-plus-tpu"
+New-Item -ItemType Directory -Force -Path $Dest | Out-Null
+# TLS pin: the server certificate fingerprint is baked into this script
+$ExpectedFp = "{fp}"
+$Handler = [System.Net.Http.HttpClientHandler]::new()
+$Handler.ServerCertificateCustomValidationCallback = {{
+    param($msg, $cert, $chain, $errors)
+    ($cert.GetCertHashString("SHA256").ToLower() -eq $ExpectedFp.ToLower())
+}}
+$Http = [System.Net.Http.HttpClient]::new($Handler)
+foreach ($f in @("pyz", "signer.pub")) {{
+    $out = Join-Path $Dest ($f -replace "pyz", "pbs-plus-tpu-agent.pyz")
+    $bytes = $Http.GetByteArrayAsync("$Base/plus/agent/$f").Result
+    [IO.File]::WriteAllBytes($out, $bytes)
+}}
+Write-Host "installed $Dest\\pbs-plus-tpu-agent.pyz"
+Write-Host "run: py $Dest\\pbs-plus-tpu-agent.pyz agent --server <host>:8008 ``"
+Write-Host "  --bootstrap-url $Base --bootstrap-token <token_id:secret>"
+"""
+        return web.Response(text=script,
+                            content_type="text/x-powershell")
+
     async def alert_settings_get(request):
         return web.json_response({"data": server.db.list_alert_settings()})
 
@@ -964,10 +1083,15 @@ echo "  --bootstrap-token <token_id:secret>"
                        verification_results)
     app.router.add_get("/api2/json/d2d/verification/{id}/export",
                        verification_export)
+    app.router.add_get("/api2/json/d2d/verification-aggregate",
+                       verification_aggregate)
+    app.router.add_get("/api2/json/d2d/backup-export", backup_export_csv)
+    app.router.add_post("/api2/json/d2d/push-update", push_update)
     app.router.add_get("/api2/json/d2d/alert-settings", alert_settings_get)
     app.router.add_post("/api2/json/d2d/alert-settings", alert_settings_put)
     app.router.add_get("/plus/notifications", notifications_list)
     app.router.add_get("/plus/agent/install.sh", agent_install_sh)
+    app.router.add_get("/plus/agent/install.ps1", agent_install_ps1)
     app.router.add_get("/plus/agent/pyz", agent_pyz)
     app.router.add_get("/plus/agent/binary", agent_pyz)   # updater alias
     app.router.add_get("/plus/agent/version", agent_version)

@@ -1,0 +1,176 @@
+"""Push-update + release-channel endpoints.
+
+Reference: ExtJsPushUpdateHandler (push_update.go — server fans an
+immediate update out to agents over their update RPC), the agent's
+updater/binswap poll cycle now wired into the lifecycle, the backup CSV
+export (export_handlers.go), verification aggregate
+(verification_handlers.go:518-551), and the Windows install script
+route (/plus/agent/install/win).
+"""
+
+import asyncio
+import hashlib
+import os
+
+import pytest
+from aiohttp import ClientSession
+
+from pbs_plus_tpu.agent.lifecycle import AgentConfig, AgentLifecycle
+from pbs_plus_tpu.arpc import TlsClientConfig
+from pbs_plus_tpu.server import database
+from pbs_plus_tpu.server.store import Server, ServerConfig
+from pbs_plus_tpu.server.web import start_web
+from pbs_plus_tpu.utils import mtls
+
+
+async def _env(tmp_path, *, agent_updates: bool):
+    server = Server(ServerConfig(
+        state_dir=str(tmp_path / "st"), cert_dir=str(tmp_path / "c"),
+        datastore_dir=str(tmp_path / "ds"), chunk_avg=1 << 16,
+        max_concurrent=2))
+    await server.start()
+    runner, port = await start_web(server)
+    base = f"http://127.0.0.1:{port}"
+
+    tid, secret = server.issue_bootstrap_token()
+    key = mtls.generate_private_key()
+    cert = server.bootstrap_agent("agent-up",
+                                  mtls.make_csr(key, "agent-up"),
+                                  tid, secret)
+    ad = tmp_path / "agent"
+    ad.mkdir()
+    (ad / "a.pem").write_bytes(cert)
+    (ad / "a.key").write_bytes(mtls.key_pem(key))
+
+    kw = {}
+    if agent_updates:
+        # the "running binary": stale bytes, so the server's pyz differs
+        binpath = ad / "agent.pyz"
+        binpath.write_bytes(b"OLD AGENT BINARY")
+        async with ClientSession() as http:
+            pub = await (await http.get(
+                f"{base}/plus/agent/signer.pub")).read()
+        kw = dict(update_base_url=base,
+                  update_binary_path=str(binpath),
+                  update_state_dir=str(ad / "upd"),
+                  update_signer_pub=pub,
+                  update_interval_s=0)       # RPC-only in the test
+    agent = AgentLifecycle(AgentConfig(
+        hostname="agent-up", server_host="127.0.0.1",
+        server_port=server.config.arpc_port,
+        tls=TlsClientConfig(str(ad / "a.pem"), str(ad / "a.key"),
+                            server.certs.ca_cert_path), **kw))
+    task = asyncio.create_task(agent.run())
+    await server.agents.wait_session("agent-up", timeout=10)
+
+    sec = os.urandom(12).hex().encode()
+    server.db.put_token("api1", sec, kind="api")
+    hdr = {"Authorization": f"Bearer api1:{sec.decode()}"}
+    return server, runner, base, hdr, agent, task
+
+
+async def _teardown(server, runner, agent, task):
+    await agent.stop()
+    task.cancel()
+    try:
+        await task
+    except (asyncio.CancelledError, Exception):
+        pass
+    await runner.cleanup()
+    await server.stop()
+
+
+def test_push_update_swaps_agent_binary(tmp_path):
+    """POST /push-update: the agent verifies the Ed25519-signed release,
+    stages, and swaps its artifact — the live file becomes the server's
+    pyz; a second push reports up-to-date."""
+    async def main():
+        server, runner, base, hdr, agent, task = await _env(
+            tmp_path, agent_updates=True)
+        try:
+            async with ClientSession() as http:
+                r = await http.post(f"{base}/api2/json/d2d/push-update",
+                                    headers=hdr,
+                                    json={"hostnames": ["agent-up"]})
+                assert r.status == 200
+                out = (await r.json())["data"]
+                assert out[0]["hostname"] == "agent-up"
+                assert out[0]["updated"] is True, out
+                # the artifact on disk is now the served pyz
+                served = await (await http.get(
+                    f"{base}/plus/agent/pyz")).read()
+                live = open(tmp_path / "agent" / "agent.pyz", "rb").read()
+                assert hashlib.sha256(live).digest() == \
+                    hashlib.sha256(served).digest()
+                assert out[0]["version"] == \
+                    hashlib.sha256(served).hexdigest()[:16]
+                # idempotent second push
+                r = await http.post(f"{base}/api2/json/d2d/push-update",
+                                    headers=hdr, json={})
+                out2 = (await r.json())["data"]
+                assert out2[0]["updated"] is False
+                assert "up to date" in out2[0]["message"]
+        finally:
+            await _teardown(server, runner, agent, task)
+    asyncio.run(main())
+
+
+def test_push_update_unconfigured_and_offline(tmp_path):
+    async def main():
+        server, runner, base, hdr, agent, task = await _env(
+            tmp_path, agent_updates=False)
+        try:
+            async with ClientSession() as http:
+                r = await http.post(
+                    f"{base}/api2/json/d2d/push-update", headers=hdr,
+                    json={"hostnames": ["agent-up", "ghost-host"]})
+                data = {d["hostname"]: d for d in (await r.json())["data"]}
+                assert data["agent-up"]["updated"] is False
+                assert "not configured" in data["agent-up"]["message"]
+                assert data["ghost-host"]["message"] == "agent offline"
+        finally:
+            await _teardown(server, runner, agent, task)
+    asyncio.run(main())
+
+
+def test_export_aggregate_and_ps1(tmp_path):
+    async def main():
+        server, runner, base, hdr, agent, task = await _env(
+            tmp_path, agent_updates=False)
+        try:
+            server.db.upsert_backup_job(database.BackupJobRow(
+                id="csvjob", target="agent-up", source_path="/data",
+                namespace="tenant-a", schedule="daily"))
+            server.db.upsert_verification_job("v1", schedule="weekly")
+            server.db.record_verification_result(
+                "v1", database.STATUS_SUCCESS,
+                {"snapshots": ["host/a/t1", "host/a/t2"], "checked": 9,
+                 "corrupt": []})
+            server.db.upsert_verification_job("v2")
+            async with ClientSession() as http:
+                r = await http.get(f"{base}/api2/json/d2d/backup-export",
+                                   headers=hdr)
+                assert r.status == 200
+                assert r.content_type == "text/csv"
+                body = await r.text()
+                assert "csvjob" in body and "tenant-a" in body
+                r = await http.get(
+                    f"{base}/api2/json/d2d/verification-aggregate",
+                    headers=hdr)
+                agg = (await r.json())["data"]
+                assert agg["total_jobs"] == 2
+                assert agg["passed"] == 1 and agg["never_run"] == 1
+                assert agg["snapshots_checked"] == 2
+                assert agg["corrupt_files"] == 0
+                # windows install script: open route, pinned fingerprint
+                r = await http.get(f"{base}/plus/agent/install.ps1")
+                assert r.status == 200
+                ps1 = await r.text()
+                assert "ExpectedFp" in ps1 and "signer.pub" in ps1
+                from cryptography import x509
+                cert = x509.load_pem_x509_certificate(
+                    open(server.certs.server_cert_path, "rb").read())
+                assert mtls.cert_fingerprint(cert) in ps1
+        finally:
+            await _teardown(server, runner, agent, task)
+    asyncio.run(main())

@@ -50,16 +50,26 @@ def test_stage_swap_commit(tmp_path):
 
 
 def test_watchdog_rollback_on_expired_grace(tmp_path):
+    """Grace is anchored at the FIRST BOOT of the new binary — a swap
+    that sat unbooted for hours is fine (long-running services swap well
+    before their next restart), but a boot that never reaches healthy
+    within the grace window rolls back."""
     live = tmp_path / "agent.bin"
     live.write_bytes(b"v1")
     swap = BinSwap(SwapState(str(live), str(tmp_path / "upd")))
     swap.stage(b"v2-broken", "2.0")
     swap.swap()
-    # simulate: never marked healthy, grace elapsed
+    # a LONG delay between swap and first boot must NOT trigger rollback
     m = json.load(open(tmp_path / "upd" / "pending-update.json"))
-    m["swapped_at"] = time.time() - 3600
+    m["swapped_at"] = time.time() - 7200
     json.dump(m, open(tmp_path / "upd" / "pending-update.json", "w"))
     wd = Watchdog(swap, grace_s=600)
+    assert wd.on_boot() == "grace"             # first boot starts the clock
+    assert live.read_bytes() == b"v2-broken"
+    # boot happened, never marked healthy, grace elapsed → rollback
+    m = json.load(open(tmp_path / "upd" / "pending-update.json"))
+    m["first_boot_at"] = time.time() - 3600
+    json.dump(m, open(tmp_path / "upd" / "pending-update.json", "w"))
     assert wd.on_boot() == "rolled-back"
     assert live.read_bytes() == b"v1"
 
