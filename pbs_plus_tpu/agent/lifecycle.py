@@ -319,6 +319,12 @@ class AgentLifecycle:
         except OSError:
             pass
         swap = BinSwap(SwapState(c.update_binary_path, c.update_state_dir))
+        if swap._marker().get("state") == "swapped":
+            # a swapped-but-never-booted update is the rollback baseline:
+            # swapping again would os.replace the unproven binary over
+            # previous.bin and lose the last KNOWN-GOOD version
+            return {"updated": False, "version": cur,
+                    "message": "update pending restart; not re-swapping"}
         up = Updater(swap, current_version=cur,
                      signing_pubkey_pem=c.update_signer_pub)
         connector = None

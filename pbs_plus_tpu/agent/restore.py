@@ -38,7 +38,7 @@ class RestoreResult:
 class RestoreEngine:
     def __init__(self, client: RemoteArchiveClient, dest: str, *,
                  verify: bool = True, apply_ownership: bool | None = None,
-                 win_meta=None):
+                 win_meta=None, workers: int = 8):
         self.c = client
         self.dest = os.path.abspath(dest)
         self.verify = verify
@@ -56,6 +56,13 @@ class RestoreEngine:
         self.result = RestoreResult()
         self._hardlinks: list[tuple[str, str]] = []
         self._dir_meta: list[tuple[str, Entry]] = []
+        # worker-pooled file pulls (reference: restore.go:22-107 — the
+        # pull loop is RPC-latency-bound on trees of small files; ranged
+        # reads for different files ride concurrent mux streams)
+        self._sem = asyncio.Semaphore(max(1, workers))
+        self._file_tasks: list[asyncio.Task] = []
+        self._peak_inflight = 0        # test/telemetry probe
+        self._inflight = 0
 
     @staticmethod
     def _clear_conflict(path: str) -> None:
@@ -77,10 +84,29 @@ class RestoreEngine:
         return p
 
     async def run(self) -> RestoreResult:
+        try:
+            return await self._run()
+        except BaseException:
+            # cancellation/crash mid-walk: the pool's detached tasks must
+            # not keep writing into dest after the caller stopped us
+            for t in self._file_tasks:
+                t.cancel()
+            await asyncio.gather(*self._file_tasks, return_exceptions=True)
+            self._file_tasks.clear()
+            raise
+
+    async def _run(self) -> RestoreResult:
         root = await self.c.root()
         os.makedirs(self.dest, exist_ok=True)
         self._dir_meta.append((self.dest, root))
         await self._restore_dir("")
+        # drain the file-worker pool before link/metadata phases
+        for t in self._file_tasks:
+            try:
+                await t
+            except Exception as ex:
+                self.result.errors.append(f"{t.get_name()}: {ex}")
+        self._file_tasks.clear()
         # hardlinks after all targets exist (follow_symlinks=False so a
         # hardlink TO a symlink links the symlink itself, not its target)
         for link_rel, target_rel in self._hardlinks:
@@ -130,7 +156,12 @@ class RestoreEngine:
             self._dir_meta.append((path, e))
             await self._restore_dir(rel)
         elif e.kind == KIND_FILE:
-            await self._restore_file(rel, e, path)
+            # schedule on the pool; the semaphore bounds real concurrency
+            # and back-pressures the walk so tasks never pile unbounded
+            await self._sem.acquire()
+            task = asyncio.create_task(self._pull_file(rel, e, path),
+                                       name=rel)
+            self._file_tasks.append(task)
         elif e.kind == KIND_SYMLINK:
             self._clear_conflict(path)
             os.symlink(e.link_target, path)
@@ -153,6 +184,15 @@ class RestoreEngine:
                 self._apply_meta(path, e)
             except OSError as ex:
                 self.result.errors.append(f"{rel}: mknod: {ex}")
+
+    async def _pull_file(self, rel: str, e: Entry, path: str) -> None:
+        self._inflight += 1
+        self._peak_inflight = max(self._peak_inflight, self._inflight)
+        try:
+            await self._restore_file(rel, e, path)
+        finally:
+            self._inflight -= 1
+            self._sem.release()
 
     async def _restore_file(self, rel: str, e: Entry, path: str) -> None:
         h = hashlib.sha256() if (self.verify and e.digest) else None

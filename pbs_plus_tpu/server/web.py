@@ -713,9 +713,10 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
             w.writerow([s])
         if rep.get("corrupt"):
             w.writerow([])
-            w.writerow(["corrupt_file"])
+            w.writerow(["corrupt_snapshot", "corrupt_file"])
             for c in rep["corrupt"]:
-                w.writerow([c])
+                for fpath in c.get("files", []) or [""]:
+                    w.writerow([c.get("snapshot", ""), fpath])
         return web.Response(
             text=buf.getvalue(), content_type="text/csv",
             headers={"Content-Disposition":
@@ -737,7 +738,10 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
             agg["passed" if status == database.STATUS_SUCCESS
                 else "failed"] += 1
             agg["snapshots_checked"] += len(rep.get("snapshots", []))
-            agg["corrupt_files"] += len(rep.get("corrupt", []))
+            # corrupt entries are {"snapshot", "files": [...]} — count
+            # the FILES, not the per-snapshot reports
+            agg["corrupt_files"] += sum(
+                len(c.get("files", [])) for c in rep.get("corrupt", []))
             if agg["last_run_at"] is None or \
                     v["last_run_at"] > agg["last_run_at"]:
                 agg["last_run_at"] = v["last_run_at"]
@@ -824,7 +828,12 @@ $ExpectedFp = "{fp}"
 $Handler = [System.Net.Http.HttpClientHandler]::new()
 $Handler.ServerCertificateCustomValidationCallback = {{
     param($msg, $cert, $chain, $errors)
-    ($cert.GetCertHashString("SHA256").ToLower() -eq $ExpectedFp.ToLower())
+    # SHA-256 over the raw DER: works on .NET Framework (PowerShell 5.1)
+    # too — GetCertHashString("SHA256") is a Core-only overload
+    $sha = [Security.Cryptography.SHA256]::Create()
+    $hex = -join ($sha.ComputeHash($cert.GetRawCertData()) |
+                  ForEach-Object {{ $_.ToString("x2") }})
+    ($hex -eq $ExpectedFp.ToLower())
 }}
 $Http = [System.Net.Http.HttpClient]::new($Handler)
 foreach ($f in @("pyz", "signer.pub")) {{

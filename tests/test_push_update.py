@@ -104,12 +104,24 @@ def test_push_update_swaps_agent_binary(tmp_path):
                     hashlib.sha256(served).digest()
                 assert out[0]["version"] == \
                     hashlib.sha256(served).hexdigest()[:16]
-                # idempotent second push
+                # a second push while the swap awaits its restart must
+                # NOT re-swap (that would clobber the rollback baseline)
                 r = await http.post(f"{base}/api2/json/d2d/push-update",
                                     headers=hdr, json={})
                 out2 = (await r.json())["data"]
                 assert out2[0]["updated"] is False
-                assert "up to date" in out2[0]["message"]
+                assert "pending restart" in out2[0]["message"]
+                # after the watchdog commits (simulated restart cycle),
+                # a push against current bytes reports up-to-date
+                from pbs_plus_tpu.agent.updater import BinSwap, SwapState
+                BinSwap(SwapState(
+                    str(tmp_path / "agent" / "agent.pyz"),
+                    str(tmp_path / "agent" / "upd"))).commit()
+                r = await http.post(f"{base}/api2/json/d2d/push-update",
+                                    headers=hdr, json={})
+                out3 = (await r.json())["data"]
+                assert out3[0]["updated"] is False
+                assert "up to date" in out3[0]["message"]
         finally:
             await _teardown(server, runner, agent, task)
     asyncio.run(main())
