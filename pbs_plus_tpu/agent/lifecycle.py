@@ -355,12 +355,15 @@ class AgentLifecycle:
         while not self._stop.is_set():
             await asyncio.sleep(self.config.update_interval_s)
             res = await self._update_once()
+            msg = res.get("message", "")
             if res.get("updated"):
-                self.log.info("auto-update: %s", res["message"])
-            elif "up to date" not in res.get("message", ""):
+                self.log.info("auto-update: %s", msg)
+            elif ("up to date" not in msg
+                    and "pending restart" not in msg):
                 # recurring silent failures would leave the fleet
-                # quietly unpatched — surface every failed cycle
-                self.log.warning("auto-update: %s", res["message"])
+                # quietly unpatched — surface every failed cycle (but a
+                # healthy swap awaiting restart is not a failure)
+                self.log.warning("auto-update: %s", msg)
 
     def _update_watchdog_on_boot(self) -> "object | None":
         """Run the rollback watchdog before the first connect; returns

@@ -197,17 +197,26 @@ class RestoreEngine:
     async def _restore_file(self, rel: str, e: Entry, path: str) -> None:
         h = hashlib.sha256() if (self.verify and e.digest) else None
         tmp = f"{path}.pbsplus-restore.tmp"
-        with open(tmp, "wb") as f:
-            off = 0
-            while off < e.size:
-                block = await self.c.read_at(rel, off, min(READ_BLOCK,
-                                                           e.size - off))
-                if not block:
-                    raise IOError(f"short read at {off}/{e.size}")
-                f.write(block)
-                if h is not None:
-                    h.update(block)
-                off += len(block)
+        try:
+            with open(tmp, "wb") as f:
+                off = 0
+                while off < e.size:
+                    block = await self.c.read_at(
+                        rel, off, min(READ_BLOCK, e.size - off))
+                    if not block:
+                        raise IOError(f"short read at {off}/{e.size}")
+                    f.write(block)
+                    if h is not None:
+                        h.update(block)
+                    off += len(block)
+        except BaseException:
+            # incl. pool cancellation: a half-written tmp must not
+            # survive as junk in the destination
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         if h is not None:
             if h.digest() != e.digest:
                 os.unlink(tmp)

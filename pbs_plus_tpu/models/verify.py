@@ -22,6 +22,10 @@ from ..ops.sha256 import sha256_chunks, sha256_stream_chunks
 class VerifyResult:
     checked: int = 0
     corrupt: list[int] = field(default_factory=list)   # indexes of failures
+    # archive paths for the corrupt indexes — filled by verify_snapshot
+    # (the sampled set is random, so bare indexes are unactionable in a
+    # stored report; operators need the path)
+    corrupt_paths: list[str] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -68,4 +72,6 @@ class VerifyPipeline:
             idx = np.sort(rng.choice(len(files), size=k, replace=False))
             files = [files[i] for i in idx]
         chunks = [reader.read_file(e) for e in files]
-        return self.verify_chunks(chunks, [e.digest for e in files])
+        res = self.verify_chunks(chunks, [e.digest for e in files])
+        res.corrupt_paths = [files[i].path for i in res.corrupt]
+        return res

@@ -60,7 +60,11 @@ class Scheduler:
     async def tick(self, now: dt.datetime | None = None) -> None:
         now = now or dt.datetime.now()
         for row in self.db.list_backup_jobs(enabled_only=True):
-            if self.jobs.is_active(row.id):
+            # the manager keys backups "backup:<id>" — the bare id never
+            # matches, so this guard silently never fired: each tick over
+            # a still-running job minted a stale queued task row before
+            # the manager's own dedup rejected the duplicate
+            if self.jobs.is_active(f"backup:{row.id}"):
                 continue
             if await self._due_retry(row, now):
                 continue

@@ -104,7 +104,7 @@ async def run_verification(server, v: dict) -> dict:
         report["snapshots"].append(str(ref))
         if not res.ok:
             report["corrupt"].append(
-                {"snapshot": str(ref), "files": res.corrupt})
+                {"snapshot": str(ref), "files": res.corrupt_paths})
         if v.get("check_source"):
             drift = await check_source_drift(server, ref, reader, rng=rng)
             if drift is not None and drift["drifted"]:

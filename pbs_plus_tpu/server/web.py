@@ -801,10 +801,13 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
                         "message": f"{type(e).__name__}: {e}"}
 
         results = await asyncio.gather(*(one(h) for h in hostnames))
+        # "nothing to do" outcomes are successes: already current, or a
+        # prior swap healthy-pending its restart
+        benign = ("up to date", "pending restart")
         return web.json_response({
             "data": list(results),
-            "success": all(r.get("updated") is not False or
-                           "up to date" in r.get("message", "")
+            "success": all(r.get("updated") or
+                           any(b in r.get("message", "") for b in benign)
                            for r in results)})
 
     async def agent_install_ps1(request):

@@ -1,0 +1,182 @@
+"""Scheduler battery against a real sqlite store with a stub enqueue —
+the reference's fake-store suite
+(/root/reference/internal/server/scheduler/scheduler_test.go:10-242):
+missed-slot resume for backups AND verifications, within-window
+behavior, lastEnqueued dedup, per-kind enqueued-state namespacing,
+typed retry policy with interval gating.
+"""
+
+import asyncio
+import datetime as dt
+import time
+
+import pytest
+
+from pbs_plus_tpu.server import database
+from pbs_plus_tpu.server.database import BackupJobRow
+from pbs_plus_tpu.server.jobs import JobsManager
+from pbs_plus_tpu.server.scheduler import Scheduler
+
+
+class Harness:
+    def __init__(self, tmp_path):
+        self.db = database.Database(str(tmp_path / "db.sqlite"))
+        self.jobs = JobsManager(max_concurrent=4)
+        self.backups: list[str] = []
+        self.verifications: list[str] = []
+
+        async def eb(row):
+            self.backups.append(row.id)
+
+        async def ev(v):
+            self.verifications.append(v["id"])
+
+        self.sched = Scheduler(self.db, self.jobs, enqueue_backup=eb,
+                               enqueue_verification=ev)
+
+    def tick(self, now: dt.datetime):
+        asyncio.run(self.sched.tick(now))
+
+
+def _job(h, jid="j1", schedule="02:00", last_run: float | None = None,
+         status: str = database.STATUS_SUCCESS, **kw) -> BackupJobRow:
+    row = BackupJobRow(id=jid, target="t", source_path="/s",
+                       schedule=schedule, **kw)
+    h.db.upsert_backup_job(row)
+    if last_run is not None:
+        with h.db._lock, h.db._conn:
+            h.db._conn.execute(
+                "UPDATE backup_jobs SET last_run_at=?, last_status=? "
+                "WHERE id=?", (last_run, status, jid))
+    return h.db.get_backup_job(jid)
+
+
+def test_missed_slot_resumes_after_downtime(tmp_path):
+    """Server down over the 02:00 slot: the first tick after restart
+    enqueues the missed run (reference:
+    TestShouldRunScheduledBackup_ResumesAfterMissedSlot)."""
+    h = Harness(tmp_path)
+    yesterday_ran = dt.datetime(2026, 7, 28, 2, 0, 5).timestamp()
+    _job(h, schedule="02:00", last_run=yesterday_ran)
+    # restart at 09:17 — hours past the missed 02:00 slot
+    h.tick(dt.datetime(2026, 7, 29, 9, 17, 0))
+    assert h.backups == ["j1"]
+    # and not again on the next tick (lastEnqueued dedup)
+    h.tick(dt.datetime(2026, 7, 29, 9, 17, 30))
+    assert h.backups == ["j1"]
+
+
+def test_within_window_runs_once(tmp_path):
+    h = Harness(tmp_path)
+    _job(h, schedule="02:00",
+         last_run=dt.datetime(2026, 7, 28, 2, 0, 5).timestamp())
+    # tick just before the slot: nothing
+    h.tick(dt.datetime(2026, 7, 29, 1, 59, 40))
+    assert h.backups == []
+    # inside the slot: once
+    h.tick(dt.datetime(2026, 7, 29, 2, 0, 10))
+    h.tick(dt.datetime(2026, 7, 29, 2, 0, 40))
+    assert h.backups == ["j1"]
+
+
+def test_fresh_job_does_not_fire_for_past_slots(tmp_path):
+    """A job created at 09:00 with schedule 02:00 must wait for the NEXT
+    02:00, not immediately replay today's already-past slot."""
+    h = Harness(tmp_path)
+    _job(h, schedule="02:00")              # never ran
+    h.tick(dt.datetime(2026, 7, 29, 9, 0, 0))
+    h.tick(dt.datetime(2026, 7, 29, 9, 0, 30))
+    assert h.backups == []
+    h.tick(dt.datetime(2026, 7, 30, 2, 0, 10))
+    assert h.backups == ["j1"]
+
+
+def test_verification_missed_slot_and_equivalence(tmp_path):
+    """Verifications resume missed slots with the same semantics as
+    backups (reference: TestShouldRunScheduledVerification_* +
+    _BackupAndVerificationEquivalent)."""
+    h = Harness(tmp_path)
+    h.db.upsert_verification_job("v1", schedule="03:00")
+    h.db.record_verification_result("v1", database.STATUS_SUCCESS, {})
+    with h.db._lock, h.db._conn:
+        h.db._conn.execute(
+            "UPDATE verification_jobs SET last_run_at=? WHERE id=?",
+            (dt.datetime(2026, 7, 28, 3, 0, 2).timestamp(), "v1"))
+    h.tick(dt.datetime(2026, 7, 29, 11, 30, 0))
+    assert h.verifications == ["v1"]
+
+
+def test_enqueued_state_namespaced_per_kind(tmp_path):
+    """A backup job and a verification job sharing an id never collide
+    in the dedup/pending state (reference:
+    TestShouldRunScheduled_EnqueuedStateIsNamespaced)."""
+    h = Harness(tmp_path)
+    _job(h, jid="same-id", schedule="02:00",
+         last_run=dt.datetime(2026, 7, 28, 2, 0, 5).timestamp())
+    h.db.upsert_verification_job("same-id", schedule="02:00")
+    h.db.record_verification_result("same-id", database.STATUS_SUCCESS, {})
+    with h.db._lock, h.db._conn:
+        h.db._conn.execute(
+            "UPDATE verification_jobs SET last_run_at=? WHERE id=?",
+            (dt.datetime(2026, 7, 28, 2, 0, 5).timestamp(), "same-id"))
+    h.tick(dt.datetime(2026, 7, 29, 2, 0, 10))
+    assert h.backups == ["same-id"]
+    assert h.verifications == ["same-id"]
+
+
+def test_retry_interval_gates_requeue(tmp_path):
+    """A failed job with retry configured re-enqueues only after the
+    interval elapses (reference: TestShouldRetryBackup_IntervalNotElapsed
+    + _TypedStatus: warnings/cancelled never retry)."""
+    h = Harness(tmp_path)
+    now = time.time()
+    _job(h, jid="rj", schedule="", retry=2, retry_interval_s=3600,
+         last_run=now - 10, status=database.STATUS_ERROR)
+    wall = dt.datetime.now()
+    h.tick(wall)                           # arms the retry clock
+    assert h.backups == []
+    h.tick(wall)                           # interval not elapsed
+    assert h.backups == []
+    h.sched._retry_at["rj"] = time.time() - 1     # elapse it
+    h.tick(wall)
+    assert h.backups == ["rj"]
+    # typed statuses: warnings and cancelled are terminal, not retryable
+    for status in (database.STATUS_WARNING, database.STATUS_CANCELLED,
+                   database.STATUS_SUCCESS):
+        assert not database.should_retry(status)
+    assert database.should_retry(database.STATUS_ERROR)
+
+
+def test_active_job_never_double_enqueued(tmp_path):
+    """A due job whose previous run is STILL ACTIVE is skipped by the
+    scheduler guard itself (not merely deduped downstream, which would
+    mint a stale queued task row per tick).  Regression: the guard
+    checked the bare id while the manager keys jobs 'backup:<id>'."""
+    from pbs_plus_tpu.server.jobs import Job
+    h = Harness(tmp_path)
+    _job(h, schedule="02:00",
+         last_run=dt.datetime(2026, 7, 28, 2, 0, 5).timestamp())
+
+    async def main():
+        release = asyncio.Event()
+
+        async def hold():
+            await release.wait()
+        h.jobs.enqueue(Job(id="backup:j1", execute=hold))
+        await asyncio.sleep(0.01)
+        await h.sched.tick(dt.datetime(2026, 7, 29, 2, 0, 10))
+        release.set()
+        await h.jobs.wait("backup:j1", timeout=5)
+    asyncio.run(main())
+    assert h.backups == []
+
+
+def test_invalid_schedule_skips_job_not_tick(tmp_path):
+    """A malformed calendar expression on one job must not starve the
+    others in the same tick."""
+    h = Harness(tmp_path)
+    _job(h, jid="bad", schedule="not-a-schedule!!")
+    _job(h, jid="good", schedule="02:00",
+         last_run=dt.datetime(2026, 7, 28, 2, 0, 5).timestamp())
+    h.tick(dt.datetime(2026, 7, 29, 2, 0, 10))
+    assert h.backups == ["good"]
