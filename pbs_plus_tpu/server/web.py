@@ -334,6 +334,51 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
             out.append(t)
         return web.json_response({"data": out})
 
+    # target reachability cache (reference: D2DTargetStatusHandler,
+    # targets.go:80-99 — cached statuses, ?refresh=true probes live)
+    target_status_cache: dict[str, dict] = {}
+    server.target_status_cache = target_status_cache    # test probe
+
+    async def _probe_target(t: dict) -> dict:
+        from ..arpc import Session
+        name, kind = t["name"], t["kind"]
+        out = {"name": name, "kind": kind, "checked_at": time.time()}
+        if kind == "agent":
+            sess = server.agents.get(t["hostname"] or name)
+            if sess is None:
+                return {**out, "status": "offline"}
+            try:
+                r = await Session(sess.conn).call(
+                    "target_status",
+                    {"path": t.get("root_path") or "/"}, timeout=10)
+                return {**out,
+                        "status": "online" if r.data.get("ok")
+                        else "path-missing"}
+            except Exception as e:
+                return {**out, "status": f"error: {type(e).__name__}"}
+        if kind == "local":
+            ok = os.path.isdir(t.get("root_path") or "")
+            return {**out, "status": "online" if ok else "path-missing"}
+        if kind == "s3":
+            cfg = t.get("config") or {}
+            ok = all(cfg.get(k) for k in ("endpoint", "bucket",
+                                          "access_key", "secret_key"))
+            return {**out, "status": "configured" if ok
+                    else "misconfigured"}
+        return {**out, "status": "unknown-kind"}
+
+    async def target_status(request):
+        if request.query.get("refresh", "").lower() == "true":
+            results = await asyncio.gather(
+                *(_probe_target(t) for t in server.db.list_targets()))
+            # full rebuild, not upsert: deleted/renamed targets must not
+            # linger as ghost "online" entries
+            target_status_cache.clear()
+            target_status_cache.update({r["name"]: r for r in results})
+        return web.json_response(
+            {"data": sorted(target_status_cache.values(),
+                            key=lambda r: r["name"])})
+
     async def target_upsert(request):
         b = await request.json()
         from ..utils import validate
@@ -647,6 +692,7 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
     # -- breadth routes (judge r1 next#10) --------------------------------
     async def target_delete(request):
         server.db.delete_target(request.match_info["name"])
+        target_status_cache.pop(request.match_info["name"], None)
         return web.json_response({"ok": True})
 
     async def script_list(request):
@@ -1099,6 +1145,7 @@ echo "  --bootstrap-token <token_id:secret>"
                        verification_aggregate)
     app.router.add_get("/api2/json/d2d/backup-export", backup_export_csv)
     app.router.add_post("/api2/json/d2d/push-update", push_update)
+    app.router.add_get("/api2/json/d2d/target-status", target_status)
     app.router.add_get("/api2/json/d2d/alert-settings", alert_settings_get)
     app.router.add_post("/api2/json/d2d/alert-settings", alert_settings_put)
     app.router.add_get("/plus/notifications", notifications_list)

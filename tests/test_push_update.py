@@ -145,6 +145,49 @@ def test_push_update_unconfigured_and_offline(tmp_path):
     asyncio.run(main())
 
 
+def test_target_status_cache_and_refresh(tmp_path):
+    """GET /target-status serves the cache; ?refresh=true probes every
+    target kind live (reference: D2DTargetStatusHandler, targets.go:80-99
+    — connected agent path probe, local dir check, s3 config check)."""
+    async def main():
+        server, runner, base, hdr, agent, task = await _env(
+            tmp_path, agent_updates=False)
+        try:
+            okdir = tmp_path / "exists"
+            okdir.mkdir()
+            server.db.upsert_target("agent-up", "agent",
+                                    hostname="agent-up", root_path="/")
+            server.db.upsert_target("ghost", "agent", hostname="ghost")
+            server.db.upsert_target("disk-ok", "local",
+                                    root_path=str(okdir))
+            server.db.upsert_target("disk-gone", "local",
+                                    root_path=str(tmp_path / "nope"))
+            server.db.upsert_target("cloud", "s3", config={
+                "endpoint": "e", "bucket": "b",
+                "access_key": "a", "secret_key": "s"})
+            async with ClientSession() as http:
+                # empty cache before any refresh
+                r = await http.get(f"{base}/api2/json/d2d/target-status",
+                                   headers=hdr)
+                assert (await r.json())["data"] == []
+                r = await http.get(
+                    f"{base}/api2/json/d2d/target-status?refresh=true",
+                    headers=hdr)
+                by = {d["name"]: d["status"]
+                      for d in (await r.json())["data"]}
+                assert by == {"agent-up": "online", "ghost": "offline",
+                              "disk-ok": "online",
+                              "disk-gone": "path-missing",
+                              "cloud": "configured"}
+                # cache persists without refresh
+                r = await http.get(f"{base}/api2/json/d2d/target-status",
+                                   headers=hdr)
+                assert len((await r.json())["data"]) == 5
+        finally:
+            await _teardown(server, runner, agent, task)
+    asyncio.run(main())
+
+
 def test_export_aggregate_and_ps1(tmp_path):
     async def main():
         server, runner, base, hdr, agent, task = await _env(
