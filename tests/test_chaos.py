@@ -1,0 +1,178 @@
+"""Concurrent-everything chaos battery: the whole control plane under
+simultaneous load — the race-detection scenario class of SURVEY §5.2
+(the reference runs its full suite under `go test -race`; asyncio has
+no race detector, so this drives every subsystem against every other
+and asserts clean completion + datastore integrity instead).
+
+One server; three live agents; concurrently: three agent backups, a
+local-target backup, prune+GC, a verification run, push-update fan-out,
+target-status refreshes, metrics scrapes, and snapshot listings.  Then:
+every job succeeded, every snapshot's content verifies, GC removed
+nothing live, and a follow-up incremental still links.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+from aiohttp import ClientSession
+
+from pbs_plus_tpu.agent.lifecycle import AgentConfig, AgentLifecycle
+from pbs_plus_tpu.arpc import TlsClientConfig
+from pbs_plus_tpu.server import database
+from pbs_plus_tpu.server.store import Server, ServerConfig
+from pbs_plus_tpu.server.web import start_web
+from pbs_plus_tpu.utils import mtls
+
+N_AGENTS = 3
+
+
+async def _mk_agent(server, tmp_path, name):
+    tid, secret = server.issue_bootstrap_token()
+    key = mtls.generate_private_key()
+    cert = server.bootstrap_agent(name, mtls.make_csr(key, name),
+                                  tid, secret)
+    ad = tmp_path / name
+    ad.mkdir()
+    (ad / "a.pem").write_bytes(cert)
+    (ad / "a.key").write_bytes(mtls.key_pem(key))
+    agent = AgentLifecycle(AgentConfig(
+        hostname=name, server_host="127.0.0.1",
+        server_port=server.config.arpc_port,
+        tls=TlsClientConfig(str(ad / "a.pem"), str(ad / "a.key"),
+                            server.certs.ca_cert_path)))
+    task = asyncio.create_task(agent.run())
+    await server.agents.wait_session(name, timeout=10)
+    return agent, task
+
+
+def test_chaos_concurrent_control_plane(tmp_path):
+    async def main():
+        server = Server(ServerConfig(
+            state_dir=str(tmp_path / "st"), cert_dir=str(tmp_path / "c"),
+            datastore_dir=str(tmp_path / "ds"), chunk_avg=1 << 14,
+            max_concurrent=8))
+        await server.start()
+        runner, port = await start_web(server)
+        base = f"http://127.0.0.1:{port}"
+        sec = os.urandom(12).hex().encode()
+        server.db.put_token("api1", sec, kind="api")
+        hdr = {"Authorization": f"Bearer api1:{sec.decode()}"}
+
+        agents = [await _mk_agent(server, tmp_path, f"chaos-{i}")
+                  for i in range(N_AGENTS)]
+        rng = np.random.default_rng(77)
+
+        # sources: per-agent trees + a local-target tree; a seed backup
+        # first so the chaos round exercises incremental paths too
+        jobs = []
+        for i in range(N_AGENTS):
+            src = tmp_path / f"src-{i}"
+            (src / "sub").mkdir(parents=True)
+            for j in range(12):
+                (src / "sub" / f"f{j:02d}.bin").write_bytes(
+                    rng.integers(0, 256, 60_000, dtype=np.uint8)
+                    .tobytes())
+            server.db.upsert_backup_job(database.BackupJobRow(
+                id=f"job-{i}", target=f"chaos-{i}", source_path=str(src),
+                backup_id=f"box-{i}"))
+            jobs.append(f"job-{i}")
+        lsrc = tmp_path / "local-src"
+        lsrc.mkdir()
+        (lsrc / "l.bin").write_bytes(
+            rng.integers(0, 256, 300_000, dtype=np.uint8).tobytes())
+        server.db.upsert_target("srv-local", "local", root_path=str(lsrc))
+        server.db.upsert_backup_job(database.BackupJobRow(
+            id="job-local", target="srv-local", source_path=str(lsrc)))
+        jobs.append("job-local")
+        server.db.upsert_verification_job("v-chaos", sample_rate=1.0)
+
+        for j in jobs:                       # seed round (sequential)
+            server.enqueue_backup(j)
+        for j in jobs:
+            await server.jobs.wait(f"backup:{j}", timeout=120)
+
+        # mutate every tree so the chaos round has new content
+        for i in range(N_AGENTS):
+            (tmp_path / f"src-{i}" / "sub" / "new.bin").write_bytes(
+                rng.integers(0, 256, 80_000, dtype=np.uint8).tobytes())
+        (lsrc / "l2.bin").write_bytes(b"fresh" * 1000)
+
+        # --- the chaos round: everything at once ---------------------
+        from pbs_plus_tpu.server.verification_job import run_verification
+
+        async def api_noise():
+            async with ClientSession() as http:
+                for _ in range(10):
+                    r = await http.get(
+                        f"{base}/api2/json/d2d/target-status"
+                        f"?refresh=true", headers=hdr)
+                    assert r.status == 200
+                    r = await http.get(f"{base}/plus/metrics")
+                    assert r.status == 200
+                    r = await http.get(f"{base}/api2/json/d2d/snapshots",
+                                       headers=hdr)
+                    assert r.status == 200
+                    r = await http.post(
+                        f"{base}/api2/json/d2d/push-update",
+                        headers=hdr, json={})
+                    assert r.status == 200
+                    await asyncio.sleep(0.02)
+
+        async def prune_noise():
+            async with ClientSession() as http:
+                for _ in range(3):
+                    r = await http.post(f"{base}/api2/json/d2d/prune",
+                                        headers=hdr,
+                                        json={"keep_last": 10,
+                                              "gc": True})
+                    assert r.status == 200, await r.text()
+                    await asyncio.sleep(0.05)
+
+        for j in jobs:
+            assert server.enqueue_backup(j)
+        results = await asyncio.gather(
+            *(server.jobs.wait(f"backup:{j}", timeout=180) for j in jobs),
+            run_verification(server, {"id": "v-chaos", "sample_rate": 1.0,
+                                      "store": ""}),
+            api_noise(), prune_noise(),
+            return_exceptions=True)
+        errs = [r for r in results if isinstance(r, BaseException)]
+        assert errs == [], errs
+
+        # --- aftermath: everything consistent ------------------------
+        for j in jobs:
+            row = server.db.get_backup_job(j)
+            assert row.last_status == database.STATUS_SUCCESS, \
+                (j, row.last_error)
+        # every snapshot's full content re-verifies (GC removed nothing
+        # live, chaos-round writes are complete)
+        from pbs_plus_tpu.models.verify import VerifyPipeline
+        from pbs_plus_tpu.pxar.transfer import SplitReader
+        vp = VerifyPipeline()
+        ds = server.datastore.datastore
+        snaps = ds.list_snapshots(all_namespaces=True)
+        assert len(snaps) >= 2 * len(jobs)
+        for ref in snaps:
+            r = SplitReader.open_snapshot(ds, ref)
+            res = vp.verify_snapshot(r, sample_rate=1.0)
+            assert res.ok, (str(ref), res.corrupt_paths)
+        # incremental chain still links: one more run dedups fully
+        server.enqueue_backup("job-local")
+        await server.jobs.wait("backup:job-local", timeout=60)
+        from pbs_plus_tpu.pxar.datastore import parse_snapshot_ref
+        row = server.db.get_backup_job("job-local")
+        man = ds.load_manifest(parse_snapshot_ref(row.last_snapshot))
+        assert man["stats"]["new_chunks"] == 0
+
+        for agent, task in agents:
+            await agent.stop()
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        await runner.cleanup()
+        await server.stop()
+    asyncio.run(main())
