@@ -159,8 +159,14 @@ class RestoreEngine:
             # schedule on the pool; the semaphore bounds real concurrency
             # and back-pressures the walk so tasks never pile unbounded
             await self._sem.acquire()
-            task = asyncio.create_task(self._pull_file(rel, e, path),
-                                       name=rel)
+            try:
+                task = asyncio.create_task(self._pull_file(rel, e, path),
+                                           name=rel)
+            except BaseException:
+                # permit must not leak if task construction fails or the
+                # coroutine is cancelled between acquire and create_task
+                self._sem.release()
+                raise
             self._file_tasks.append(task)
         elif e.kind == KIND_SYMLINK:
             self._clear_conflict(path)

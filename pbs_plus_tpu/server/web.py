@@ -338,6 +338,10 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
     # targets.go:80-99 — cached statuses, ?refresh=true probes live)
     target_status_cache: dict[str, dict] = {}
     server.target_status_cache = target_status_cache    # test probe
+    # ?refresh=true fans out live probes (10s RPC timeout per agent); a
+    # stampede of concurrent refreshes must share ONE probe pass
+    status_flight = SingleFlight()
+    server.status_flight = status_flight                # test probe
 
     async def _probe_target(t: dict) -> dict:
         from ..arpc import Session
@@ -369,12 +373,16 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
 
     async def target_status(request):
         if request.query.get("refresh", "").lower() == "true":
-            results = await asyncio.gather(
-                *(_probe_target(t) for t in server.db.list_targets()))
-            # full rebuild, not upsert: deleted/renamed targets must not
-            # linger as ghost "online" entries
-            target_status_cache.clear()
-            target_status_cache.update({r["name"]: r for r in results})
+
+            async def _refresh_all():
+                results = await asyncio.gather(
+                    *(_probe_target(t) for t in server.db.list_targets()))
+                # full rebuild, not upsert: deleted/renamed targets must
+                # not linger as ghost "online" entries
+                target_status_cache.clear()
+                target_status_cache.update({r["name"]: r for r in results})
+
+            await status_flight.do("target-status", _refresh_all)
         return web.json_response(
             {"data": sorted(target_status_cache.values(),
                             key=lambda r: r["name"])})
@@ -826,12 +834,26 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
             body = await request.json()
         except Exception:
             body = {}
+        req_hosts = body.get("hostnames")
+        if req_hosts is not None and not (
+                isinstance(req_hosts, list)
+                and all(isinstance(h, str) for h in req_hosts)):
+            return web.json_response(
+                {"error": "hostnames must be a list of strings"},
+                status=400)
+        try:
+            timeout = float(body.get("timeout") or 30.0)
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "timeout must be a number"}, status=400)
+        timeout = min(max(timeout, 1.0), 300.0)
         # dedupe: a host with live job sessions appears once per session
-        # in sessions(), and duplicate RPCs would race the agent's swap
+        # in sessions(), and duplicate RPCs would race the agent's swap.
+        # An explicit [] means "push to nobody", not "push fleet-wide" —
+        # only an absent field selects all connected agents.
         hostnames = list(dict.fromkeys(
-            body.get("hostnames")
-            or sorted({s.cn for s in server.agents.sessions()})))
-        timeout = float(body.get("timeout") or 30.0)
+            req_hosts if req_hosts is not None
+            else sorted({s.cn for s in server.agents.sessions()})))
 
         async def one(host: str) -> dict:
             sess = server.agents.get(host)
@@ -859,7 +881,9 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
     async def agent_install_ps1(request):
         """Windows install script (reference: AgentInstallScriptHandler,
         /plus/agent/install/win) — mirrors install.sh: fetch the pyz +
-        pinned signer key over pinned TLS, register the service."""
+        pinned signer key over pinned TLS; with -Server (and optionally
+        -BootstrapToken) it also registers + starts the NT service via
+        sc.exe, otherwise it prints the manual run command."""
         base = f"https://{request.host}"
         from cryptography import x509
 
@@ -868,6 +892,10 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
             fp = _mtls.cert_fingerprint(
                 x509.load_pem_x509_certificate(f.read()))
         script = f"""# pbs-plus-tpu agent install (Windows)
+param(
+    [string]$Server = "",
+    [string]$BootstrapToken = ""
+)
 $ErrorActionPreference = "Stop"
 $Base = "{base}"
 $Dest = "$Env:ProgramFiles\\pbs-plus-tpu"
@@ -891,8 +919,25 @@ foreach ($f in @("pyz", "signer.pub")) {{
     [IO.File]::WriteAllBytes($out, $bytes)
 }}
 Write-Host "installed $Dest\\pbs-plus-tpu-agent.pyz"
-Write-Host "run: py $Dest\\pbs-plus-tpu-agent.pyz agent --server <host>:8008 ``"
-Write-Host "  --bootstrap-url $Base --bootstrap-token <token_id:secret>"
+if ($Server) {{
+    # register as an NT service (mirror of agent/win/service.py install():
+    # auto-start + failure restarts), then start it.  New-Service passes
+    # $BinPath to CreateService verbatim — PS 5.1's native-arg quoting
+    # would mangle sc.exe create's embedded quotes around Program Files.
+    $BinPath = "py `"$Dest\\pbs-plus-tpu-agent.pyz`" agent --server $Server" +
+               " --bootstrap-url $Base" +
+               $(if ($BootstrapToken) {{ " --bootstrap-token $BootstrapToken" }} else {{ "" }})
+    New-Service -Name PBSPlusTPUAgent -BinaryPathName $BinPath `
+        -StartupType Automatic -DisplayName "PBS Plus TPU Agent" | Out-Null
+    sc.exe failure PBSPlusTPUAgent reset= 86400 `
+        actions= restart/5000/restart/30000/restart/60000 | Out-Null
+    Start-Service PBSPlusTPUAgent
+    Write-Host "service PBSPlusTPUAgent registered and started"
+}} else {{
+    Write-Host "run: py $Dest\\pbs-plus-tpu-agent.pyz agent --server <host>:8008 ``"
+    Write-Host "  --bootstrap-url $Base --bootstrap-token <token_id:secret>"
+    Write-Host "(re-run with -Server <host>:8008 to register the NT service)"
+}}
 """
         return web.Response(text=script,
                             content_type="text/x-powershell")

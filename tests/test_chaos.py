@@ -127,7 +127,9 @@ def test_chaos_concurrent_control_plane(tmp_path):
                                         headers=hdr,
                                         json={"keep_last": 10,
                                               "gc": True})
-                    assert r.status == 200, await r.text()
+                    # 409 "prune deferred: N job(s) active" is the
+                    # correct answer while the chaos backups run
+                    assert r.status in (200, 409), await r.text()
                     await asyncio.sleep(0.05)
 
         for j in jobs:

@@ -229,3 +229,74 @@ def test_export_aggregate_and_ps1(tmp_path):
         finally:
             await _teardown(server, runner, agent, task)
     asyncio.run(main())
+
+
+def test_push_update_body_validation(tmp_path):
+    """Advisor r3: a JSON string for hostnames must not iterate
+    per-character into bogus RPC targets, and timeout must be numeric
+    and clamped — bad input is a 400, not a 500."""
+    async def main():
+        server, runner, base, hdr, agent, task = await _env(
+            tmp_path, agent_updates=False)
+        try:
+            async with ClientSession() as http:
+                for bad in ("agent-up", 7, {"host": "x"}, [1, 2],
+                            ["ok", None]):
+                    r = await http.post(
+                        f"{base}/api2/json/d2d/push-update", headers=hdr,
+                        json={"hostnames": bad})
+                    assert r.status == 400, (bad, await r.text())
+                r = await http.post(
+                    f"{base}/api2/json/d2d/push-update", headers=hdr,
+                    json={"timeout": "soon"})
+                assert r.status == 400
+                # huge timeout is clamped, not honored
+                r = await http.post(
+                    f"{base}/api2/json/d2d/push-update", headers=hdr,
+                    json={"hostnames": ["ghost"], "timeout": 1e12})
+                assert r.status == 200
+        finally:
+            await _teardown(server, runner, agent, task)
+    asyncio.run(main())
+
+
+def test_target_status_refresh_stampede_coalesces(tmp_path):
+    """Advisor r3: concurrent ?refresh=true requests share ONE probe
+    pass through the server's SingleFlight instead of each fanning out
+    live probes."""
+    async def main():
+        server, runner, base, hdr, agent, task = await _env(
+            tmp_path, agent_updates=False)
+        try:
+            for i in range(4):
+                server.db.upsert_target(f"t{i}", "local",
+                                        root_path="/nope")
+            # deterministic: hold a flight open on the handler's key so
+            # every request MUST join it (no timing dependence on how
+            # fast the local-dir probes complete)
+            gate = asyncio.Event()
+
+            async def held_refresh():
+                await gate.wait()
+
+            holder = asyncio.ensure_future(
+                server.status_flight.do("target-status", held_refresh))
+            await asyncio.sleep(0)          # flight registered
+            assert server.status_flight.in_flight("target-status")
+            async with ClientSession() as http:
+                reqs = [asyncio.ensure_future(
+                    http.get(f"{base}/api2/json/d2d/target-status"
+                             f"?refresh=true", headers=hdr))
+                    for _ in range(8)]
+                await asyncio.sleep(0.2)    # all 8 block on the flight
+                gate.set()
+                rs = await asyncio.gather(*reqs)
+                assert all(r.status == 200 for r in rs)
+            await holder
+            st = server.status_flight.stats
+            assert st["calls"] == 9         # holder + 8 requests
+            assert st["executions"] == 1    # the held flight only
+            assert st["shared"] == 8
+        finally:
+            await _teardown(server, runner, agent, task)
+    asyncio.run(main())
