@@ -115,9 +115,10 @@ class CommitEngine:
                 # changed/new content: stream from the passthrough dir
                 p = os.path.join(self.fs.passthrough, n.content_path)
                 with open(p, "rb") as f:
-                    writer.write_entry_reader(e, f)
+                    d = writer.write_entry_reader(e, f)
                 self.progress.changed_files += 1
                 self._changed_paths.append(rel)
+                self._changed_digests[rel] = d
             elif n.base_path is not None:
                 self._ref_or_reencode(writer, prev_entries, e, n.base_path)
             else:
@@ -159,9 +160,10 @@ class CommitEngine:
                 data = self.fs.view.read_file(
                     self.fs.view.lookup(arch_path))  # type: ignore[arg-type]
                 import io
-                writer.write_entry_reader(e, io.BytesIO(data))
+                d = writer.write_entry_reader(e, io.BytesIO(data))
                 self.progress.changed_files += 1
                 self._changed_paths.append(e.path)
+                self._changed_digests[e.path] = d
 
     # -- the commit --------------------------------------------------------
     def commit(self) -> SnapshotRef:
@@ -189,6 +191,9 @@ class CommitEngine:
             try:
                 prog.emit("walk")
                 self._changed_paths = []
+                # write-time digests: pxar2 archives carry none in the
+                # meta stream, so post-publish verify needs them here
+                self._changed_digests = {}
                 root = fs.journal.get_node(ROOT_ID)
                 assert root is not None
                 session.writer.write_entry(self._entry_from_node(root, ""))
@@ -277,9 +282,17 @@ class CommitEngine:
         passthrough-backed files, so commit cost stays O(changed bytes),
         with peak memory bounded by VERIFY_BATCH_BYTES per dispatch)."""
         changed = set(getattr(self, "_changed_paths", []))
+        digests = getattr(self, "_changed_digests", {})
         vp = VerifyPipeline()
-        entries = [e for e in reader.entries()
-                   if e.is_file and e.size and e.digest and e.path in changed]
+        entries = []
+        for e in reader.entries():
+            if not (e.is_file and e.size and e.path in changed):
+                continue
+            # the archive entry's digest when present (tpxar), else the
+            # digest recorded at write time (pxar2 has no digest field)
+            e.digest = e.digest or digests.get(e.path, b"")
+            if e.digest:
+                entries.append(e)
         # verify reads every changed chunk exactly once — the reader's
         # big serving cache would just retain them all; cap it for the
         # duration so commit peak stays ~2x the batch ceiling

@@ -57,6 +57,9 @@ class BackupSession:
             payload_params=store.params,
             chunker_factory=chunker_factory,
             batch_hasher=store.batch_hasher,
+            # PBS layout ⇒ stock pxar v2 entries so PBS tools can decode
+            # the archive content too, not just serve its chunks/indexes
+            entry_codec="pxar2" if store.datastore.pbs_format else "tpxar",
         )
         store.datastore.ensure_group_dir(ref)   # ns chain (PBS chown 34)
         self._final_dir = store.datastore.snapshot_dir(ref)

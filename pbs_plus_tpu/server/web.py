@@ -841,11 +841,14 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
             return web.json_response(
                 {"error": "hostnames must be a list of strings"},
                 status=400)
+        import math
         try:
             timeout = float(body.get("timeout") or 30.0)
         except (TypeError, ValueError):
+            timeout = None
+        if timeout is None or not math.isfinite(timeout):
             return web.json_response(
-                {"error": "timeout must be a number"}, status=400)
+                {"error": "timeout must be a finite number"}, status=400)
         timeout = min(max(timeout, 1.0), 300.0)
         # dedupe: a host with live job sessions appears once per session
         # in sessions(), and duplicate RPCs would race the agent's swap.

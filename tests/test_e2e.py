@@ -236,10 +236,14 @@ def test_backup_job_pushes_to_pbs(env, tmp_path):
             assert len(pbs.snapshots) == 1
             ref = next(iter(pbs.snapshots))
             from pbs_plus_tpu.pxar.datastore import Datastore
-            payload = pbs.read_stream(ref, Datastore.PAYLOAD_IDX)
-            # archive DFS order: a.bin then b.txt
-            want = (src / "a.bin").read_bytes() + \
-                (src / "b.txt").read_bytes()
+            from pbs_plus_tpu.pxar.pxarv2 import (
+                payload_header, payload_start_marker)
+            payload = pbs.read_stream(ref, Datastore.PAYLOAD_IDX_PBS)
+            # archive DFS order: a.bin then b.txt, pxar2-wrapped
+            a = (src / "a.bin").read_bytes()
+            b = (src / "b.txt").read_bytes()
+            want = (payload_start_marker() + payload_header(len(a)) + a +
+                    payload_header(len(b)) + b)
             assert payload == want
             # nothing landed in the local datastore
             assert server.datastore.datastore.list_snapshots() == []

@@ -303,8 +303,13 @@ def test_mtf_to_pbs_with_inventory(tmp_path):
         assert res.files == 2 and res.entries >= 4
 
         ref = next(iter(pbs.snapshots))
-        payload = pbs.read_stream(ref, Datastore.PAYLOAD_IDX)
-        assert payload == tree["acme/db.bak"] + tree["acme/logs/app.log"]
+        from pbs_plus_tpu.pxar.pxarv2 import (
+            payload_header, payload_start_marker)
+        payload = pbs.read_stream(ref, Datastore.PAYLOAD_IDX_PBS)
+        a, b = tree["acme/db.bak"], tree["acme/logs/app.log"]
+        assert payload == (payload_start_marker() +
+                           payload_header(len(a)) + a +
+                           payload_header(len(b)) + b)
 
         inv = CartridgeInventory(str(tmp_path / "tapes.db"))
         inv.record_dataset("LTO007", "acme", file_mark=0, snapshot=ref,

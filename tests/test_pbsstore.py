@@ -14,6 +14,8 @@ from pbs_plus_tpu.pxar.format import Entry, KIND_DIR, KIND_FILE
 from pbs_plus_tpu.pxar.pbsstore import (
     PBSConfig, PBSError, PBSStore, index_csum,
 )
+from pbs_plus_tpu.pxar.pbsformat import blob_decode
+from pbs_plus_tpu.pxar.pxarv2 import payload_header, payload_start_marker
 
 from mock_pbs import MockPBS
 
@@ -32,16 +34,25 @@ def _store(pbs, **kw) -> PBSStore:
                               auth_token=pbs.token), PARAMS, **kw)
 
 
+def _wrapped(files: dict[str, bytes]) -> bytes:
+    """The stock pxar2 payload stream for a flat sorted tree: start
+    marker + per-file payload item header + raw bytes."""
+    out = bytearray(payload_start_marker())
+    for name in sorted(files):
+        out += payload_header(len(files[name])) + files[name]
+    return bytes(out)
+
+
 def _write_tree(session, files: dict[str, bytes]) -> bytes:
-    """Write a root dir + files (sorted), return concatenated payload."""
+    """Write a root dir + files (sorted), return the expected (pxar2-
+    wrapped) payload stream."""
     session.writer.write_entry(Entry(path="", kind=KIND_DIR, mode=0o755))
-    payload = bytearray()
     for name in sorted(files):
         session.writer.write_entry_reader(
-            Entry(path=name, kind=KIND_FILE, mode=0o644),
+            Entry(path=name, kind=KIND_FILE, mode=0o644,
+                  size=len(files[name])),
             io.BytesIO(files[name]))
-        payload += files[name]
-    return bytes(payload)
+    return _wrapped(files)
 
 
 def test_session_uploads_and_registers_snapshot(pbs):
@@ -59,12 +70,18 @@ def test_session_uploads_and_registers_snapshot(pbs):
     ref = next(iter(pbs.snapshots))
     assert ref.startswith("host/web-01/")
     # payload reconstruction from the server's chunk store is bit-exact
-    assert pbs.read_stream(ref, Datastore.PAYLOAD_IDX) == payload
-    # manifest blob round-trips
+    assert pbs.read_stream(ref, Datastore.PAYLOAD_IDX_PBS) == payload
+    # manifest blob: DataBlob-encoded BackupManifest under the stock
+    # name, internal manifest riding in unprotected
     import json
-    man = json.loads(pbs.snapshots[ref]["blobs"][Datastore.MANIFEST])
-    assert man["backup_id"] == "web-01" and man["job"] == "j1"
-    assert man["payload_size"] == len(payload)
+    man = json.loads(blob_decode(
+        pbs.snapshots[ref]["blobs"][Datastore.MANIFEST_PBS]))
+    assert man["backup-id"] == "web-01"
+    assert {f["filename"] for f in man["files"]} == \
+        {Datastore.META_IDX_PBS, Datastore.PAYLOAD_IDX_PBS}
+    inner = man["unprotected"]["tpu-plus"]
+    assert inner["backup_id"] == "web-01" and inner["job"] == "j1"
+    assert inner["payload_size"] == len(payload)
     assert manifest["entries"] == len(files) + 1
     assert s.sink.uploaded_chunks > 0
 
@@ -145,8 +162,8 @@ def test_ref_splice_unchanged_files_zero_reencode(pbs):
     # the spliced snapshot reconstructs bit-identically on the server
     ref2 = max(pbs.snapshots)
     assert ref2 != ref1
-    want = b"".join(files[n] for n in sorted(files))
-    assert pbs.read_stream(ref2, Datastore.PAYLOAD_IDX) == want
+    want = _wrapped(files)
+    assert pbs.read_stream(ref2, Datastore.PAYLOAD_IDX_PBS) == want
 
     # a changed file mid-tree: only boundary/changed bytes re-encode
     files2 = dict(files)
@@ -173,8 +190,8 @@ def test_ref_splice_unchanged_files_zero_reencode(pbs):
     # only the changed file (+ possible splice-boundary bytes) streamed
     assert st3.bytes_streamed < len(files2["f2.bin"]) + 2 * (1 << 16)
     ref3 = max(pbs.snapshots)
-    want3 = b"".join(files2[n] for n in sorted(files2))
-    assert pbs.read_stream(ref3, Datastore.PAYLOAD_IDX) == want3
+    want3 = _wrapped(files2)
+    assert pbs.read_stream(ref3, Datastore.PAYLOAD_IDX_PBS) == want3
 
 
 def test_mount_commit_against_pbs_target(pbs, tmp_path):
@@ -307,10 +324,12 @@ def test_wire_sequence_golden(pbs):
     log = pbs.request_log
     assert log[0].startswith("GET /api2/json/backup?")
     assert "backup-id=100" in log[0] and "backup-type=vm" in log[0]
-    # previous-manifest probe (404 on a first backup) precedes writers
-    assert log[1].startswith("GET /previous?")
-    assert log[2] == "POST /dynamic_index"       # root.midx wid
-    assert log[3] == "POST /dynamic_index"       # root.pidx wid
+    # previous-manifest probe (stock name, then the round-3 legacy
+    # fallback; 404 on a first backup) precedes writers
+    assert log[1] == "GET /previous?archive-name=index.json.blob"
+    assert log[2] == "GET /previous?archive-name=manifest.json"
+    assert log[3] == "POST /dynamic_index"       # root.mpxar.didx wid
+    assert log[4] == "POST /dynamic_index"       # root.ppxar.didx wid
     # chunk uploads carry wid/digest/size/encoded-size
     chunk_reqs = [l for l in log if l.startswith("POST /dynamic_chunk?")]
     assert chunk_reqs and all("digest=" in l and "encoded-size=" in l
@@ -318,7 +337,7 @@ def test_wire_sequence_golden(pbs):
     # both indexes appended then closed, then manifest blob, then finish
     assert log.count("PUT /dynamic_index") >= 2
     assert log.count("POST /dynamic_close") == 2
-    assert any(l.startswith("POST /blob?") and "manifest.json" in l
+    assert any(l.startswith("POST /blob?") and "index.json.blob" in l
                for l in log)
     assert log[-1] == "POST /finish"
 

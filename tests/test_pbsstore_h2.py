@@ -44,12 +44,14 @@ def _store(bridge, mock, **kw) -> PBSStore:
 
 def _write_tree(session, files: dict[str, bytes]) -> bytes:
     session.writer.write_entry(Entry(path="", kind=KIND_DIR, mode=0o755))
-    payload = bytearray()
+    from pbs_plus_tpu.pxar.pxarv2 import (
+        payload_header, payload_start_marker)
+    payload = bytearray(payload_start_marker())
     for name in sorted(files):
         session.writer.write_entry_reader(
             Entry(path=name, kind=KIND_FILE, mode=0o644),
             io.BytesIO(files[name]))
-        payload += files[name]
+        payload += payload_header(len(files[name])) + files[name]
     return bytes(payload)
 
 
@@ -71,7 +73,7 @@ def test_h2_backup_session_end_to_end(bridged):
     assert bridge.upgrades >= 1
     ref = max(mock.snapshots)
     assert ref.startswith("host/h2-01/")
-    assert mock.read_stream(ref, Datastore.PAYLOAD_IDX) == payload
+    assert mock.read_stream(ref, Datastore.PAYLOAD_IDX_PBS) == payload
     assert s.sink.uploaded_chunks > 0
 
 
@@ -178,4 +180,4 @@ def test_h2_stream_error_does_not_kill_session(bridged):
     payload = _write_tree(s, {"x.bin": data})
     s.finish()
     ref = max(mock.snapshots)
-    assert mock.read_stream(ref, Datastore.PAYLOAD_IDX) == payload
+    assert mock.read_stream(ref, Datastore.PAYLOAD_IDX_PBS) == payload

@@ -300,3 +300,21 @@ def test_target_status_refresh_stampede_coalesces(tmp_path):
         finally:
             await _teardown(server, runner, agent, task)
     asyncio.run(main())
+
+
+def test_push_update_nan_timeout_rejected(tmp_path):
+    """float('nan') parses but must not reach the RPC timeout (NaN
+    poisons the event-loop timer heap) — 400 like any bad input."""
+    async def main():
+        server, runner, base, hdr, agent, task = await _env(
+            tmp_path, agent_updates=False)
+        try:
+            async with ClientSession() as http:
+                for bad in ("nan", "inf", "-inf"):
+                    r = await http.post(
+                        f"{base}/api2/json/d2d/push-update", headers=hdr,
+                        json={"timeout": bad})
+                    assert r.status == 400, bad
+        finally:
+            await _teardown(server, runner, agent, task)
+    asyncio.run(main())
