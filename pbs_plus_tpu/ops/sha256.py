@@ -194,6 +194,10 @@ def _digests_to_bytes(d: np.ndarray) -> list[bytes]:
 
 _dispatch_count = 0      # device-batch dispatches (integration-test probe)
 
+# multi-chip dispatch evidence (test/metrics probe), mirror of
+# rolling_hash.stats: bumped when a bucket shards over the data mesh
+stats = {"mesh_dispatches": 0, "mesh_devices": 0}
+
 
 def sha256_stream_chunks(stream, bounds: list[tuple[int, int]], *,
                          max_batch: int = 4096,
@@ -224,18 +228,43 @@ def sha256_stream_chunks(stream, bounds: list[tuple[int, int]], *,
     for i, nb in enumerate(nblocks):
         t = 1 << int(nb - 1).bit_length() if nb > 1 else 1
         buckets.setdefault(t, []).append(i)
+    # multi-chip: shard each bucket's rows over the data mesh (stream
+    # replicated, per-row slices local); buckets narrower than the mesh
+    # stay single-device
+    from ..parallel.mesh import data_mesh
+    mesh = data_mesh()
+    mesh_sharding = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh_sharding = (NamedSharding(mesh, P("data")),
+                         NamedSharding(mesh, P()))
+    dstream_rep = None        # stream replicated over the mesh, once
     out: list[bytes | None] = [None] * len(bounds)
     for t_max, idxs in sorted(buckets.items()):
         for lo in range(0, len(idxs), max_batch):
             part = idxs[lo:lo + max_batch]
             n = len(part)
             n_pad = max(8, 1 << (n - 1).bit_length())
+            if mesh is not None and n_pad >= mesh.size:
+                # row axis must divide evenly over the mesh
+                n_pad = ((n_pad + mesh.size - 1)
+                         // mesh.size) * mesh.size
             bs = np.zeros(n_pad, dtype=np.int32)
             bl = np.zeros(n_pad, dtype=np.int32)
             bs[:n] = starts[part]
             bl[:n] = lens[part]
-            dig = np.asarray(_sha256_scan(dstream, jnp.asarray(bs),
-                                          jnp.asarray(bl), t_max,
+            dbs, dbl = jnp.asarray(bs), jnp.asarray(bl)
+            ds = dstream
+            if mesh_sharding is not None and n_pad >= mesh.size:
+                row_s, rep_s = mesh_sharding
+                dbs = jax.device_put(dbs, row_s)
+                dbl = jax.device_put(dbl, row_s)
+                if dstream_rep is None:
+                    dstream_rep = jax.device_put(dstream, rep_s)
+                ds = dstream_rep
+                stats["mesh_dispatches"] += 1
+                stats["mesh_devices"] = mesh.size
+            dig = np.asarray(_sha256_scan(ds, dbs, dbl, t_max,
                                           unroll=unroll, assume_padded=True))
             for k, i in enumerate(part):
                 out[i] = dig[k].astype(">u4").tobytes()
