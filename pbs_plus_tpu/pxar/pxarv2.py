@@ -166,7 +166,25 @@ _ACL_VERSION = 2
 _ACL_ENT = struct.Struct("<HHI")
 _TAG_USER_OBJ, _TAG_USER, _TAG_GROUP_OBJ = 0x01, 0x02, 0x04
 _TAG_GROUP, _TAG_MASK, _TAG_OTHER = 0x08, 0x10, 0x20
-_ID_UNSET = 0xFFFFFFFF
+_ID_UNSET = 0xFFFFFFFF          # u32 ACL_UNDEFINED_ID in the xattr blob
+# The stock pxar crate marks an absent permission slot in the u64 fields
+# of PXAR_ACL_DEFAULT with u64::MAX ("NO_MASK"), not u32::MAX.
+_PERM_UNSET = 0xFFFFFFFFFFFFFFFF
+
+
+def _checked_perm(perm: int) -> int:
+    """Validate a decoded ACL permission fits the u16 xattr field so a
+    malformed stock archive raises ValueError instead of struct.error."""
+    if not 0 <= perm <= 0xFFFF:
+        raise ValueError(f"ACL permission out of u16 range: {perm:#x}")
+    return perm
+
+
+def _checked_id(eid: int) -> int:
+    """Validate a decoded uid/gid fits the u32 xattr id field."""
+    if not 0 <= eid <= 0xFFFFFFFF:
+        raise ValueError(f"ACL uid/gid out of u32 range: {eid:#x}")
+    return eid
 
 
 def _parse_posix_acl(raw: bytes) -> list[tuple[int, int, int]] | None:
@@ -213,7 +231,7 @@ def _acl_items_from_xattr(raw: bytes, default: bool) -> list[bytes]:
                 "<Q", by_tag[_TAG_GROUP_OBJ][0][1])))
     else:
         def _perm(tag: int) -> int:
-            return by_tag[tag][0][1] if tag in by_tag else _ID_UNSET
+            return by_tag[tag][0][1] if tag in by_tag else _PERM_UNSET
         items.append(item(PXAR_ACL_DEFAULT, struct.pack(
             "<QQQQ", _perm(_TAG_USER_OBJ), _perm(_TAG_GROUP_OBJ),
             _perm(_TAG_OTHER), _perm(_TAG_MASK))))
@@ -238,20 +256,21 @@ class _AclAssembler:
     def feed(self, htype: int, payload: bytes) -> bool:
         if htype == PXAR_ACL_USER:
             eid, perm = struct.unpack("<QQ", payload)
-            self.access.append((_TAG_USER, perm, eid))
+            self.access.append((_TAG_USER, _checked_perm(perm), _checked_id(eid)))
         elif htype == PXAR_ACL_GROUP:
             eid, perm = struct.unpack("<QQ", payload)
-            self.access.append((_TAG_GROUP, perm, eid))
+            self.access.append((_TAG_GROUP, _checked_perm(perm), _checked_id(eid)))
         elif htype == PXAR_ACL_GROUP_OBJ:
             (self.group_obj,) = struct.unpack("<Q", payload)
+            self.group_obj = _checked_perm(self.group_obj)
         elif htype == PXAR_ACL_DEFAULT:
             self.default_head = struct.unpack("<QQQQ", payload)
         elif htype == PXAR_ACL_DEFAULT_USER:
             eid, perm = struct.unpack("<QQ", payload)
-            self.default.append((_TAG_USER, perm, eid))
+            self.default.append((_TAG_USER, _checked_perm(perm), _checked_id(eid)))
         elif htype == PXAR_ACL_DEFAULT_GROUP:
             eid, perm = struct.unpack("<QQ", payload)
-            self.default.append((_TAG_GROUP, perm, eid))
+            self.default.append((_TAG_GROUP, _checked_perm(perm), _checked_id(eid)))
         else:
             return False
         return True
@@ -271,14 +290,14 @@ class _AclAssembler:
             ents = []
             if self.default_head is not None:
                 uo, go, ot, mask = self.default_head
-                if uo != _ID_UNSET:
-                    ents.append((_TAG_USER_OBJ, uo, _ID_UNSET))
-                if go != _ID_UNSET:
-                    ents.append((_TAG_GROUP_OBJ, go, _ID_UNSET))
-                if ot != _ID_UNSET:
-                    ents.append((_TAG_OTHER, ot, _ID_UNSET))
-                if mask != _ID_UNSET:
-                    ents.append((_TAG_MASK, mask, _ID_UNSET))
+                if uo != _PERM_UNSET:
+                    ents.append((_TAG_USER_OBJ, _checked_perm(uo), _ID_UNSET))
+                if go != _PERM_UNSET:
+                    ents.append((_TAG_GROUP_OBJ, _checked_perm(go), _ID_UNSET))
+                if ot != _PERM_UNSET:
+                    ents.append((_TAG_OTHER, _checked_perm(ot), _ID_UNSET))
+                if mask != _PERM_UNSET:
+                    ents.append((_TAG_MASK, _checked_perm(mask), _ID_UNSET))
             ents += self.default
             xattrs[_XATTR_ACL_DEFAULT] = _build_posix_acl(ents)
 

@@ -35,6 +35,7 @@ from typing import Callable, Iterator
 
 from ..chunker import ChunkerParams, CpuChunker
 from ..chunker import spec as _spec
+from ..utils.log import L
 from .datastore import ChunkStore, Datastore, DynamicIndex, SnapshotRef
 from .format import Entry, KIND_DIR, KIND_FILE, decode_entries
 from .pxarv2 import (
@@ -57,6 +58,7 @@ class WriterStats:
     bytes_streamed: int = 0        # bytes that went through the chunker
     bytes_reffed: int = 0          # bytes covered by reused chunks
     bytes_reencoded: int = 0       # ref boundary bytes that were re-read
+    size_mismatch_files: int = 0   # streams shorter/longer than stat size
 
     def merge(self, other: "WriterStats") -> None:
         for f in self.__dataclass_fields__:
@@ -216,6 +218,9 @@ class SessionWriter:
         self._last_path: str | None = None
         self._entries = 0
         self._finished = False
+        # per-file divergence reports (size mismatches etc.) for the
+        # caller's session stats / task log
+        self.file_errors: list[str] = []
 
     # -- entry emission ---------------------------------------------------
     @staticmethod
@@ -247,6 +252,11 @@ class SessionWriter:
         self._check_order(entry)
         if entry.kind == KIND_FILE and entry.size:
             raise ValueError("file with content must use write_entry_reader")
+        if self._codec is not None and entry.kind == KIND_FILE:
+            # pxar2: even an empty file owns a real zero-length PAYLOAD
+            # item so its ref validates under a stock accessor
+            self._write_file_pxar2(entry, io.BytesIO(b""), 1 << 16)
+            return
         self._emit_meta(entry)
         self._entries += 1
 
@@ -306,21 +316,36 @@ class SessionWriter:
                 declared = 0
         hdr_off = self.payload.offset
         h = hashlib.sha256()
-        if declared:
-            self.payload.write(payload_header(declared))
-            remaining = declared
-            while remaining > 0:
-                block = reader.read(min(bufsize, remaining))
-                if not block:
-                    block = b"\0" * min(bufsize, remaining)   # short stream
-                block = block[:remaining]
-                h.update(block)
-                self.payload.write(block)
-                remaining -= len(block)
+        # A zero-length file still gets a real PAYLOAD item so the ref
+        # points at a validatable header, matching the stock encoder
+        # (r4 advisor: REF(0,0) aimed at the start marker instead).
+        self.payload.write(payload_header(declared))
+        short = False
+        remaining = declared
+        while remaining > 0:
+            block = reader.read(min(bufsize, remaining))
+            if not block:
+                short = True
+                block = b"\0" * min(bufsize, remaining)
+            block = block[:remaining]
+            h.update(block)
+            self.payload.write(block)
+            remaining -= len(block)
+        long_tail = bool(reader.read(1))
+        if short or long_tail:
+            # file changed size mid-backup: the declared stat size stays
+            # authoritative for the archive, but the divergence must be
+            # visible — warn and count it as the stock client does
+            self.payload.stats.size_mismatch_files += 1
+            self.file_errors.append(
+                f"{entry.path}: stream {'shorter' if short else 'longer'} "
+                f"than declared size {declared} (content "
+                f"{'zero-padded' if short else 'truncated'})")
+            L.warning("pxar2 size mismatch: %s", self.file_errors[-1])
         entry.size = declared
-        entry.payload_offset = (hdr_off + PAYLOAD_HDR_SIZE) if declared else -1
+        entry.payload_offset = hdr_off + PAYLOAD_HDR_SIZE
         entry.digest = h.digest()
-        self._emit_meta(entry, (hdr_off, declared) if declared else None)
+        self._emit_meta(entry, (hdr_off, declared))
         self._entries += 1
         return entry.digest
 
