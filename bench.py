@@ -77,6 +77,61 @@ def _cpu_baseline(mib: int = 256) -> dict:
     return out
 
 
+class _NullStore:
+    """insert/touch sink: benchmarks the writer orchestration without
+    disk/compression cost (every chunk is 'new')."""
+
+    def insert(self, digest, data, *, verify=True):
+        return True
+
+    def touch(self, digest):
+        pass
+
+
+def _pipeline_bench(mib: int = 256) -> dict:
+    """Writer-loop pipeline benchmark: the same stream through the
+    sequential ``_ChunkedStream`` and the pipelined ``PipelinedStream``
+    (scan ∥ sha256 ∥ insert, pxar/pipeline.py) against a no-op store.
+
+    Emits ``pipelined chunk+fingerprint MiB/s`` alongside the
+    single-thread ``cpu.mib_s`` figure.  The parity gate asserts
+    bit-identical (end_offset, digest) records — identical chunk
+    boundaries and digest sets, so dedup ratio cannot drift."""
+    import numpy as np
+    from pbs_plus_tpu.chunker import ChunkerParams
+    from pbs_plus_tpu.pxar.pipeline import PipelinedStream
+    from pbs_plus_tpu.pxar.transfer import _ChunkedStream
+
+    params = ChunkerParams(avg_size=4 << 20)
+    data = np.random.default_rng(0).integers(
+        0, 256, mib << 20, dtype=np.uint8).tobytes()
+    block = 8 << 20
+    workers = max(1, min(8, os.cpu_count() or 1))
+
+    def run(make):
+        s = make()
+        t0 = time.perf_counter()
+        for i in range(0, len(data), block):
+            s.write(data[i:i + block])
+        rec = s.finish()
+        return rec, time.perf_counter() - t0
+
+    rec_seq, dt_seq = run(lambda: _ChunkedStream(_NullStore(), params))
+    rec_pipe, dt_pipe = run(lambda: PipelinedStream(
+        _NullStore(), params, workers=workers))
+    if rec_seq != rec_pipe:
+        raise AssertionError("pipelined records diverged from sequential")
+    return {
+        "metric": "pipelined chunk+fingerprint MiB/s",
+        "pipelined_mib_s": round(mib / dt_pipe, 1),
+        "writer_seq_mib_s": round(mib / dt_seq, 1),
+        "workers": workers,
+        "cores": os.cpu_count(),
+        "chunks": len(rec_pipe),
+        "parity": True,
+    }
+
+
 from pbs_plus_tpu.utils.jaxdev import probe_relay  # shared tunnel probe
 
 
@@ -354,6 +409,16 @@ def main() -> None:
     # the captured path above carries its own baseline — only the live
     # paths pay for the 256 MiB single-core baseline run
     cpu = _cpu_baseline()
+    try:
+        pipe = _pipeline_bench()
+        pipe["vs_cpu_single_thread"] = round(
+            pipe["pipelined_mib_s"] / cpu["mib_s"], 2)
+    except AssertionError:
+        raise      # records divergence is a correctness failure, not
+                   # a missing-capability note — fail the bench loudly
+    except Exception as e:
+        sys.stderr.write(f"[bench] pipeline bench unavailable: {e}\n")
+        pipe = None
     if tpu is not None:
         value = tpu["mib_s"]
         result = {
@@ -375,6 +440,9 @@ def main() -> None:
                        "cpu": cpu, "probe": probe_diag,
                        "relay_watch": _watcher_summary()},
         }
+    if pipe is not None:
+        result["pipelined_mib_s"] = pipe["pipelined_mib_s"]
+        result["detail"]["pipeline"] = pipe
     print(json.dumps(result))
 
 

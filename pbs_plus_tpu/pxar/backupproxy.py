@@ -44,7 +44,8 @@ class BackupSession:
 
     def __init__(self, store: "LocalStore", ref: SnapshotRef,
                  previous: SnapshotRef | None,
-                 chunker_factory: ChunkerFactory):
+                 chunker_factory: ChunkerFactory,
+                 pipeline_workers: int | None = None):
         self.store = store
         self.ref = ref
         self.previous_ref = previous
@@ -57,6 +58,9 @@ class BackupSession:
             payload_params=store.params,
             chunker_factory=chunker_factory,
             batch_hasher=store.batch_hasher,
+            pipeline_workers=(getattr(store, "pipeline_workers", 0)
+                              if pipeline_workers is None
+                              else pipeline_workers),
             # PBS layout ⇒ stock pxar v2 entries so PBS tools can decode
             # the archive content too, not just serve its chunks/indexes
             entry_codec="pxar2" if store.datastore.pbs_format else "tpxar",
@@ -115,6 +119,10 @@ class BackupSession:
             os.replace(self._tmp_dir, self._final_dir)
         except BaseException:
             self._done = True
+            try:
+                self.writer.close()    # reap pipeline threads; _done=True
+            except Exception:          # makes a later abort() a no-op
+                pass
             shutil.rmtree(self._tmp_dir, ignore_errors=True)
             raise
         self._done = True
@@ -143,6 +151,10 @@ class BackupSession:
     def abort(self) -> None:
         if not self._done:
             self._done = True
+            try:
+                self.writer.close()    # park pipeline pool + committer
+            except Exception:
+                pass
             shutil.rmtree(self._tmp_dir, ignore_errors=True)
 
 
@@ -152,17 +164,22 @@ class LocalStore:
 
     def __init__(self, base_dir: str, params: ChunkerParams, *,
                  chunker_factory: ChunkerFactory = _default_chunker_factory,
-                 batch_hasher=None, pbs_format: bool = False):
+                 batch_hasher=None, pbs_format: bool = False,
+                 pipeline_workers: int = 0):
         self.datastore = Datastore(base_dir, pbs_format=pbs_format)
         self.params = params
         self._chunker_factory = chunker_factory
         self.batch_hasher = batch_hasher
+        # >=1 pipelines each session's payload stream (pxar/pipeline.py);
+        # 0 keeps the sequential writer (cut/digest output is identical)
+        self.pipeline_workers = pipeline_workers
 
     def start_session(self, *, backup_type: str, backup_id: str,
                       backup_time: float | None = None,
                       previous: SnapshotRef | PreviousBackupRef | None = None,
                       auto_previous: bool = True,
-                      namespace: str | None = None) -> BackupSession:
+                      namespace: str | None = None,
+                      pipeline_workers: int | None = None) -> BackupSession:
         """Open a session.  ``previous`` enables ref-dedup against that
         snapshot; by default the latest snapshot of the same group (same
         ``namespace``) is used.  Same-second collisions bump the timestamp
@@ -203,7 +220,8 @@ class LocalStore:
             t += 1.0
             ref = dataclasses.replace(ref,
                                       backup_time=format_backup_time(t))
-        return BackupSession(self, ref, previous, self._chunker_factory)
+        return BackupSession(self, ref, previous, self._chunker_factory,
+                             pipeline_workers=pipeline_workers)
 
     def open_snapshot(self, ref: SnapshotRef, **kw) -> SplitReader:
         return SplitReader.open_snapshot(self.datastore, ref, **kw)

@@ -29,7 +29,10 @@ from dataclasses import dataclass
 from typing import Iterator
 
 import numpy as np
-import zstandard
+try:
+    import zstandard
+except ImportError:                 # image lacks the wheel; ctypes shim
+    from ..utils import zstdshim as zstandard
 
 from ..utils import validate
 

@@ -43,7 +43,10 @@ import struct
 import zlib
 from dataclasses import dataclass
 
-import zstandard
+try:
+    import zstandard
+except ImportError:                 # image lacks the wheel; ctypes shim
+    from ..utils import zstdshim as zstandard
 
 # -- published PBS magics (see module docstring for provenance) -----------
 DYNAMIC_INDEX_MAGIC = bytes([28, 145, 78, 165, 25, 186, 179, 205])

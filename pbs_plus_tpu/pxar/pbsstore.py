@@ -55,7 +55,10 @@ import urllib.parse
 from dataclasses import dataclass, field
 
 import numpy as np
-import zstandard
+try:
+    import zstandard
+except ImportError:                 # image lacks the wheel; ctypes shim
+    from ..utils import zstdshim as zstandard
 
 from ..chunker import ChunkerParams
 from ..utils import validate
@@ -442,7 +445,8 @@ class PBSBackupSession:
     def __init__(self, store: "PBSStore", ref: SnapshotRef,
                  http_: _PBSHttp, known: set[bytes],
                  chunker_factory: ChunkerFactory,
-                 previous: "object | None" = None):
+                 previous: "object | None" = None,
+                 pipeline_workers: int | None = None):
         self.store = store
         self.ref = ref
         self._http = http_
@@ -464,6 +468,9 @@ class PBSBackupSession:
             payload_params=store.params,
             chunker_factory=chunker_factory,
             batch_hasher=store.batch_hasher,
+            pipeline_workers=(getattr(store, "pipeline_workers", 0)
+                              if pipeline_workers is None
+                              else pipeline_workers),
             # a PBS target always gets stock pxar v2 entries + split
             # archive names so stock tools can browse/restore (round-3
             # judge finding: msgpack entries were the last compat gap)
@@ -536,6 +543,10 @@ class PBSBackupSession:
             self._http.call("POST", "/finish")
         except BaseException:
             self._done = True
+            try:
+                self.writer.close()    # reap pipeline threads; _done=True
+            except Exception:          # makes a later abort() a no-op
+                pass
             self._close_reader()
             self._http.close()         # dropping the session aborts it
             raise
@@ -597,6 +608,10 @@ class PBSBackupSession:
     def abort(self) -> None:
         if not self._done:
             self._done = True
+            try:
+                self.writer.close()    # park pipeline pool + committer
+            except Exception:
+                pass
             self._close_reader()
             self._http.close()         # no /finish → server discards
 
@@ -607,11 +622,12 @@ class PBSStore:
 
     def __init__(self, cfg: PBSConfig, params: ChunkerParams, *,
                  chunker_factory: ChunkerFactory = _default_chunker_factory,
-                 batch_hasher=None):
+                 batch_hasher=None, pipeline_workers: int = 0):
         self.cfg = cfg
         self.params = params
         self._chunker_factory = chunker_factory
         self.batch_hasher = batch_hasher
+        self.pipeline_workers = pipeline_workers
 
     def open_snapshot(self, ref: SnapshotRef, **kw):
         """SplitReader over a published PBS snapshot (reader session:
@@ -657,7 +673,8 @@ class PBSStore:
     def start_session(self, *, backup_type: str, backup_id: str,
                       backup_time: float | None = None,
                       previous=None, auto_previous: bool = True,
-                      namespace: str | None = None) -> PBSBackupSession:
+                      namespace: str | None = None,
+                      pipeline_workers: int | None = None) -> PBSBackupSession:
         parse_backup_type(backup_type)
         validate.snapshot_component(backup_id)
         ns = self.cfg.namespace if namespace is None else namespace
@@ -675,7 +692,8 @@ class PBSStore:
         http_.session_bound = True
         try:
             return self._init_session(http_, backup_type, backup_id, t,
-                                      auto_previous, ns)
+                                      auto_previous, ns,
+                                      pipeline_workers=pipeline_workers)
         except BaseException:
             # a failure between session establish and a usable session
             # must release the connection — it holds the server-side
@@ -685,7 +703,9 @@ class PBSStore:
 
     def _init_session(self, http_: _PBSHttp, backup_type: str,
                       backup_id: str, t: float,
-                      auto_previous: bool, ns: str = "") -> PBSBackupSession:
+                      auto_previous: bool, ns: str = "",
+                      pipeline_workers: int | None = None
+                      ) -> PBSBackupSession:
         known: set[bytes] = set()
         previous = None
         if auto_previous:
@@ -730,7 +750,8 @@ class PBSStore:
         ref = SnapshotRef(backup_type, backup_id, format_backup_time(t),
                           ns)
         return PBSBackupSession(self, ref, http_, known,
-                                self._chunker_factory, previous=previous)
+                                self._chunker_factory, previous=previous,
+                                pipeline_workers=pipeline_workers)
 
     @staticmethod
     def _previous_manifest(prev_file) -> dict | None:

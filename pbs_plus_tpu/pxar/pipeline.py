@@ -1,0 +1,318 @@
+"""Pipelined multi-worker chunk+fingerprint engine (the CPU data plane).
+
+BENCH_r05 put the end-to-end chunk+fingerprint path at ~193 MiB/s on one
+core while the raw buzhash scan alone reaches ~610 MiB/s multithreaded:
+the sequential writer chunks, hashes, and inserts one chunk at a time,
+so SHA-256 and store IO serialize behind the scan.  ``PipelinedStream``
+splits the path into three overlapped stages (the stage-pipelining lever
+of arXiv:2508.05797 / arXiv:2409.06066):
+
+    scan    (caller thread)   CDC chunker feed + zero-copy chunk slicing
+    hash    (N pool threads)  SHA-256 per chunk — hashlib releases the
+                              GIL on large buffers, so N threads scale on
+                              multi-core hosts; the ``batch_hasher`` hook
+                              stays the TPU escape hatch (batched device
+                              dispatch from the pool instead)
+    insert  (committer)       ``store.insert`` + record/stat commit,
+                              strictly in chunk-emission order
+
+Hashes may complete out of order; each chunk's record slot is allocated
+at emission time and the committer fills slots in order, so ``records``
+(and the WriterStats new/known accounting, which a sequential dedup hit
+pattern determines) are bit-identical to ``transfer._ChunkedStream`` for
+ANY worker count — the parity gate ``tests/test_pipeline.py`` pins.
+
+Store thread-safety: neither built-in store is safe for concurrent
+calls (ChunkStore shares one zstd compressor context; PBSChunkSink
+shares one HTTP connection), and a pipelined session has two calling
+threads — this stream's committer, plus the writer thread inserting
+meta chunks through its sequential sibling stream.  Every store call
+therefore goes through a ``_LockedStore`` proxy; ``SessionWriter``
+wraps the store ONCE so meta and payload streams share the same lock.
+Contention is negligible: meta chunks are rare, and the lock is only
+ever held for one insert/touch.
+
+Backpressure: at most ``max_inflight`` chunks (default 2*workers+2) are
+in flight, bounding peak extra memory by max_inflight * params.max_size.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import queue
+import threading
+import time
+import weakref
+from concurrent.futures import ThreadPoolExecutor
+
+from ..chunker import ChunkerParams
+from .transfer import (
+    _HASH_BATCH_BYTES, _HASH_BATCH_COUNT, BatchHasher, ChunkerFactory,
+    _ChunkedStream, _default_chunker_factory,
+)
+
+_DONE = object()
+
+
+class _LockedStore:
+    """Serializes ``insert``/``touch`` across threads for stores that
+    are not thread-safe (module docstring).  Everything else proxies
+    through untouched."""
+
+    def __init__(self, store):
+        self._store = store
+        self._lock = threading.Lock()
+
+    def insert(self, digest, data, *, verify: bool = True) -> bool:
+        with self._lock:
+            return self._store.insert(digest, data, verify=verify)
+
+    def touch(self, digest) -> None:
+        with self._lock:
+            self._store.touch(digest)
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+
+def locked_store(store) -> _LockedStore:
+    """Idempotent wrap (an already-locked store is returned as is, so
+    two streams built from one wrap share one lock)."""
+    return store if isinstance(store, _LockedStore) else _LockedStore(store)
+
+
+class PipelineMetrics:
+    """Process-global pipeline observability (rendered by
+    server/metrics.py): cumulative per-stage bytes/seconds/chunks plus
+    live queue depths summed over active streams at snapshot time."""
+
+    _STAGES = ("scan", "hash", "insert")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._bytes = dict.fromkeys(self._STAGES, 0)
+        self._seconds = dict.fromkeys(self._STAGES, 0.0)
+        self._chunks = dict.fromkeys(self._STAGES, 0)
+        self._streams: "weakref.WeakSet[PipelinedStream]" = weakref.WeakSet()
+
+    def add(self, stage: str, nbytes: int, seconds: float,
+            chunks: int = 0) -> None:
+        with self._lock:
+            self._bytes[stage] += nbytes
+            self._seconds[stage] += seconds
+            self._chunks[stage] += chunks
+
+    def register(self, stream: "PipelinedStream") -> None:
+        with self._lock:
+            self._streams.add(stream)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            live = [s for s in self._streams if not s._closed]
+            stages = {}
+            for s in self._STAGES:
+                secs = self._seconds[s]
+                stages[s] = {
+                    "bytes": self._bytes[s],
+                    "seconds": round(secs, 6),
+                    "chunks": self._chunks[s],
+                    "mib_s": round(self._bytes[s] / (1 << 20) / secs, 3)
+                    if secs > 1e-9 else 0.0,
+                }
+            return {
+                "stages": stages,
+                "active_streams": len(live),
+                "workers": sum(s.workers for s in live),
+                "queues": {
+                    "hash_inflight": sum(s._hash_inflight for s in live),
+                    "commit_depth": sum(s._commit_q.qsize() for s in live),
+                },
+            }
+
+
+METRICS = PipelineMetrics()
+
+
+def metrics_snapshot() -> dict:
+    return METRICS.snapshot()
+
+
+class PipelinedStream(_ChunkedStream):
+    """``_ChunkedStream`` with the hash and insert stages pipelined
+    behind the CDC scan (module docstring).
+
+    Subclasses the sequential writer so the entire caller surface —
+    ``write``/``_emit``/``flush_chunker``/``append_ref`` buffer and
+    offset bookkeeping — is SHARED, not copied; only chunk emission
+    (hand-off to the pool instead of inline hash+insert) and ``finish``
+    (drain + join) are overridden.  Extra surface: ``close()`` for
+    abort paths (reaps the pool + committer; idempotent, also safe
+    after ``finish``)."""
+
+    def __init__(self, store, params: ChunkerParams,
+                 chunker_factory: ChunkerFactory = _default_chunker_factory,
+                 batch_hasher: BatchHasher | None = None,
+                 workers: int = 2, max_inflight: int | None = None):
+        super().__init__(locked_store(store), params, chunker_factory,
+                         batch_hasher=batch_hasher)
+        self.workers = max(1, int(workers))
+        # chunk-count backpressure (per-chunk hash mode); batch mode
+        # bounds whole batches instead — a >max_inflight batch of small
+        # chunks must never deadlock against its own permits
+        self._slots = threading.BoundedSemaphore(
+            max_inflight or (2 * self.workers + 2))
+        self._batch_slots = threading.BoundedSemaphore(2)
+        self._pool = ThreadPoolExecutor(max_workers=self.workers,
+                                        thread_name_prefix="pipe-hash")
+        self._commit_q: "queue.Queue" = queue.Queue()
+        self._exc: BaseException | None = None
+        self._hash_inflight = 0     # gauge only; racy int updates are fine
+        self._closed = False
+        self._finished = False
+        self._committer = threading.Thread(
+            target=self._commit_loop, name="pipeline-commit", daemon=True)
+        self._committer.start()
+        METRICS.register(self)
+
+    # -- caller-thread surface: inherited semantics + failure checks -------
+    def _check_failed(self) -> None:
+        if self._exc is not None:
+            self.close()
+            raise self._exc
+
+    def write(self, data) -> None:
+        self._check_failed()
+        t0 = time.perf_counter()
+        super().write(data)
+        # scan = caller-thread time INCLUDING backpressure stalls: when
+        # this gauge's MiB/s collapses while insert stays busy, the
+        # store stage is the bottleneck
+        METRICS.add("scan", len(data) if data else 0,
+                    time.perf_counter() - t0)
+
+    def flush_chunker(self) -> None:
+        self._check_failed()
+        super().flush_chunker()
+
+    def append_ref(self, digest: bytes, size: int) -> None:
+        self._check_failed()
+        super().append_ref(digest, size)    # touch goes via _LockedStore
+
+    def _emit_chunk(self, end: int) -> None:
+        """Hand the finalized chunk to the pipeline instead of hashing
+        and inserting inline."""
+        n = end - self._buf_base
+        chunk = self._buf.take(n)
+        self._buf_base = end
+        self.records.append((end, b""))      # slot filled by the committer
+        idx = len(self.records) - 1
+        if self._hasher is not None:
+            # batch mode reuses the sequential writer's pending-batch
+            # fields; whole batches dispatch to the pool at the same
+            # thresholds, so the device feeder sees identical batches
+            self._pending.append((idx, chunk))
+            self._pending_bytes += n
+            if (self._pending_bytes >= _HASH_BATCH_BYTES
+                    or len(self._pending) >= _HASH_BATCH_COUNT):
+                self._flush_batch()
+            return
+        self._slots.acquire()
+        self._hash_inflight += 1
+        fut = self._pool.submit(self._hash_one, chunk)
+        self._commit_q.put(("chunk", idx, chunk, fut))
+
+    def _hash_one(self, chunk) -> bytes:
+        t0 = time.perf_counter()
+        d = hashlib.sha256(chunk).digest()
+        METRICS.add("hash", len(chunk), time.perf_counter() - t0, 1)
+        self._hash_inflight -= 1
+        return d
+
+    def _hash_batch(self, chunks: list, nbytes: int) -> list:
+        t0 = time.perf_counter()
+        out = self._hasher(chunks)
+        METRICS.add("hash", nbytes, time.perf_counter() - t0, len(chunks))
+        self._hash_inflight -= len(chunks)
+        return out
+
+    def _flush_batch(self) -> None:
+        if not self._pending:
+            return
+        batch, self._pending = self._pending, []
+        nbytes, self._pending_bytes = self._pending_bytes, 0
+        self._batch_slots.acquire()
+        self._hash_inflight += len(batch)
+        fut = self._pool.submit(self._hash_batch,
+                                [c for _, c in batch], nbytes)
+        self._commit_q.put(("batch", batch, fut))
+
+    def _flush_hashes(self) -> None:
+        # the sequential batch path (records filled inline) never runs
+        # here — the committer owns record slots; finish() drains instead
+        raise AssertionError("unused on the pipelined stream")
+
+    def finish(self) -> list[tuple[int, bytes]]:
+        if self._finished:
+            return self.records
+        if self._buf:
+            self.flush_chunker()
+        if self._exc is None and self._hasher is not None:
+            self._flush_batch()
+        self._shutdown()
+        if self._exc is not None:
+            raise self._exc
+        return self.records
+
+    def close(self) -> None:
+        """Reap the pool + committer (abort paths); idempotent."""
+        self._shutdown()
+
+    def _shutdown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._finished = True
+        self._commit_q.put(_DONE)
+        self._committer.join()
+        self._pool.shutdown(wait=True)
+
+    # -- committer thread --------------------------------------------------
+    def _commit_loop(self) -> None:
+        try:
+            while True:
+                slot = self._commit_q.get()
+                if slot is _DONE:
+                    return
+                if slot[0] == "chunk":
+                    _, idx, chunk, fut = slot
+                    try:
+                        self._commit(idx, fut.result(), chunk)
+                    finally:
+                        self._slots.release()
+                else:
+                    _, batch, fut = slot
+                    try:
+                        digests = fut.result()
+                        for (idx, chunk), digest in zip(batch, digests):
+                            self._commit(idx, digest, chunk)
+                    finally:
+                        self._batch_slots.release()
+        except BaseException as e:
+            self._exc = e
+            # drain until the finish()/close() sentinel so a caller
+            # blocked on backpressure permits always wakes up
+            while True:
+                slot = self._commit_q.get()
+                if slot is _DONE:
+                    return
+                if slot[0] == "chunk":
+                    self._slots.release()
+                else:
+                    self._batch_slots.release()
+
+    def _commit(self, idx: int, digest: bytes, chunk) -> None:
+        end, _ = self.records[idx]
+        self.records[idx] = (end, digest)
+        t0 = time.perf_counter()
+        self._insert(digest, chunk)          # inherited new/known counting
+        METRICS.add("insert", len(chunk), time.perf_counter() - t0, 1)

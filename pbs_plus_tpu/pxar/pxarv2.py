@@ -170,6 +170,14 @@ _ID_UNSET = 0xFFFFFFFF          # u32 ACL_UNDEFINED_ID in the xattr blob
 # The stock pxar crate marks an absent permission slot in the u64 fields
 # of PXAR_ACL_DEFAULT with u64::MAX ("NO_MASK"), not u32::MAX.
 _PERM_UNSET = 0xFFFFFFFFFFFFFFFF
+# Snapshots written before the r4 sentinel fix carried u32::MAX in those
+# slots; perms are u16-range, so the value is unambiguous — accepted as
+# "unset" on DECODE ONLY (the encoder always writes u64::MAX).
+_PERM_UNSET_LEGACY = 0xFFFFFFFF
+
+
+def _perm_is_unset(v: int) -> bool:
+    return v == _PERM_UNSET or v == _PERM_UNSET_LEGACY
 
 
 def _checked_perm(perm: int) -> int:
@@ -290,13 +298,13 @@ class _AclAssembler:
             ents = []
             if self.default_head is not None:
                 uo, go, ot, mask = self.default_head
-                if uo != _PERM_UNSET:
+                if not _perm_is_unset(uo):
                     ents.append((_TAG_USER_OBJ, _checked_perm(uo), _ID_UNSET))
-                if go != _PERM_UNSET:
+                if not _perm_is_unset(go):
                     ents.append((_TAG_GROUP_OBJ, _checked_perm(go), _ID_UNSET))
-                if ot != _PERM_UNSET:
+                if not _perm_is_unset(ot):
                     ents.append((_TAG_OTHER, _checked_perm(ot), _ID_UNSET))
-                if mask != _PERM_UNSET:
+                if not _perm_is_unset(mask):
                     ents.append((_TAG_MASK, _checked_perm(mask), _ID_UNSET))
             ents += self.default
             xattrs[_XATTR_ACL_DEFAULT] = _build_posix_acl(ents)
@@ -461,12 +469,17 @@ class Pxar2Encoder:
                     off, size = payload_ref
                     self._emit(item(PXAR_PAYLOAD_REF,
                                     struct.pack("<QQ", off, size)))
-                elif e.size:
-                    raise ValueError(
-                        f"non-empty file {e.path!r} needs a payload_ref")
                 else:
-                    self._emit(item(PXAR_PAYLOAD_REF,
-                                    struct.pack("<QQ", 0, 0)))
+                    # Every file — even an empty one — must carry a ref
+                    # at a real PAYLOAD item header; a REF(0,0) aimed at
+                    # the start marker does not validate under a stock
+                    # accessor.  SessionWriter routes empty files through
+                    # _write_file_pxar2, which writes the zero-length
+                    # item, so hitting this branch is a writer bug
+                    # (ADVICE r5: the old silent REF(0,0) fallback).
+                    raise ValueError(
+                        f"file {e.path!r} needs a payload_ref "
+                        f"(zero-length PAYLOAD item for empty files)")
             elif e.kind == KIND_SYMLINK:
                 self._emit(item(PXAR_SYMLINK,
                                 e.link_target.encode() + b"\0"))

@@ -29,7 +29,7 @@ import io
 import json
 import os
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Callable, Iterator
 
@@ -74,6 +74,72 @@ _HASH_BATCH_BYTES = 16 << 20
 _HASH_BATCH_COUNT = 512
 
 
+class _ChunkBuffer:
+    """Rotating segment buffer for the chunk-emission hot path.
+
+    ``append`` retains incoming blocks whole (no copy); ``take(n)``
+    yields the next ``n`` bytes — a zero-copy memoryview when the chunk
+    lies inside one block, a single joined bytes object only when it
+    spans a block seam.  Replaces the old ``bytes(buf[:n])`` +
+    ``del buf[:n]`` pattern, which paid one copy plus an O(remaining)
+    memmove per chunk on large files.  Appended blocks are retained by
+    reference — callers must not mutate them afterwards (every writer
+    path feeds immutable bytes)."""
+
+    __slots__ = ("_segs", "_head", "size")
+
+    def __init__(self) -> None:
+        self._segs: "deque" = deque()   # retained bytes blocks
+        self._head = 0                  # consumed bytes of _segs[0]
+        self.size = 0
+
+    def __bool__(self) -> bool:
+        return self.size > 0
+
+    def __len__(self) -> int:
+        return self.size
+
+    def append(self, data) -> None:
+        if len(data):
+            self._segs.append(data)
+            self.size += len(data)
+
+    def take(self, n: int):
+        """First n bytes, consumed.  memoryview (zero-copy) or bytes."""
+        if n <= 0:
+            return b""
+        if n > self.size:
+            raise ValueError(f"take({n}) exceeds buffered {self.size}")
+        first = self._segs[0]
+        avail = len(first) - self._head
+        if n < avail:
+            out = memoryview(first)[self._head:self._head + n]
+            self._head += n
+            self.size -= n
+            return out
+        if n == avail:
+            out = memoryview(first)[self._head:] if self._head else first
+            self._segs.popleft()
+            self._head = 0
+            self.size -= n
+            return out
+        parts = []
+        remaining = n
+        while remaining:
+            first = self._segs[0]
+            avail = len(first) - self._head
+            step = min(avail, remaining)
+            parts.append(memoryview(first)[self._head:self._head + step])
+            if step == avail:
+                self._segs.popleft()
+                self._head = 0
+            else:
+                self._head += step
+            remaining -= step
+        self.size -= n
+        return b"".join(parts)
+
+
 class _ChunkedStream:
     """CDC-chunked stream writer over a ChunkStore: ``write`` feeds the
     chunker, ``append_ref`` splices an existing chunk, ``finish`` returns
@@ -90,7 +156,7 @@ class _ChunkedStream:
         self.params = params
         self._factory = chunker_factory
         self._chunker = chunker_factory(params)
-        self._buf = bytearray()
+        self._buf = _ChunkBuffer()
         self._buf_base = 0          # stream offset of _buf[0]
         self._run_base = 0          # stream offset where current chunker run began
         self.offset = 0             # total stream bytes accepted
@@ -103,7 +169,7 @@ class _ChunkedStream:
     def write(self, data: bytes) -> None:
         if not data:
             return
-        self._buf += data
+        self._buf.append(data)
         self.offset += len(data)
         self.stats.bytes_streamed += len(data)
         cuts = self._chunker.feed(data)
@@ -117,8 +183,7 @@ class _ChunkedStream:
     def _emit_chunk(self, end: int) -> None:
         start = self._buf_base
         n = end - start
-        chunk = bytes(self._buf[:n])
-        del self._buf[:n]
+        chunk = self._buf.take(n)      # memoryview when seam-free
         self._buf_base = end
         if self._hasher is None:
             digest = hashlib.sha256(chunk).digest()
@@ -191,22 +256,44 @@ class SessionWriter:
                  meta_params: ChunkerParams | None = None,
                  chunker_factory: ChunkerFactory = _default_chunker_factory,
                  batch_hasher: BatchHasher | None = None,
-                 entry_codec: str = "tpxar"):
+                 entry_codec: str = "tpxar",
+                 pipeline_workers: int = 0):
         """``entry_codec='pxar2'`` writes stock pxar v2 binary items in
         the meta stream (with per-file payload headers + start marker in
         the payload stream) so stock PBS tools can decode the archive;
         'tpxar' (default) keeps the native msgpack entries (`pxarv2.py`
         module docstring; round-3 judge finding: entry encoding was the
-        last stock-PBS format gap)."""
+        last stock-PBS format gap).
+
+        ``pipeline_workers >= 1`` runs the payload stream through
+        ``pipeline.PipelinedStream`` (scan ∥ hash ∥ insert with N hash
+        workers); 0 (default) keeps the sequential writer.  Cut/digest
+        output is bit-identical either way (tests/test_pipeline.py)."""
         if entry_codec not in ("tpxar", "pxar2"):
             raise ValueError(f"unknown entry codec {entry_codec!r}")
+        if pipeline_workers and pipeline_workers > 0:
+            # the payload committer thread and this (writer) thread both
+            # call store.insert once the meta stream cuts a chunk, and
+            # neither built-in store is thread-safe — share ONE locked
+            # proxy across both streams (pipeline.py module docstring)
+            from .pipeline import locked_store
+            store = locked_store(store)
         self.store = store
         self.payload_params = payload_params
         self.meta_params = meta_params or ChunkerParams(
             avg_size=max(1024, min(payload_params.avg_size, 128 << 10)))
+        # meta stays sequential: entries are tiny and arrive interleaved
+        # with payload writes on the same caller thread
         self.meta = _ChunkedStream(store, self.meta_params, chunker_factory)
-        self.payload = _ChunkedStream(store, payload_params, chunker_factory,
-                                      batch_hasher=batch_hasher)
+        if pipeline_workers and pipeline_workers > 0:
+            from .pipeline import PipelinedStream
+            self.payload = PipelinedStream(
+                store, payload_params, chunker_factory,
+                batch_hasher=batch_hasher, workers=pipeline_workers)
+        else:
+            self.payload = _ChunkedStream(
+                store, payload_params, chunker_factory,
+                batch_hasher=batch_hasher)
         self.entry_codec = entry_codec
         self._codec: Pxar2Encoder | None = None
         if entry_codec == "pxar2":
@@ -331,7 +418,23 @@ class SessionWriter:
             h.update(block)
             self.payload.write(block)
             remaining -= len(block)
-        long_tail = bool(reader.read(1))
+        long_tail = False
+        if not short:
+            # long-stream probe: one extra byte tells a grown file from a
+            # stat-sized one.  A reader that has already delivered every
+            # declared byte may legitimately raise here (e.g. a
+            # _QueuePumpReader whose producer errored after the payload
+            # sentinel) — the file content is complete, so treat probe
+            # failures as a divergence report, not a write failure
+            # (ADVICE r5).
+            try:
+                long_tail = bool(reader.read(1))
+            except Exception as e:
+                self.payload.stats.size_mismatch_files += 1
+                self.file_errors.append(
+                    f"{entry.path}: stream probe past declared size "
+                    f"{declared} failed: {e}")
+                L.warning("pxar2 probe divergence: %s", self.file_errors[-1])
         if short or long_tail:
             # file changed size mid-backup: the declared stat size stays
             # authoritative for the archive, but the divergence must be
@@ -367,16 +470,33 @@ class SessionWriter:
         if self._finished:
             raise RuntimeError("writer already finished")
         self._finished = True
-        if self._codec is not None:
-            self._codec.finish()          # close open dirs, goodbye tables
-            self._ensure_payload_started()  # valid (if empty) v2 stream
-        now_ns = time.time_ns()
-        midx = DynamicIndex.from_records(self.meta.finish(), ctime_ns=now_ns)
-        pidx = DynamicIndex.from_records(self.payload.finish(), ctime_ns=now_ns)
+        try:
+            if self._codec is not None:
+                self._codec.finish()        # close open dirs, goodbye tables
+                self._ensure_payload_started()  # valid (if empty) v2 stream
+            now_ns = time.time_ns()
+            midx = DynamicIndex.from_records(self.meta.finish(),
+                                             ctime_ns=now_ns)
+            pidx = DynamicIndex.from_records(self.payload.finish(),
+                                             ctime_ns=now_ns)
+        except BaseException:
+            # a meta-stream failure must still reap the payload
+            # pipeline's pool + committer (no-op for sequential streams)
+            self.close()
+            raise
         stats = WriterStats()
         stats.merge(self.meta.stats)
         stats.merge(self.payload.stats)
         return midx, pidx, stats
+
+    def close(self) -> None:
+        """Release stream resources without finishing (abort paths).
+        No-op for sequential streams; a PipelinedStream parks a worker
+        pool + committer thread that must not outlive a failed job."""
+        for s in (self.meta, self.payload):
+            closer = getattr(s, "close", None)
+            if closer is not None:
+                closer()
 
     @property
     def entry_count(self) -> int:
@@ -393,12 +513,14 @@ class DedupWriter(SessionWriter):
                  meta_params: ChunkerParams | None = None,
                  chunker_factory: ChunkerFactory = _default_chunker_factory,
                  batch_hasher: BatchHasher | None = None,
-                 entry_codec: str = "tpxar"):
+                 entry_codec: str = "tpxar",
+                 pipeline_workers: int = 0):
         super().__init__(store, payload_params=payload_params,
                          meta_params=meta_params,
                          chunker_factory=chunker_factory,
                          batch_hasher=batch_hasher,
-                         entry_codec=entry_codec)
+                         entry_codec=entry_codec,
+                         pipeline_workers=pipeline_workers)
         self.previous = previous
         # pending coalesced old-payload range [A, B) and the new-stream
         # offset N0 where it will land
@@ -506,8 +628,14 @@ class DedupWriter(SessionWriter):
                     self._emit_meta(entry, (entry.payload_offset -
                                             PAYLOAD_HDR_SIZE, entry.size))
                 else:
-                    entry.payload_offset = -1
-                    self._emit_meta(entry, None)
+                    # empty refed file: write a real zero-length PAYLOAD
+                    # item so its ref validates under a stock accessor —
+                    # a bare REF(0,0) aimed at the start marker does not
+                    # (ADVICE r5; the encoder now refuses payload_ref=None
+                    # files outright).  _write_file_pxar2 recounts the
+                    # entry, which write_entry_ref already did.
+                    self._entries -= 1
+                    self._write_file_pxar2(entry, io.BytesIO(b""), 1 << 16)
             else:
                 self._emit_meta(entry)
         self._pend_entries.clear()

@@ -89,6 +89,17 @@ def validate_chunker_kind(kind: str) -> None:
                      "(want cpu | tpu | sidecar:<host:port>)")
 
 
+def validate_pipeline_workers(n) -> int:
+    """Validate the per-job pipelined-writer worker count (web CRUD
+    path).  0 = the sequential writer; 1..64 = pxar/pipeline.py with
+    that many hash workers (insert always runs on one ordered committer
+    stage, so cut/digest output is identical for every value)."""
+    n = int(n)
+    if not 0 <= n <= 64:
+        raise ValueError(f"pipeline_workers {n} out of range 0..64")
+    return n
+
+
 def make_batch_hasher(kind: str):
     """Batched digest backend matching the chunker backend: the tpu path
     hashes emitted chunks in device batches (ops/sha256); cpu/sidecar use
@@ -457,7 +468,8 @@ async def run_local_backup(row: database.BackupJobRow, *, db, store,
         try:
             session = store.start_session(
                 backup_type="host", backup_id=row.backup_id or row.target,
-                namespace=row.namespace or None)
+                namespace=row.namespace or None,
+                pipeline_workers=row.pipeline_workers)
             try:
                 counters = {"files": 0, "bytes": 0}
                 n = backup_tree(
@@ -497,7 +509,8 @@ async def run_s3_backup(row: database.BackupJobRow, *, db, store,
     session = await asyncio.get_running_loop().run_in_executor(
         None, lambda: store.start_session(
             backup_type="host", backup_id=row.backup_id or row.target,
-            namespace=row.namespace or None))
+            namespace=row.namespace or None,
+            pipeline_workers=row.pipeline_workers))
     try:
         async with aiohttp.ClientSession() as http:
             client = S3Client(http, S3Config(
@@ -567,7 +580,8 @@ async def run_backup_job(row: database.BackupJobRow, *,
         session = await asyncio.get_running_loop().run_in_executor(
             None, lambda: store.start_session(
                 backup_type="host", backup_id=row.backup_id or row.target,
-                namespace=row.namespace or None))
+                namespace=row.namespace or None,
+                pipeline_workers=row.pipeline_workers))
         try:
             pump = RemoteTreeBackup(
                 fs, session,

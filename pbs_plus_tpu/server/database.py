@@ -162,6 +162,12 @@ _MIGRATIONS: list[str] = [
     """
     ALTER TABLE backup_jobs ADD COLUMN namespace TEXT NOT NULL DEFAULT '';
     """,
+    # 007 — pipelined data plane: per-job hash-worker count (0 = the
+    # sequential writer; >=1 opts the job into pxar/pipeline.py)
+    """
+    ALTER TABLE backup_jobs ADD COLUMN pipeline_workers
+        INTEGER NOT NULL DEFAULT 0;
+    """,
 ]
 
 
@@ -178,6 +184,7 @@ class BackupJobRow:
     retry_interval_s: int = 60
     exclusions: list[str] = field(default_factory=list)
     chunker: str = "cpu"
+    pipeline_workers: int = 0      # 0 = sequential; >=1 = pipelined writer
     pre_script: str = ""
     post_script: str = ""
     enabled: bool = True
@@ -220,9 +227,9 @@ class Database:
             self._conn.execute(
                 """INSERT INTO backup_jobs (id,target,source_path,store,
                    backup_id,namespace,schedule,retry,retry_interval_s,
-                   exclusions,chunker,pre_script,post_script,enabled,
-                   created_at)
-                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)
+                   exclusions,chunker,pipeline_workers,pre_script,
+                   post_script,enabled,created_at)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)
                    ON CONFLICT(id) DO UPDATE SET target=excluded.target,
                      source_path=excluded.source_path, store=excluded.store,
                      backup_id=excluded.backup_id,
@@ -231,13 +238,14 @@ class Database:
                      retry=excluded.retry,
                      retry_interval_s=excluded.retry_interval_s,
                      exclusions=excluded.exclusions, chunker=excluded.chunker,
+                     pipeline_workers=excluded.pipeline_workers,
                      pre_script=excluded.pre_script,
                      post_script=excluded.post_script,
                      enabled=excluded.enabled""",
                 (j.id, j.target, j.source_path, j.store, j.backup_id,
                  j.namespace, j.schedule, j.retry, j.retry_interval_s,
-                 json.dumps(j.exclusions), j.chunker, j.pre_script,
-                 j.post_script, int(j.enabled), time.time()))
+                 json.dumps(j.exclusions), j.chunker, j.pipeline_workers,
+                 j.pre_script, j.post_script, int(j.enabled), time.time()))
 
     def _row_to_job(self, r: sqlite3.Row) -> BackupJobRow:
         return BackupJobRow(
@@ -246,6 +254,7 @@ class Database:
             namespace=r["namespace"], schedule=r["schedule"],
             retry=r["retry"], retry_interval_s=r["retry_interval_s"],
             exclusions=json.loads(r["exclusions"]), chunker=r["chunker"],
+            pipeline_workers=r["pipeline_workers"],
             pre_script=r["pre_script"], post_script=r["post_script"],
             enabled=bool(r["enabled"]), last_run_at=r["last_run_at"],
             last_status=r["last_status"], last_error=r["last_error"],

@@ -225,6 +225,36 @@ class MetricsRegistry:
               "Logical snapshot bytes / on-disk chunk bytes",
               [({}, logical / chunk_b)] if chunk_b else [])
 
+        # -- pipelined data plane (pxar/pipeline.py) --------------------------
+        from ..pxar import pipeline as _pipeline
+        snap = _pipeline.metrics_snapshot()
+        gauge("pbs_plus_pipeline_stage_bytes_total",
+              "Cumulative bytes processed per pipeline stage",
+              [({"stage": st}, float(v["bytes"]))
+               for st, v in snap["stages"].items()])
+        gauge("pbs_plus_pipeline_stage_chunks_total",
+              "Cumulative chunks processed per pipeline stage",
+              [({"stage": st}, float(v["chunks"]))
+               for st, v in snap["stages"].items() if st != "scan"])
+        gauge("pbs_plus_pipeline_stage_busy_seconds_total",
+              "Cumulative busy time per pipeline stage",
+              [({"stage": st}, v["seconds"])
+               for st, v in snap["stages"].items()])
+        gauge("pbs_plus_pipeline_stage_throughput_mib_s",
+              "Per-stage throughput (bytes / busy seconds)",
+              [({"stage": st}, v["mib_s"])
+               for st, v in snap["stages"].items()])
+        gauge("pbs_plus_pipeline_active_streams",
+              "PipelinedStreams currently open",
+              [({}, float(snap["active_streams"]))])
+        gauge("pbs_plus_pipeline_workers",
+              "Hash workers across active pipelined streams",
+              [({}, float(snap["workers"]))])
+        gauge("pbs_plus_pipeline_queue_depth",
+              "In-flight items per pipeline queue",
+              [({"queue": q}, float(v))
+               for q, v in snap["queues"].items()])
+
         # -- mounts / server --------------------------------------------------
         ms = getattr(s, "mount_service", None)
         gauge("pbs_plus_mounts_active", "Active snapshot mounts",

@@ -45,6 +45,9 @@ class ServerConfig:
     arpc_port: int = 0                      # 0 = ephemeral (tests)
     chunk_avg: int = 4 << 20
     chunker: str = "cpu"                    # default backend; per-job override
+    # default pipelined-writer hash workers (0 = sequential); per-job
+    # override via BackupJobRow.pipeline_workers
+    pipeline_workers: int = 0
     datastore_format: str = "tpxd"          # "tpxd" | "pbs" (stock-PBS layout)
     max_concurrent: int | None = None
     hostname: str = "pbs-plus-tpu-server"
@@ -97,7 +100,8 @@ class Server:
             config.datastore_dir, params,
             chunker_factory=make_chunker_factory(config.chunker),
             batch_hasher=make_batch_hasher(config.chunker),
-            pbs_format=config.datastore_format == "pbs")
+            pbs_format=config.datastore_format == "pbs",
+            pipeline_workers=config.pipeline_workers)
         self.scheduler = Scheduler(
             self.db, self.jobs,
             enqueue_backup=self._enqueue_backup_row,
@@ -425,14 +429,16 @@ class Server:
                           fingerprint=self.config.pbs_fingerprint),
                 ChunkerParams(avg_size=self.config.chunk_avg),
                 chunker_factory=make_chunker_factory(kind),
-                batch_hasher=make_batch_hasher(kind))
+                batch_hasher=make_batch_hasher(kind),
+                pipeline_workers=self.config.pipeline_workers)
         elif row.chunker and row.chunker != self.config.chunker:
             store = LocalStore(
                 self.config.datastore_dir,
                 ChunkerParams(avg_size=self.config.chunk_avg),
                 chunker_factory=make_chunker_factory(row.chunker),
                 batch_hasher=make_batch_hasher(row.chunker),
-                pbs_format=self.config.datastore_format == "pbs")
+                pbs_format=self.config.datastore_format == "pbs",
+                pipeline_workers=self.config.pipeline_workers)
 
         async def execute():
             from . import hooks

@@ -272,6 +272,7 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
             "schedule": j.schedule,
             "retry": j.retry, "retry_interval_s": j.retry_interval_s,
             "exclusions": j.exclusions, "chunker": j.chunker,
+            "pipeline_workers": j.pipeline_workers,
             "store": j.store,
             "enabled": j.enabled, "last_run_at": j.last_run_at,
             "last_status": j.last_status, "last_error": j.last_error,
@@ -286,9 +287,15 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
     async def backup_upsert(request):
         b = await request.json()
         from ..utils import validate
-        from .backup_job import validate_chunker_kind
+        from .backup_job import (validate_chunker_kind,
+                                 validate_pipeline_workers)
         chunker = b.get("chunker", server.config.chunker)
         validate_chunker_kind(chunker)  # reject unknown backends up front
+        try:
+            pipeline_workers = validate_pipeline_workers(
+                b.get("pipeline_workers", server.config.pipeline_workers))
+        except (TypeError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
         store_kind = b.get("store", "")
         if store_kind not in ("", "local", "pbs"):
             return web.json_response(
@@ -309,6 +316,7 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
             retry_interval_s=int(b.get("retry_interval_s", 60)),
             exclusions=list(b.get("exclusions", [])),
             chunker=chunker,
+            pipeline_workers=pipeline_workers,
             enabled=bool(b.get("enabled", True)))
         server.db.upsert_backup_job(row)
         return web.json_response({"data": _job_dict(row)})
@@ -812,11 +820,13 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
         buf = io.StringIO()
         w = csv.writer(buf)
         w.writerow(["id", "store", "ns", "target", "source_path",
-                    "schedule", "chunker", "enabled", "last_run_at",
+                    "schedule", "chunker", "pipeline_workers", "enabled",
+                    "last_run_at",
                     "last_status", "last_error", "last_snapshot"])
         for j in jobs:
             w.writerow([j.id, j.store or "local", j.namespace, j.target,
                         j.source_path, j.schedule, j.chunker,
+                        j.pipeline_workers,
                         int(j.enabled), j.last_run_at or "",
                         j.last_status or "", j.last_error or "",
                         j.last_snapshot or ""])

@@ -1,0 +1,124 @@
+"""Minimal ``zstandard``-compatible shim over the system libzstd (ctypes).
+
+Some deployment images (including this one) lack the ``zstandard`` wheel
+but ship ``libzstd.so.1``.  This shim exposes exactly the API surface the
+repo uses — ``ZstdCompressor(level=).compress``, ``ZstdDecompressor()
+.decompress(data, max_output_size=)``, ``ZstdError`` — producing and
+consuming REAL zstd frames via the one-shot libzstd API, so the on-disk
+chunk/blob format stays byte-compatible with a zstandard-equipped
+install (the simple API embeds the frame content size, exactly like the
+python binding's default).
+
+Import-gated consumers do::
+
+    try:
+        import zstandard
+    except ImportError:
+        from ..utils import zstdshim as zstandard
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+
+_CONTENTSIZE_UNKNOWN = 2**64 - 1
+_CONTENTSIZE_ERROR = 2**64 - 2
+_lib: "ctypes.CDLL | None" = None
+
+
+class ZstdError(Exception):
+    pass
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    name = ctypes.util.find_library("zstd") or "libzstd.so.1"
+    try:
+        lib = ctypes.CDLL(name)
+    except OSError as e:                      # no wheel AND no system lib
+        raise ImportError(f"libzstd unavailable: {e}") from e
+    lib.ZSTD_compressBound.restype = ctypes.c_size_t
+    lib.ZSTD_compressBound.argtypes = [ctypes.c_size_t]
+    lib.ZSTD_compress.restype = ctypes.c_size_t
+    lib.ZSTD_compress.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                  ctypes.c_char_p, ctypes.c_size_t,
+                                  ctypes.c_int]
+    lib.ZSTD_decompress.restype = ctypes.c_size_t
+    lib.ZSTD_decompress.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                    ctypes.c_char_p, ctypes.c_size_t]
+    lib.ZSTD_isError.restype = ctypes.c_uint
+    lib.ZSTD_isError.argtypes = [ctypes.c_size_t]
+    lib.ZSTD_getErrorName.restype = ctypes.c_char_p
+    lib.ZSTD_getErrorName.argtypes = [ctypes.c_size_t]
+    lib.ZSTD_getFrameContentSize.restype = ctypes.c_ulonglong
+    lib.ZSTD_getFrameContentSize.argtypes = [ctypes.c_char_p,
+                                             ctypes.c_size_t]
+    _lib = lib
+    return lib
+
+
+def _err(lib: ctypes.CDLL, code: int) -> str:
+    return lib.ZSTD_getErrorName(code).decode(errors="replace")
+
+
+class ZstdCompressor:
+    def __init__(self, level: int = 3, **_kw):
+        self._level = level
+
+    def compress(self, data) -> bytes:
+        if not isinstance(data, bytes):
+            data = bytes(data)
+        lib = _load()
+        bound = lib.ZSTD_compressBound(len(data))
+        dst = ctypes.create_string_buffer(max(bound, 1))
+        n = lib.ZSTD_compress(dst, bound, data, len(data), self._level)
+        if lib.ZSTD_isError(n):
+            raise ZstdError(f"compress failed: {_err(lib, n)}")
+        return dst.raw[:n]
+
+
+class ZstdDecompressor:
+    def decompress(self, data, max_output_size: int = 0) -> bytes:
+        if not isinstance(data, bytes):
+            data = bytes(data)
+        lib = _load()
+        sz = lib.ZSTD_getFrameContentSize(data, len(data))
+        if sz == _CONTENTSIZE_ERROR:
+            raise ZstdError("input is not a zstd frame")
+        if sz == _CONTENTSIZE_UNKNOWN:
+            if max_output_size <= 0:
+                raise ZstdError("frame content size unknown and no "
+                                "max_output_size given")
+            # grow-and-retry: frames without an embedded size are rare
+            # here (both writers embed it); start small, never allocate
+            # the full (possibly GiB-scale) cap up front
+            cap = min(max_output_size, max(64 << 10, 4 * len(data)))
+            while True:
+                out = self._one_shot(lib, data, cap)
+                if out is not None:
+                    return out
+                if cap >= max_output_size:
+                    raise ZstdError("decompressed size exceeds "
+                                    "max_output_size")
+                cap = min(max_output_size, cap * 2)
+        if max_output_size and sz > max_output_size:
+            raise ZstdError("decompressed size exceeds max_output_size")
+        out = self._one_shot(lib, data, int(sz))
+        if out is None:
+            raise ZstdError("frame declares a smaller size than it holds")
+        return out
+
+    @staticmethod
+    def _one_shot(lib: ctypes.CDLL, data: bytes, cap: int) -> bytes | None:
+        """Returns None when the destination was too small (retryable)."""
+        dst = ctypes.create_string_buffer(max(cap, 1))
+        n = lib.ZSTD_decompress(dst, cap, data, len(data))
+        if lib.ZSTD_isError(n):
+            msg = _err(lib, n)
+            if "too small" in msg:
+                return None
+            raise ZstdError(f"decompress failed: {msg}")
+        return dst.raw[:n]

@@ -16,7 +16,10 @@ import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-import zstandard
+try:
+    import zstandard
+except ImportError:                 # image lacks the wheel; ctypes shim
+    from pbs_plus_tpu.utils import zstdshim as zstandard
 
 from pbs_plus_tpu.pxar.pbsstore import index_csum, index_to_bytes
 from pbs_plus_tpu.pxar.datastore import DynamicIndex, parse_backup_time

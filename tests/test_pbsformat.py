@@ -11,7 +11,10 @@ import struct
 import zlib
 
 import pytest
-import zstandard
+try:
+    import zstandard
+except ImportError:                 # image lacks the wheel; ctypes shim
+    from pbs_plus_tpu.utils import zstdshim as zstandard
 
 from pbs_plus_tpu.pxar import pbsformat as pf
 

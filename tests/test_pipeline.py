@@ -1,0 +1,271 @@
+"""Pipelined chunk+fingerprint engine battery (pxar/pipeline.py).
+
+The parity gate for the pipelined data plane: ``PipelinedStream`` must
+produce bit-identical records (cut boundaries + digests) and identical
+dedup stats vs the sequential ``_ChunkedStream`` for any worker count,
+keep record order deterministic under induced hash-stage reordering,
+and propagate a failing ``store.insert`` worker cleanly (no hang, no
+leaked committer thread)."""
+
+import hashlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pbs_plus_tpu.chunker import ChunkerParams
+from pbs_plus_tpu.pxar.datastore import ChunkStore
+from pbs_plus_tpu.pxar.pipeline import PipelinedStream, metrics_snapshot
+from pbs_plus_tpu.pxar.transfer import _ChunkedStream
+
+P = ChunkerParams(avg_size=4 << 10)   # test scale: 4 KiB avg
+
+
+def _random_stream(n: int, seed: int) -> bytes:
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+def _dup_heavy_stream() -> bytes:
+    """Duplicate-heavy: repeated blocks interleaved with fresh data, so
+    the known/new dedup accounting is exercised, not just digests."""
+    block = _random_stream(120_000, seed=3)
+    fresh = _random_stream(80_000, seed=4)
+    return block + fresh[:20_000] + block + fresh[20_000:] + block
+
+
+def _feed(stream, data: bytes, block: int = 57_331):
+    for i in range(0, len(data), block):
+        stream.write(data[i:i + block])
+    return stream.finish()
+
+
+def _run_seq(tmp_path, data, name="seq", **kw):
+    st = ChunkStore(str(tmp_path / name))
+    s = _ChunkedStream(st, P, **kw)
+    rec = _feed(s, data)
+    return rec, s.stats
+
+
+def _run_pipe(tmp_path, data, workers, name=None, cls=PipelinedStream,
+              **kw):
+    st = ChunkStore(str(tmp_path / (name or f"pipe{workers}")))
+    s = cls(st, P, workers=workers, **kw)
+    rec = _feed(s, data)
+    return rec, s.stats
+
+
+@pytest.mark.parametrize("workers", [1, 4])
+def test_parity_random_stream(tmp_path, workers):
+    data = _random_stream(1_500_000, seed=11)
+    rec0, st0 = _run_seq(tmp_path, data)
+    rec1, st1 = _run_pipe(tmp_path, data, workers)
+    assert rec0 == rec1
+    assert (st0.new_chunks, st0.known_chunks) == \
+        (st1.new_chunks, st1.known_chunks)
+    assert st0.bytes_streamed == st1.bytes_streamed == len(data)
+
+
+@pytest.mark.parametrize("workers", [1, 4])
+def test_parity_duplicate_heavy_stream(tmp_path, workers):
+    data = _dup_heavy_stream()
+    rec0, st0 = _run_seq(tmp_path, data)
+    rec1, st1 = _run_pipe(tmp_path, data, workers)
+    assert rec0 == rec1
+    # the dedup hit pattern (order-dependent!) must match exactly — the
+    # committer inserts in record order, so known/new cannot drift
+    assert (st0.new_chunks, st0.known_chunks) == \
+        (st1.new_chunks, st1.known_chunks)
+    assert st0.known_chunks > 0        # the corpus actually dedups
+
+
+def test_parity_batch_hasher_mode(tmp_path):
+    """The batch_hasher hook (the TPU escape hatch) pipelines whole
+    batches; output must stay identical to the sequential writer."""
+    calls = []
+
+    def hasher(chunks):
+        calls.append(len(chunks))
+        return [hashlib.sha256(c).digest() for c in chunks]
+
+    data = _dup_heavy_stream()
+    rec0, st0 = _run_seq(tmp_path, data, name="seq-b", batch_hasher=hasher)
+    rec1, st1 = _run_pipe(tmp_path, data, 2, name="pipe-b",
+                          batch_hasher=hasher)
+    assert rec0 == rec1
+    assert (st0.new_chunks, st0.known_chunks) == \
+        (st1.new_chunks, st1.known_chunks)
+    assert calls                       # the hook actually ran
+
+
+def test_parity_with_append_ref_and_flush(tmp_path):
+    """append_ref / flush_chunker interleavings (the DedupWriter splice
+    path) behave identically on both streams."""
+    chunk = _random_stream(30_000, seed=5)
+    digest = hashlib.sha256(chunk).digest()
+    a = _random_stream(200_000, seed=6)
+    b = _random_stream(150_000, seed=7)
+
+    def run(cls, name, **kw):
+        st = ChunkStore(str(tmp_path / name))
+        st.insert(digest, chunk, verify=False)   # pre-seed the ref target
+        s = cls(st, P, **kw)
+        s.write(a)
+        s.append_ref(digest, len(chunk))
+        s.write(b)
+        rec = s.finish()
+        return rec, s.stats
+
+    rec0, st0 = run(_ChunkedStream, "seq")
+    rec1, st1 = run(PipelinedStream, "pipe", workers=4)
+    assert rec0 == rec1
+    assert st0.ref_chunks == st1.ref_chunks == 1
+    assert st0.bytes_reffed == st1.bytes_reffed == len(chunk)
+
+
+class _JitteryPipeline(PipelinedStream):
+    """Induces hash-stage completion reordering: per-chunk sleeps keyed
+    to content so later chunks often finish first."""
+
+    def _hash_one(self, chunk):
+        time.sleep((chunk[0] % 5) * 0.002 if len(chunk) else 0)
+        return super()._hash_one(chunk)
+
+
+def test_deterministic_order_under_hash_reordering(tmp_path):
+    data = _random_stream(800_000, seed=13)
+    rec0, st0 = _run_seq(tmp_path, data)
+    rec1, st1 = _run_pipe(tmp_path, data, 4, name="jitter",
+                          cls=_JitteryPipeline)
+    assert rec0 == rec1                # commit stays in emission order
+    assert (st0.new_chunks, st0.known_chunks) == \
+        (st1.new_chunks, st1.known_chunks)
+
+
+class _FailingStore:
+    """insert raises after ``ok`` successful inserts."""
+
+    def __init__(self, ok: int):
+        self._left = ok
+
+    def insert(self, digest, data, *, verify=True):
+        if self._left <= 0:
+            raise RuntimeError("store exploded")
+        self._left -= 1
+        return True
+
+    def touch(self, digest):
+        pass
+
+
+def test_insert_failure_propagates_and_releases_threads():
+    data = _random_stream(1_200_000, seed=17)
+    s = PipelinedStream(_FailingStore(ok=3), P, workers=4)
+    with pytest.raises(RuntimeError, match="store exploded"):
+        _feed(s, data)
+    # no wedged committer/pool after the failure — close() idempotent
+    s.close()
+    assert not s._committer.is_alive()
+    # and the stream refuses further writes instead of hanging
+    with pytest.raises(RuntimeError):
+        s.write(b"x" * 100_000)
+
+
+def test_close_without_finish_releases_threads():
+    """Abort path: a session that never reaches finish() must not leak
+    the committer thread or the hash pool."""
+    st = _FailingStore(ok=10**9)
+    s = PipelinedStream(st, P, workers=2)
+    s.write(_random_stream(300_000, seed=19))
+    s.close()
+    assert not s._committer.is_alive()
+
+
+def test_session_writer_pipeline_end_to_end(tmp_path):
+    """SessionWriter(pipeline_workers=4) produces the same indexes and
+    per-file digests as the sequential writer — the knob is safe to flip
+    per job."""
+    import io
+
+    from pbs_plus_tpu.pxar.format import Entry, KIND_DIR, KIND_FILE
+    from pbs_plus_tpu.pxar.transfer import SessionWriter
+
+    files = [(f"d/f{i:02d}", _random_stream(40_000 + i * 7_001, seed=i))
+             for i in range(6)]
+    files.insert(0, ("d/empty", b""))
+
+    def run(name, **kw):
+        st = ChunkStore(str(tmp_path / name))
+        w = SessionWriter(st, payload_params=P, **kw)
+        w.write_entry(Entry(path="", kind=KIND_DIR, mode=0o755))
+        w.write_entry(Entry(path="d", kind=KIND_DIR, mode=0o755))
+        digests = {}
+        for path, blob in files:
+            if blob:
+                digests[path] = w.write_entry_reader(
+                    Entry(path=path, kind=KIND_FILE, mode=0o644),
+                    io.BytesIO(blob))
+            else:
+                w.write_entry(Entry(path=path, kind=KIND_FILE, mode=0o644,
+                                    size=0))
+        midx, pidx, stats = w.finish()
+        return midx, pidx, digests
+
+    m0, p0, d0 = run("seq")
+    m1, p1, d1 = run("pipe", pipeline_workers=4)
+    assert d0 == d1
+    assert [(p0.chunk_bounds(i), p0.digest(i)) for i in range(len(p0))] \
+        == [(p1.chunk_bounds(i), p1.digest(i)) for i in range(len(p1))]
+    assert [(m0.chunk_bounds(i), m0.digest(i)) for i in range(len(m0))] \
+        == [(m1.chunk_bounds(i), m1.digest(i)) for i in range(len(m1))]
+
+
+def test_session_writer_shares_one_locked_store(tmp_path):
+    """Meta (writer thread) and payload (committer thread) insert into
+    the same store concurrently; SessionWriter must hand both streams
+    ONE _LockedStore so those inserts serialize."""
+    from pbs_plus_tpu.pxar.pipeline import _LockedStore
+    from pbs_plus_tpu.pxar.transfer import SessionWriter
+
+    st = ChunkStore(str(tmp_path / "ls"))
+    w = SessionWriter(st, payload_params=P, pipeline_workers=2)
+    assert isinstance(w.payload.store, _LockedStore)
+    assert w.meta.store is w.payload.store
+    w.finish()
+    # sequential sessions stay unwrapped (no lock overhead)
+    w0 = SessionWriter(st, payload_params=P)
+    assert w0.meta.store is st
+
+
+def test_meta_finish_failure_reaps_payload_pipeline(tmp_path):
+    """A meta-stream failure inside SessionWriter.finish must still reap
+    the payload pipeline's pool + committer (no thread leak on the
+    retry-every-60s job path)."""
+    import io
+
+    from pbs_plus_tpu.pxar.format import Entry, KIND_DIR, KIND_FILE
+    from pbs_plus_tpu.pxar.transfer import SessionWriter
+
+    st = ChunkStore(str(tmp_path / "mf"))
+    w = SessionWriter(st, payload_params=P, pipeline_workers=2)
+    w.write_entry(Entry(path="", kind=KIND_DIR, mode=0o755))
+    w.write_entry_reader(Entry(path="f", kind=KIND_FILE, mode=0o644),
+                         io.BytesIO(_random_stream(200_000, seed=3)))
+
+    def boom():
+        raise IOError("meta boom")
+    w.meta.finish = boom
+    with pytest.raises(IOError, match="meta boom"):
+        w.finish()
+    assert not w.payload._committer.is_alive()
+
+
+def test_metrics_snapshot_counts_stages(tmp_path):
+    before = metrics_snapshot()["stages"]["hash"]["bytes"]
+    data = _random_stream(400_000, seed=23)
+    _run_pipe(tmp_path, data, 2, name="metrics")
+    snap = metrics_snapshot()
+    assert snap["stages"]["hash"]["bytes"] >= before + len(data)
+    assert set(snap["stages"]) == {"scan", "hash", "insert"}
+    assert "hash_inflight" in snap["queues"]

@@ -31,7 +31,9 @@ def _encode(entries, payload_offsets=None):
     enc = Pxar2Encoder(buf.write)
     off = 16                              # after the start marker
     for e in entries:
-        if e.kind == KIND_FILE and e.size:
+        if e.kind == KIND_FILE:
+            # every file owns a real PAYLOAD item — zero-length for empty
+            # files (the encoder refuses payload_ref=None files)
             enc.entry(e, (off, e.size))
             off += 16 + e.size
         else:
@@ -351,23 +353,141 @@ def test_default_acl_unset_sentinel_is_u64_max():
     assert n_entries == 1                   # only the named USER entry
 
 
+def test_legacy_u32_default_acl_sentinel_decodes_as_unset():
+    """Snapshots written before the r4 sentinel fix carry u32::MAX in
+    the PXAR_ACL_DEFAULT permission slots; decode must treat them as
+    "unset" (perms are u16-range, so the value is unambiguous) instead
+    of fabricating 0xFFFFFFFF entries (ADVICE r5)."""
+    enc = Pxar2Encoder((buf := io.BytesIO()).write)
+    enc.entry(Entry(path="", kind=KIND_DIR, mode=0o755), None)
+    enc.entry(Entry(path="f", kind=KIND_FILE, mode=0o644, size=0), (16, 0))
+    enc.finish()
+    raw = bytearray(buf.getvalue())
+    # splice a legacy ACL_DEFAULT item (u32::MAX unset slots, one real
+    # user_obj perm) into f's item-set, right before its PAYLOAD_REF
+    legacy = pxarv2.item(pxarv2.PXAR_ACL_DEFAULT, struct.pack(
+        "<QQQQ", 0o7, 0xFFFFFFFF, 0xFFFFFFFF, 0xFFFFFFFF))
+    ref_needle = HDR.pack(PXAR_PAYLOAD_REF, 16 + 16)
+    off = raw.index(ref_needle)
+    spliced = bytes(raw[:off]) + legacy + bytes(raw[off:])
+    ents = list(decode_pxar2(io.BytesIO(spliced)))
+    got = [x for x in ents if x.path == "f"][0]
+    back = got.xattrs["system.posix_acl_default"]
+    entries = [struct.unpack_from("<HHI", back, 4 + i * 8)
+               for i in range((len(back) - 4) // 8)]
+    # exactly the one real USER_OBJ slot — no fabricated u32::MAX perms
+    assert entries == [(0x01, 0o7, 0xFFFFFFFF)]
+
+
+def test_empty_file_without_payload_ref_raises():
+    """payload_ref=None + size==0 on a FILE entry is a writer bug (empty
+    files must own a real zero-length PAYLOAD item); the encoder refuses
+    instead of silently emitting REF(0,0) at the start marker
+    (ADVICE r5)."""
+    enc = Pxar2Encoder(io.BytesIO().write)
+    enc.entry(Entry(path="", kind=KIND_DIR, mode=0o755), None)
+    with pytest.raises(ValueError, match="payload_ref"):
+        enc.entry(Entry(path="f", kind=KIND_FILE, mode=0o644, size=0),
+                  None)
+
+
+def test_empty_refed_file_gets_real_payload_item(tmp_path):
+    """DedupWriter.write_entry_ref with size=0 against a pxar2 previous
+    snapshot must route through _write_file_pxar2 so the empty file's
+    ref points at a real zero-length PAYLOAD item (ADVICE r5)."""
+    from pbs_plus_tpu.pxar.backupproxy import LocalStore
+    from pbs_plus_tpu.pxar.transfer import SplitReader
+
+    store = LocalStore(str(tmp_path / "ds"), PARAMS, pbs_format=True)
+    s1 = store.start_session(backup_type="host", backup_id="e",
+                             backup_time=1_753_000_000)
+    s1.writer.write_entry(Entry(path="", kind=KIND_DIR, mode=0o755))
+    s1.writer.write_entry(Entry(path="empty", kind=KIND_FILE, mode=0o644,
+                                size=0))
+    s1.writer.write_entry_reader(
+        Entry(path="full", kind=KIND_FILE, mode=0o644, size=5),
+        io.BytesIO(b"hello"))
+    s1.finish()
+
+    # incremental: reference both files unchanged from the previous
+    s2 = store.start_session(backup_type="host", backup_id="e",
+                             backup_time=1_753_000_100)
+    prev = s2.previous_reader
+    assert prev is not None and prev.codec == "pxar2"
+    s2.writer.write_entry(Entry(path="", kind=KIND_DIR, mode=0o755))
+    e_prev = prev.lookup("empty")
+    f_prev = prev.lookup("full")
+    s2.writer.write_entry_ref(
+        Entry(path="empty", kind=KIND_FILE, mode=0o644),
+        e_prev.payload_offset if e_prev.payload_offset >= 0 else 0,
+        e_prev.size)
+    s2.writer.write_entry_ref(
+        Entry(path="full", kind=KIND_FILE, mode=0o644),
+        f_prev.payload_offset, f_prev.size)
+    s2.finish()
+
+    ref2 = sorted(store.datastore.list_snapshots(),
+                  key=lambda r: r.backup_time)[-1]
+    r = SplitReader.open_snapshot(store.datastore, ref2)
+    e = r.lookup("empty")
+    assert e is not None and e.size == 0
+    # the decoded Entry maps size==0 refs to payload_offset=-1, so check
+    # the raw meta stream: the empty file's PAYLOAD_REF must aim at a
+    # real zero-length PAYLOAD header, never at the start marker
+    raw = r.read_meta(0, 1 << 20)
+    off, refs = 0, []
+    while off + 16 <= len(raw):
+        htype, size = HDR.unpack_from(raw, off)
+        if htype == PXAR_PAYLOAD_REF:
+            refs.append(struct.unpack_from("<QQ", raw, off + 16))
+        if htype == PXAR_GOODBYE_TAIL_MARKER:
+            off += 16
+            continue
+        off += max(size, 16)
+    empty_refs = [(o, sz) for o, sz in refs if sz == 0]
+    assert len(empty_refs) == 1
+    hdr_off = empty_refs[0][0]
+    assert hdr_off >= 16            # past the 16-byte start marker
+    hdr = r.read_payload(hdr_off, pxarv2.PAYLOAD_HDR_SIZE)
+    htype, size = HDR.unpack(hdr)
+    assert htype == pxarv2.PXAR_PAYLOAD and size == pxarv2.PAYLOAD_HDR_SIZE
+    assert r.read_file(e) == b""
+    assert r.read_file(r.lookup("full")) == b"hello"
+
+
 def test_malformed_stock_acl_raises_valueerror():
     """Out-of-range perms in a decoded ACL item raise ValueError, not
-    struct.error (r4 advisor: u16 clamp on the decode path)."""
-    buf = io.BytesIO()
-    enc = Pxar2Encoder(buf.write)
+    struct.error (r4 advisor: u16 clamp on the decode path) — asserted
+    end-to-end by splicing the malformed item-set into a real archive
+    (ADVICE r5: the spliced set was previously dead code)."""
+    enc = Pxar2Encoder((buf := io.BytesIO()).write)
     enc.entry(Entry(path="", kind=KIND_DIR, mode=0o755), None)
-    raw = bytearray(buf.getvalue())
-    # splice a FILENAME + ENTRY + malformed ACL_USER item-set by hand
+    enc.finish()
+    raw = buf.getvalue()
+    # malformed FILENAME + ENTRY + ACL_USER item-set
     item_set = pxarv2.item(pxarv2.PXAR_FILENAME, b"f\0")
     item_set += pxarv2.item(PXAR_ENTRY, Pxar2Encoder._stat_payload(
         Entry(path="f", kind=KIND_FILE, mode=0o644)))
     item_set += pxarv2.item(pxarv2.PXAR_ACL_USER,
                             struct.pack("<QQ", 1000, 0x1FFFF))  # perm > u16
     item_set += pxarv2.item(PXAR_PAYLOAD_REF, struct.pack("<QQ", 16, 0))
+    # splice it just before the root goodbye table (walk the item frames;
+    # stat payloads cannot alias the GOODBYE type constant)
+    off = 0
+    gb_off = None
+    while off < len(raw):
+        htype, size = HDR.unpack_from(raw, off)
+        if htype == pxarv2.PXAR_GOODBYE:
+            gb_off = off
+            break
+        off += size
+    assert gb_off is not None
+    spliced = raw[:gb_off] + item_set + raw[gb_off:]
+    # decode hits the malformed ACL item before the (now-stale) goodbye
     with pytest.raises(ValueError, match="u16"):
-        # feed the assembler directly (decode_pxar2 consumes whole
-        # archives; the assembler is where the guard lives)
+        list(decode_pxar2(io.BytesIO(spliced)))
+    # and the assembler guard is the layer that raises
+    with pytest.raises(ValueError, match="u16"):
         asm = pxarv2._AclAssembler()
         asm.feed(pxarv2.PXAR_ACL_USER, struct.pack("<QQ", 1000, 0x1FFFF))
 
