@@ -393,8 +393,11 @@ class AgentLifecycle:
                 updater_task.cancel()
                 try:
                     await updater_task
-                except (asyncio.CancelledError, Exception):
-                    pass
+                except asyncio.CancelledError:
+                    pass        # its own cancellation: expected teardown
+                except Exception as e:
+                    self.log.warning("update poller died during "
+                                     "shutdown: %s", e)
 
     async def _run_loop(self, backoff: float, watchdog) -> None:
         while not self._stop.is_set():
@@ -424,8 +427,9 @@ class AgentLifecycle:
                             # OUR task being cancelled must propagate
                             if asyncio.current_task().cancelling():
                                 raise
-                        except Exception:
-                            pass
+                        except Exception as e:
+                            self.log.warning(
+                                "drive pusher died with session: %s", e)
                 self.log.warning("control session lost: %s",
                                  self.conn.close_reason)
             except asyncio.CancelledError:

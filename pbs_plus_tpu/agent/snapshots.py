@@ -232,8 +232,9 @@ class FreezeHandler:
             try:
                 self._run(["fsfreeze", "--unfreeze", mnt],
                           capture_output=True, timeout=30)
-            except Exception:
-                pass
+            except Exception as e:
+                L.error("best-effort unfreeze of %s after failed freeze "
+                        "also failed (fs may be wedged frozen): %s", mnt, e)
             raise
         # frozen: journal + caches quiesced on disk — thaw immediately.
         # A fs left frozen wedges every writer, so a failed thaw is a

@@ -27,6 +27,7 @@ from __future__ import annotations
 import re
 import subprocess
 
+from ...utils.log import L
 from .acls import Runner, WinAcls, _ps, _q
 
 ATTRS_XATTR = "win.attrs"
@@ -161,8 +162,8 @@ class WinMetaCapture:
             keep = [t for t in tokens if t in ATTR_TOKENS]
             if keep:
                 out[ATTRS_XATTR] = ",".join(keep).encode()
-        except Exception:
-            pass
+        except Exception as e:
+            L.debug("attribute capture skipped for %s: %s", path, e)
         try:
             r = self._run(_ps(
                 f"Get-Item -LiteralPath {_q(path)} -Stream * | "
@@ -180,6 +181,6 @@ class WinMetaCapture:
                 import base64
                 out[ADS_PREFIX + name] = base64.b64decode(
                     rb.stdout.strip() or "")
-        except Exception:
-            pass
+        except Exception as e:
+            L.debug("ADS capture skipped for %s: %s", path, e)
         return out

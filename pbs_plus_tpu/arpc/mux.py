@@ -314,8 +314,8 @@ class MuxConnection:
                 t.cancel()
         try:
             self.writer.close()
-        except Exception:
-            pass
+        except Exception as e:
+            L.debug("transport close on dead conn: %s", e)
 
     async def close(self) -> None:
         await self._shutdown("closed locally")   # cancels companion tasks
@@ -323,9 +323,11 @@ class MuxConnection:
             if t is not asyncio.current_task():
                 try:
                     await t
-                except (asyncio.CancelledError, Exception):
-                    pass
+                except asyncio.CancelledError:
+                    pass        # we cancelled it above: expected
+                except Exception as e:
+                    L.debug("companion task died at close: %s", e)
         try:
             await self.writer.wait_closed()
-        except Exception:
-            pass
+        except Exception as e:
+            L.debug("transport wait_closed: %s", e)

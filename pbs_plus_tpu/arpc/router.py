@@ -107,5 +107,5 @@ class Router:
         finally:
             try:
                 await st.close()
-            except Exception:
-                pass
+            except Exception as e:
+                L.debug("stream close after serve: %s", e)

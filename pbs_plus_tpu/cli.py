@@ -15,6 +15,8 @@ import asyncio
 import os
 import sys
 
+from .utils import fsio
+
 
 def _cmd_server(args: argparse.Namespace) -> int:
     from .server.store import Server, ServerConfig
@@ -98,11 +100,10 @@ def _cmd_agent(args: argparse.Namespace) -> int:
             if r.status != 200:
                 raise SystemExit(f"bootstrap failed: {await r.text()}")
             body = await r.json()
-        open(cert_p, "w").write(body["cert"])
-        open(ca_p, "w").write(body["ca"])
-        fd = os.open(key_p, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-        os.write(fd, mtls.key_pem(key))
-        os.close(fd)
+        await fsio.awrite_text(cert_p, body["cert"])
+        await fsio.awrite_text(ca_p, body["ca"])
+        await asyncio.to_thread(fsio.write_private_bytes, key_p,
+                                mtls.key_pem(key))
         print("bootstrapped: certificate stored", flush=True)
 
     async def main():
@@ -147,19 +148,17 @@ def _cmd_signer(args: argparse.Namespace) -> int:
         pub = key.public_key().public_bytes(
             serialization.Encoding.PEM,
             serialization.PublicFormat.SubjectPublicKeyInfo)
-        fd = os.open(args.key, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-        os.write(fd, priv)
-        os.close(fd)
-        open(f"{args.key}.pub", "wb").write(pub)
+        fsio.write_private_bytes(args.key, priv)
+        fsio.write_bytes(f"{args.key}.pub", pub)
         print(f"wrote {args.key} and {args.key}.pub")
         return 0
     if not args.file:
         print(f"signer {args.action} requires --file", flush=True)
         return 2
-    data = open(args.file, "rb").read()
+    data = fsio.read_bytes(args.file)
     if args.action == "sign":
         key = serialization.load_pem_private_key(
-            open(args.key, "rb").read(), password=None)
+            fsio.read_bytes(args.key), password=None)
         from cryptography.hazmat.primitives import hashes
         from cryptography.hazmat.primitives.asymmetric import ec
         if isinstance(key, ed25519.Ed25519PrivateKey):
@@ -169,12 +168,12 @@ def _cmd_signer(args: argparse.Namespace) -> int:
         else:
             print("unsupported key type", flush=True)
             return 2
-        open(f"{args.file}.sig", "wb").write(sig)
+        fsio.write_bytes(f"{args.file}.sig", sig)
         print(f"wrote {args.file}.sig ({len(sig)} bytes)")
         return 0
     # verify
-    sig = open(args.sig or f"{args.file}.sig", "rb").read()
-    ok = verify_signature(data, sig, open(args.key, "rb").read())
+    sig = fsio.read_bytes(args.sig or f"{args.file}.sig")
+    ok = verify_signature(data, sig, fsio.read_bytes(args.key))
     print("OK" if ok else "BAD SIGNATURE")
     return 0 if ok else 1
 
@@ -341,8 +340,9 @@ def main(argv: list[str] | None = None) -> int:
             import jax
             jax.config.update("jax_platforms",
                               os.environ["JAX_PLATFORMS"].split(",")[0])
-        except Exception:
-            pass
+        except Exception as e:
+            from .utils.log import L
+            L.debug("JAX_PLATFORMS override not applied: %s", e)
     p = argparse.ArgumentParser(prog="pbs-plus-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
 

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..ops.sha256 import sha256_chunks, sha256_stream_chunks
+from ..utils.log import L
 
 
 @dataclass
@@ -102,8 +103,9 @@ class VerifyPipeline:
             if ensure_backend() != "cpu":
                 import jax
                 use_device = jax.default_backend() != "cpu"
-        except Exception:
-            pass
+        except Exception as e:
+            L.debug("device backend probe failed; verifying with "
+                    "hashlib: %s", e)
         batch_bytes = 64 << 20
         i = 0
         while i < len(digests):

@@ -57,8 +57,9 @@ class CommitProgress:
         for fn in list(self.listeners):
             try:
                 fn(phase, {**kw, "entries": self.entries})
-            except Exception:
-                pass
+            except Exception as e:
+                L.warning("progress listener raised in phase %s: %s",
+                          phase, e)
 
 
 class CommitEngine:

@@ -351,7 +351,8 @@ class MutableFS:
         parent, name = os.path.split(path.strip("/"))
         pnode = self._materialize_dir(parent)
         cp = self._new_content_path()
-        open(os.path.join(self.passthrough, cp), "wb").close()
+        with open(os.path.join(self.passthrough, cp), "wb"):
+            pass        # create the empty content file
         node = Node(0, KIND_FILE, mode=mode, mtime_ns=time.time_ns(),
                     content_path=cp)
         self.journal.put_node(node)

@@ -12,6 +12,7 @@ from typing import Optional
 
 from ..pxar.format import Entry
 from ..pxar.transfer import SplitReader
+from ..utils.log import L
 
 
 class ArchiveView:
@@ -41,8 +42,8 @@ class ArchiveView:
             if close is not None:
                 try:
                     close()
-                except Exception:
-                    pass
+                except Exception as e:
+                    L.debug("old reader store close after swap: %s", e)
 
     # -- lookups (None-safe for init-mode empty mounts) --------------------
     def lookup(self, path: str) -> Optional[Entry]:

@@ -7,6 +7,8 @@ import os
 import ssl
 from typing import Any, AsyncIterator, Optional
 
+from ..utils import fsio
+
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
@@ -30,8 +32,8 @@ class KubeClient:
     def in_cluster(cls, http) -> "KubeClient":
         host = os.environ["KUBERNETES_SERVICE_HOST"]
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
-        token = open(os.path.join(SA_DIR, "token")).read()
-        ns = open(os.path.join(SA_DIR, "namespace")).read().strip()
+        token = fsio.read_text(os.path.join(SA_DIR, "token"))
+        ns = fsio.read_text(os.path.join(SA_DIR, "namespace")).strip()
         ctx = ssl.create_default_context(
             cafile=os.path.join(SA_DIR, "ca.crt"))
         return cls(http, f"https://{host}:{port}", token=token,

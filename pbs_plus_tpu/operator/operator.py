@@ -154,8 +154,18 @@ class Operator:
                 clone = self.clone_pvc_spec(pvc, snap_name)
                 try:
                     await self.kube.create_pvc(clone)
-                except Exception:
-                    pass                      # already exists
+                except Exception as e:
+                    # isolate the failure to THIS pvc: one broken clone
+                    # (quota, RBAC, transport timeout) must not starve
+                    # the rest of the reconcile round
+                    if getattr(e, "status", None) == 409:
+                        L.debug("create_pvc %s: already exists",
+                                clone["metadata"]["name"])
+                    else:
+                        L.warning("create_pvc %s failed: %s",
+                                  clone["metadata"]["name"], e)
+                        res.skipped.append(f"{name} (clone create failed)")
+                        continue
                 await self.kube.create_pod(
                     self.agent_pod_spec(pvc, clone["metadata"]["name"]))
             else:

@@ -264,6 +264,10 @@ def sha256_stream_chunks(stream, bounds: list[tuple[int, int]], *,
                 ds = dstream_rep
                 stats["mesh_dispatches"] += 1
                 stats["mesh_devices"] = mesh.size
+            # deliberate batched sync: ONE device→host transfer per
+            # dispatch of up to max_batch chunks (the digests must land
+            # on the host), not a per-chunk sync
+            # pbslint: disable=no-hostsync-in-hot-loop
             dig = np.asarray(_sha256_scan(ds, dbs, dbl, t_max,
                                           unroll=unroll, assume_padded=True))
             for k, i in enumerate(part):

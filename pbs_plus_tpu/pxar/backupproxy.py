@@ -121,8 +121,8 @@ class BackupSession:
             self._done = True
             try:
                 self.writer.close()    # reap pipeline threads; _done=True
-            except Exception:          # makes a later abort() a no-op
-                pass
+            except Exception as e:     # makes a later abort() a no-op
+                L.debug("writer close during failed publish: %s", e)
             shutil.rmtree(self._tmp_dir, ignore_errors=True)
             raise
         self._done = True
@@ -153,8 +153,8 @@ class BackupSession:
             self._done = True
             try:
                 self.writer.close()    # park pipeline pool + committer
-            except Exception:
-                pass
+            except Exception as e:
+                L.debug("writer close during abort: %s", e)
             shutil.rmtree(self._tmp_dir, ignore_errors=True)
 
 

@@ -323,14 +323,14 @@ class _PBSHttp:
         if self._h2 is not None:
             try:
                 self._h2.close()
-            except Exception:
-                pass
+            except Exception as e:
+                L.debug("h2 session close: %s", e)
             self._h2 = None
         if self._conn is not None:
             try:
                 self._conn.close()
-            except Exception:
-                pass
+            except Exception as e:
+                L.debug("PBS connection close: %s", e)
             self._conn = None
 
 
@@ -545,8 +545,8 @@ class PBSBackupSession:
             self._done = True
             try:
                 self.writer.close()    # reap pipeline threads; _done=True
-            except Exception:          # makes a later abort() a no-op
-                pass
+            except Exception as e:     # makes a later abort() a no-op
+                L.debug("writer close during failed finish: %s", e)
             self._close_reader()
             self._http.close()         # dropping the session aborts it
             raise
@@ -561,8 +561,8 @@ class PBSBackupSession:
         if self._previous is not None:
             try:
                 self._previous.store.close()
-            except Exception:
-                pass
+            except Exception as e:
+                L.debug("previous-snapshot reader close: %s", e)
 
     def _finish_writer(self):
         midx, pidx, stats = self.writer.finish()
@@ -610,8 +610,8 @@ class PBSBackupSession:
             self._done = True
             try:
                 self.writer.close()    # park pipeline pool + committer
-            except Exception:
-                pass
+            except Exception as e:
+                L.debug("writer close during abort: %s", e)
             self._close_reader()
             self._http.close()         # no /finish → server discards
 

@@ -46,6 +46,7 @@ import weakref
 from concurrent.futures import ThreadPoolExecutor
 
 from ..chunker import ChunkerParams
+from ..utils.log import L
 from .transfer import (
     _HASH_BATCH_BYTES, _HASH_BATCH_COUNT, BatchHasher, ChunkerFactory,
     _ChunkedStream, _default_chunker_factory,
@@ -75,10 +76,32 @@ class _LockedStore:
         return getattr(self._store, name)
 
 
+_wrap_lock = threading.Lock()
+
+
 def locked_store(store) -> _LockedStore:
-    """Idempotent wrap (an already-locked store is returned as is, so
-    two streams built from one wrap share one lock)."""
-    return store if isinstance(store, _LockedStore) else _LockedStore(store)
+    """Idempotent AND memoized: one proxy — therefore ONE lock — per
+    underlying store object.  Memoization matters because the server
+    runs concurrent jobs over the SAME shared ChunkStore (jobs.py
+    max_concurrent > 1, backupproxy hands every session
+    ``datastore.chunks``): per-writer locks would each "protect" the
+    same non-thread-safe zstd context from a different lock."""
+    if isinstance(store, _LockedStore):
+        return store
+    with _wrap_lock:
+        proxy = getattr(store, "_locked_proxy", None)
+        if proxy is None:
+            proxy = _LockedStore(store)
+            try:
+                store._locked_proxy = proxy
+            except AttributeError:
+                # __slots__ store: per-call proxies means per-caller
+                # LOCKS — cross-writer serialization is lost, so say so
+                L.warning(
+                    "locked_store: %s rejects attribute memoization; "
+                    "concurrent writers will NOT share one lock",
+                    type(store).__name__)
+    return proxy
 
 
 class PipelineMetrics:
@@ -169,6 +192,7 @@ class PipelinedStream(_ChunkedStream):
         self._hash_inflight = 0     # gauge only; racy int updates are fine
         self._closed = False
         self._finished = False
+        self._finish_ok = False     # set only by a successful finish()
         self._committer = threading.Thread(
             target=self._commit_loop, name="pipeline-commit", daemon=True)
         self._committer.start()
@@ -253,6 +277,14 @@ class PipelinedStream(_ChunkedStream):
 
     def finish(self) -> list[tuple[int, bytes]]:
         if self._finished:
+            # finish() after close()/failure must never hand back
+            # records with un-committed b"" digest slots — a caller
+            # would silently build a corrupt index from them
+            if self._exc is not None:
+                raise self._exc
+            if not self._finish_ok:
+                raise RuntimeError(
+                    "finish() after close(): stream was aborted")
             return self.records
         if self._buf:
             self.flush_chunker()
@@ -261,6 +293,7 @@ class PipelinedStream(_ChunkedStream):
         self._shutdown()
         if self._exc is not None:
             raise self._exc
+        self._finish_ok = True
         return self.records
 
     def close(self) -> None:

@@ -250,8 +250,9 @@ class RemoteTreeBackup:
                 if not closer.done():
                     try:
                         await closer
-                    except (asyncio.CancelledError, Exception):
-                        pass
+                    except (asyncio.CancelledError, Exception) as e:
+                        self.log.debug(
+                            "writer close raced job cancel: %s", e)
                 raise
         if self._writer_exc is not None:
             raise self._writer_exc
@@ -340,8 +341,8 @@ class RemoteTreeBackup:
                 None, fq.put, _SENTINEL)
             try:
                 await self.fs.close(handle)
-            except Exception:
-                pass
+            except Exception as e:
+                self.log.debug("agentfs close failed for %s: %s", rel, e)
         self.result.files += 1
 
     def _drain_reader(self, reader) -> None:
@@ -634,10 +635,11 @@ async def run_backup_job(row: database.BackupJobRow, *,
             sess_info = agents.get(client_id)
             if sess_info is not None:
                 await sess_info.conn.close()
-        except Exception:
-            pass
+        except Exception as e:
+            log.debug("job data session close failed: %s", e)
         # tear down the agent-side job session (reference: "cleanup" RPC)
         try:
             await control_sess.call("cleanup", {"job_id": job_id}, timeout=15)
-        except Exception:
-            pass
+        except Exception as e:
+            log.warning("agent cleanup RPC failed (agent may leak a "
+                        "snapshot): %s", e)

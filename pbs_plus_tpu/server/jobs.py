@@ -69,8 +69,11 @@ class JobsManager:
         t.cancel()
         try:
             await t
-        except (asyncio.CancelledError, Exception):
-            pass
+        except asyncio.CancelledError:
+            pass        # the cancellation we just requested
+        except Exception as e:
+            L.with_scope(job_id=job_id).warning(
+                "job raised while being cancelled: %s", e)
         return True
 
     async def _run(self, job: Job) -> None:

@@ -12,6 +12,8 @@ import os
 import time
 from typing import TYPE_CHECKING
 
+from ..utils.log import L
+
 if TYPE_CHECKING:
     from .store import Server
 
@@ -26,6 +28,10 @@ class MetricsRegistry:
     def __init__(self, server: "Server"):
         self.server = server
         self._ds_scan: tuple[float, int, int] = (0.0, 0, 0)
+        # warn ONCE per unreadable manifest, not once per scrape: a
+        # permanently corrupt snapshot would otherwise re-warn every
+        # Prometheus interval
+        self._warned_manifests: set[str] = set()
 
     def _datastore_usage(self) -> tuple[int, int]:
         """(chunk_count, chunk_disk_bytes), cached — walking the chunk
@@ -95,8 +101,12 @@ class MetricsRegistry:
                 man = s.datastore.datastore.load_manifest(ref)
                 size_per_group[key] = size_per_group.get(key, 0) + \
                     man.get("payload_size", 0)
-            except Exception:
-                pass    # a corrupt manifest must not kill the scrape
+            except Exception as e:
+                # a corrupt manifest must not kill the scrape
+                if str(ref) not in self._warned_manifests:
+                    self._warned_manifests.add(str(ref))
+                    L.warning("metrics: manifest unreadable for %s/%s: %s",
+                              ref.backup_type, ref.backup_id, e)
         gauge("pbs_plus_snapshots_per_group", "Snapshots per backup group",
               [({"group": g}, float(n)) for g, n in per_group.items()])
         gauge("pbs_plus_snapshot_bytes", "Logical bytes per backup group",

@@ -88,8 +88,8 @@ async def run_restore_job(server, rid: str, *, target: str, snapshot: str,
         try:
             await control_sess.call("cleanup_restore", {"job_id": rid},
                                     timeout=15)
-        except Exception:
-            pass
+        except Exception as e:
+            log.warning("agent cleanup_restore RPC failed: %s", e)
 
 
 def enqueue_restore(server, *, target: str, snapshot: str,

@@ -249,8 +249,10 @@ class Server:
         for t in self._tasks:
             try:
                 await t
-            except (asyncio.CancelledError, Exception):
-                pass
+            except asyncio.CancelledError:
+                pass        # we cancelled it above
+            except Exception as e:
+                L.debug("server task died at shutdown: %s", e)
         for sess in self.agents.sessions():
             await sess.conn.close()
         if self._arpc_server is not None:

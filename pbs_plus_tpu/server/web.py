@@ -41,6 +41,7 @@ from typing import TYPE_CHECKING
 
 from aiohttp import web
 
+from ..utils import fsio
 from ..utils.log import L
 from ..utils.singleflight import SingleFlight
 from . import database
@@ -225,7 +226,7 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
             return web.json_response({"error": str(last_err)}, status=403)
         return web.json_response({
             "cert": cert.decode(),
-            "ca": open(server.certs.ca_cert_path).read(),
+            "ca": await fsio.aread_text(server.certs.ca_cert_path),
         })
 
     async def agent_renew(request):
@@ -901,9 +902,9 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
         from cryptography import x509
 
         from ..utils import mtls as _mtls
-        with open(server.certs.server_cert_path, "rb") as f:
-            fp = _mtls.cert_fingerprint(
-                x509.load_pem_x509_certificate(f.read()))
+        cert_pem = await fsio.aread_bytes(server.certs.server_cert_path)
+        fp = _mtls.cert_fingerprint(
+            x509.load_pem_x509_certificate(cert_pem))
         script = f"""# pbs-plus-tpu agent install (Windows)
 param(
     [string]$Server = "",
@@ -977,8 +978,8 @@ if ($Server) {{
             names = []
         for n in names:
             try:
-                with open(os.path.join(spool, n)) as f:
-                    out.append(json.load(f))
+                out.append(json.loads(
+                    await fsio.aread_text(os.path.join(spool, n))))
             except (OSError, ValueError):
                 continue
         return web.json_response({"data": out})
@@ -991,8 +992,7 @@ if ($Server) {{
         # TLS pinned to this deployment's CA (no -k: an install-time MITM
         # could otherwise substitute a malicious agent before the Ed25519
         # update verification ever gets a chance to run).
-        with open(server.certs.ca_cert_path) as f:
-            ca_pem = f.read()
+        ca_pem = await fsio.aread_text(server.certs.ca_cert_path)
         if not ca_pem.endswith("\n"):     # keep the heredoc terminator on
             ca_pem += "\n"                # its own line for any ca.pem
         script = f"""#!/bin/sh
@@ -1242,7 +1242,7 @@ def _signer_keys(server) -> tuple[bytes, bytes]:
             # the public key at install; a new pair would brick fleet
             # auto-update silently.  The pub is derived, not trusted
             # from disk, so a missing/partial .pub self-heals.
-            priv = open(key_p, "rb").read()
+            priv = fsio.read_bytes(key_p)
             key = serialization.load_pem_private_key(priv, password=None)
             pub = key.public_key().public_bytes(
                 serialization.Encoding.PEM,
@@ -1290,7 +1290,7 @@ def _agent_release_info(server) -> dict:
     if hit is not None and hit[0] == mtime:
         _release_cache[state] = (mtime, hit[1], now)
         return hit[1]
-    data = open(pyz, "rb").read()
+    data = fsio.read_bytes(pyz)
     digest = hashlib.sha256(data).hexdigest()
     priv_pem, _pub = _signer_keys(server)
     key = serialization.load_pem_private_key(priv_pem, password=None)

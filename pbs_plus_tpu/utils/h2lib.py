@@ -357,7 +357,9 @@ class _SessionBase:
     def __del__(self):  # pragma: no cover - GC ordering
         try:
             self.close()
-        except Exception:
+        # finalizer during interpreter teardown: the logging stack may
+        # already be gone, so this one stays dark by design
+        except Exception:   # pbslint: disable=no-silent-swallow
             pass
 
 

@@ -16,7 +16,8 @@ def _cgroup_limit() -> int | None:
     for path in ("/sys/fs/cgroup/memory.max",
                  "/sys/fs/cgroup/memory/memory.limit_in_bytes"):
         try:
-            raw = open(path).read().strip()
+            with open(path) as f:
+                raw = f.read().strip()
             if raw in ("max", ""):
                 continue
             v = int(raw)

@@ -195,3 +195,56 @@ def test_native_mt_bit_identical():
     small = _data(100_000, seed=22)
     assert np.array_equal(native.candidates(small, P, threads=0),
                           native.candidates(small, P, threads=1))
+
+
+def test_native_probe_fails_closed_on_hung_toolchain(monkeypatch, tmp_path):
+    """A hung g++ (subprocess timeout) must make the native probe fail
+    CLOSED: _build returns False, available() turns False, candidates()
+    raises — never a wedged agent waiting on the compiler forever.
+    The pbslint subprocess-timeout rule pins the timeout= that makes
+    this reachable at all."""
+    import subprocess
+
+    def hung_run(cmd, *a, **kw):
+        assert kw.get("timeout"), "native build must pass timeout="
+        raise subprocess.TimeoutExpired(cmd, kw["timeout"])
+
+    monkeypatch.setattr(native.subprocess, "run", hung_run)
+    # force the build path: a source newer than any .so, private workdir
+    so = tmp_path / "libbuzhash_native.so"
+    src = tmp_path / "buzhash_native.cpp"
+    src.write_text("// pretend source")
+    monkeypatch.setattr(native, "_SO", str(so))
+    monkeypatch.setattr(native, "_SRC", str(src))
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load_failed", False)
+
+    assert native._build() is False
+    assert not so.exists()                  # no half-written artifact
+    assert native.available() is False      # probe latches failed
+    with pytest.raises(RuntimeError):
+        native.candidates(b"x" * 1024, P)
+
+
+def test_native_probe_fail_closed_leaves_no_tmp(monkeypatch, tmp_path):
+    """An interrupted build cleans up its tmp artifact (the atomic
+    os.replace contract: _SO either appears whole or not at all)."""
+    import subprocess
+
+    so = tmp_path / "libbuzhash_native.so"
+    src = tmp_path / "buzhash_native.cpp"
+    src.write_text("// pretend source")
+
+    def half_write_then_hang(cmd, *a, **kw):
+        # simulate the compiler dying after creating its output
+        [out] = [c for c in cmd if ".tmp." in str(c)]
+        with open(out, "wb") as f:
+            f.write(b"partial")
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 0))
+
+    monkeypatch.setattr(native.subprocess, "run", half_write_then_hang)
+    monkeypatch.setattr(native, "_SO", str(so))
+    monkeypatch.setattr(native, "_SRC", str(src))
+    assert native._build() is False
+    assert not so.exists()
+    assert list(tmp_path.glob("*.tmp.*")) == []

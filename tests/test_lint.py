@@ -1,0 +1,689 @@
+"""pbslint battery: one positive + one negative fixture per rule,
+baseline ratchet semantics, inline/file suppression parsing, CLI exit
+codes, and the acceptance gate (the live tree lints clean against the
+committed baseline; a seeded violation fails)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.lint import Baseline, lint_source
+from tools.lint.baseline import Baseline as _B
+from tools.lint.core import REPO_ROOT, Violation, lint_paths
+from tools.lint.rules import build_rules, rule_names
+
+
+def run_lint(src, path="pbs_plus_tpu/fake.py", rules=None):
+    only = set(rules) if rules else None
+    return lint_source(textwrap.dedent(src), path,
+                       build_rules(only), relativize=False)
+
+
+def names(violations):
+    return [v.rule for v in violations]
+
+
+# ---------------------------------------------------------------- rules
+
+
+def test_registry_has_expected_rules():
+    assert set(rule_names()) == {
+        "no-silent-swallow", "no-blocking-in-async",
+        "locked-store-discipline", "jit-purity",
+        "no-hostsync-in-hot-loop", "subprocess-timeout",
+        "thread-hygiene", "resource-ctx", "mutable-default",
+    }
+
+
+def test_swallow_flags_broad_pass():
+    v = run_lint("""
+        try:
+            x = 1
+        except Exception:
+            pass
+    """)
+    assert names(v) == ["no-silent-swallow"]
+    assert v[0].line == 4
+
+
+def test_swallow_flags_bare_except_and_tuple():
+    v = run_lint("""
+        try:
+            x = 1
+        except:
+            cleanup()
+        try:
+            y = 2
+        except (ValueError, Exception):
+            ...
+    """)
+    assert names(v) == ["no-silent-swallow"] * 2
+
+
+def test_swallow_negative_logging_or_raise_or_narrow():
+    v = run_lint("""
+        try:
+            x = 1
+        except Exception as e:
+            L.warning("boom: %s", e)
+        try:
+            y = 2
+        except Exception:
+            raise
+        except OSError:
+            pass
+        try:
+            z = 3
+        except:
+            raise
+    """)
+    assert v == []
+
+
+def test_async_blocking_positive():
+    v = run_lint("""
+        import time, subprocess
+
+        async def handler():
+            time.sleep(1)
+            subprocess.run(["x"], timeout=5)
+    """)
+    assert names(v) == ["no-blocking-in-async"] * 2
+
+
+def test_async_blocking_negative_sync_def_and_nested():
+    v = run_lint("""
+        import time
+
+        def worker():
+            time.sleep(1)              # sync context: fine
+
+        async def outer():
+            def inner():
+                time.sleep(1)          # nested sync def: fine
+            await asyncio.sleep(1)
+    """, rules=["no-blocking-in-async"])
+    assert v == []
+
+
+def test_async_blocking_open_only_in_server():
+    src = """
+        async def handler():
+            with open("/etc/x") as f:
+                return f.read()
+    """
+    assert names(run_lint(src, path="pbs_plus_tpu/server/web.py",
+                          rules=["no-blocking-in-async"])) == \
+        ["no-blocking-in-async"]
+    assert run_lint(src, path="pbs_plus_tpu/agent/x.py",
+                    rules=["no-blocking-in-async"]) == []
+
+
+def test_async_blocking_flags_sync_fsio():
+    # the gap this suite itself could open: fsio's sync halves used in
+    # an async handler bypass a lexical open() check
+    v = run_lint("""
+        from pbs_plus_tpu.utils import fsio
+
+        async def handler(p):
+            return fsio.read_bytes(p)
+    """, rules=["no-blocking-in-async"])
+    assert names(v) == ["no-blocking-in-async"]
+    v = run_lint("""
+        from pbs_plus_tpu.utils import fsio
+
+        async def handler(p):
+            return await fsio.aread_bytes(p)
+    """, rules=["no-blocking-in-async"])
+    assert v == []
+
+
+def test_store_discipline_positive():
+    v = run_lint("""
+        from concurrent.futures import ThreadPoolExecutor
+
+        class W:
+            def go(self):
+                self._pool = ThreadPoolExecutor(2)
+                self.store.insert(b"d", b"c")
+                self._store.touch(b"d")
+    """, path="pbs_plus_tpu/pxar/x.py", rules=["locked-store-discipline"])
+    assert names(v) == ["locked-store-discipline"] * 2
+
+
+def test_store_discipline_negative():
+    # unthreaded module, wrapped receiver, _LockedStore itself, non-pxar
+    threaded = """
+        import threading
+
+        class _LockedStore:
+            def insert(self, d, c):
+                self._store.insert(d, c)
+
+        def go(store):
+            threading.Thread(target=None, daemon=True)
+            locked_store(store).insert(b"d", b"c")
+    """
+    assert run_lint(threaded, path="pbs_plus_tpu/pxar/x.py",
+                    rules=["locked-store-discipline"]) == []
+    unthreaded = """
+        def go(store):
+            store.insert(b"d", b"c")
+    """
+    assert run_lint(unthreaded, path="pbs_plus_tpu/pxar/x.py",
+                    rules=["locked-store-discipline"]) == []
+    assert run_lint(threaded.replace("locked_store(store)", "store"),
+                    path="pbs_plus_tpu/models/x.py",
+                    rules=["locked-store-discipline"]) == []
+
+
+def test_jit_purity_positive_decorated():
+    v = run_lint("""
+        import functools, time, jax
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def kernel(x, k):
+            t = time.time()
+            print(x)
+            return x * t
+    """, rules=["jit-purity"])
+    assert names(v) == ["jit-purity"] * 2
+
+
+def test_jit_purity_positive_wrapped_and_mutation():
+    v = run_lint("""
+        import jax
+        import numpy as np
+
+        _count = 0
+
+        def impl(x):
+            global _count
+            _count += 1
+            return np.asarray(x).item()
+
+        impl_jit = jax.jit(impl)
+    """, rules=["jit-purity"])
+    assert sorted(names(v)) == ["jit-purity"] * 3   # global, asarray, item
+
+
+def test_jit_purity_negative():
+    v = run_lint("""
+        import time, jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x):
+            return jnp.asarray(x) + 1
+
+        def host_side():
+            return time.time()      # not jitted: fine
+    """, rules=["jit-purity"])
+    assert v == []
+
+
+def test_hostsync_positive():
+    v = run_lint("""
+        import jax
+
+        def scan(xs):
+            out = []
+            for x in xs:
+                out.append(x.item())
+                jax.device_get(x)
+            return out
+    """, path="pbs_plus_tpu/ops/x.py", rules=["no-hostsync-in-hot-loop"])
+    assert names(v) == ["no-hostsync-in-hot-loop"] * 2
+
+
+def test_hostsync_negative_outside_loop_and_scope():
+    src = """
+        import jax
+
+        def once(x):
+            return x.item()         # not in a loop
+    """
+    assert run_lint(src, path="pbs_plus_tpu/ops/x.py",
+                    rules=["no-hostsync-in-hot-loop"]) == []
+    loop = """
+        import jax
+
+        def scan(xs):
+            return [x.item() for x in xs]
+    """
+    # outside chunker/ops/parallel the rule is inert
+    assert run_lint(loop.replace("import jax", "import jax\n"),
+                    path="pbs_plus_tpu/server/x.py",
+                    rules=["no-hostsync-in-hot-loop"]) == []
+    # numpy-only module (no jax import): np.asarray in a loop is free
+    numpy_only = """
+        import numpy as np
+
+        def scan(xs):
+            for x in xs:
+                np.asarray(x)
+    """
+    assert run_lint(numpy_only, path="pbs_plus_tpu/chunker/x.py",
+                    rules=["no-hostsync-in-hot-loop"]) == []
+
+
+def test_subprocess_timeout_positive():
+    v = run_lint("""
+        import subprocess
+        from subprocess import check_output
+
+        def go():
+            subprocess.run(["x"], check=True)
+            check_output(["y"])
+            subprocess.Popen(["z"])
+    """, rules=["subprocess-timeout"])
+    assert names(v) == ["subprocess-timeout"] * 3
+
+
+def test_subprocess_timeout_negative():
+    v = run_lint("""
+        import subprocess
+
+        def go(run):
+            subprocess.run(["x"], timeout=30)
+            run(["y"])      # injected runner: the default carries timeout
+    """, rules=["subprocess-timeout"])
+    assert v == []
+
+
+def test_thread_hygiene_positive():
+    v = run_lint("""
+        import threading
+
+        def go(items):
+            t = threading.Thread(target=None)
+            for _ in items:
+                lk = threading.Lock()
+    """, rules=["thread-hygiene"])
+    assert names(v) == ["thread-hygiene"] * 2
+
+
+def test_thread_hygiene_negative():
+    v = run_lint("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._t = threading.Thread(target=None, daemon=True)
+    """, rules=["thread-hygiene"])
+    assert v == []
+
+
+def test_resource_ctx_positive():
+    v = run_lint("""
+        def leak(p):
+            data = open(p).read()
+            f = open(p, "rb")
+            return data
+    """, rules=["resource-ctx"])
+    assert names(v) == ["resource-ctx"] * 2
+
+
+def test_resource_ctx_negative():
+    v = run_lint("""
+        def fine(p, q):
+            with open(p) as f:
+                data = f.read()
+            g = open(q)
+            try:
+                g.read()
+            finally:
+                g.close()
+            return data
+
+        def handoff(p):
+            return open(p)          # ownership transfers to the caller
+
+        def stored(self, p):
+            self.fh = open(p)       # owner object closes it
+    """, rules=["resource-ctx"])
+    assert v == []
+
+
+def test_resource_ctx_flags_non_owning_consumers():
+    v = run_lint("""
+        import json
+
+        def load_cfg(p):
+            return json.load(open(p))
+    """, rules=["resource-ctx"])
+    assert names(v) == ["resource-ctx"]
+    # genuine ownership transfer to an unknown callee stays exempt
+    v = run_lint("""
+        def hand_off(p, owner):
+            owner.adopt(open(p))
+    """, rules=["resource-ctx"])
+    assert v == []
+
+
+def test_mutable_default_positive_and_negative():
+    v = run_lint("""
+        def bad(xs=[]):
+            return xs
+
+        def also_bad(m=dict()):
+            return m
+
+        def fine(xs=None, n=3, s="x"):
+            return xs or []
+    """, rules=["mutable-default"])
+    assert names(v) == ["mutable-default"] * 2
+
+
+# ------------------------------------------------------- suppressions
+
+
+def test_inline_disable_same_line():
+    v = run_lint("""
+        try:
+            x = 1
+        except Exception:   # pbslint: disable=no-silent-swallow
+            pass
+    """)
+    assert v == []
+
+
+def test_inline_disable_comment_line_above():
+    v = run_lint("""
+        try:
+            x = 1
+        # pbslint: disable=no-silent-swallow
+        except Exception:
+            pass
+    """)
+    assert v == []
+
+
+def test_inline_disable_wrong_rule_does_not_suppress():
+    v = run_lint("""
+        try:
+            x = 1
+        except Exception:   # pbslint: disable=resource-ctx
+            pass
+    """)
+    assert names(v) == ["no-silent-swallow"]
+
+
+def test_disable_inside_string_literal_does_not_suppress():
+    # only real COMMENT tokens suppress; docs/help strings must not
+    v = run_lint("""
+        HELP = "suppress with # pbslint: disable=all"
+
+        def f(xs=[]):
+            return xs
+    """)
+    assert "mutable-default" in names(v)
+    v = run_lint("""
+        try:
+            x = 1
+        except Exception:   # pbslint: disable=all
+            pass
+    """)
+    assert v == []      # but a REAL comment still works
+
+
+def test_disable_all_and_disable_file():
+    v = run_lint("""
+        try:
+            x = 1
+        except Exception:   # pbslint: disable=all
+            pass
+    """)
+    assert v == []
+    v = run_lint("""
+        # pbslint: disable-file=no-silent-swallow
+        try:
+            x = 1
+        except Exception:
+            pass
+
+        def bad(xs=[]):
+            return xs
+    """)
+    assert names(v) == ["mutable-default"]      # file-disable is per-rule
+
+
+# ----------------------------------------------------------- baseline
+
+
+def V(path, rule, line=1):
+    return Violation(rule, path, line, "m")
+
+
+def test_baseline_ratchet_new_violation_fails():
+    bl = _B({"a.py::no-silent-swallow": 1})
+    diff = bl.compare([V("a.py", "no-silent-swallow"),
+                       V("a.py", "no-silent-swallow", 9)])
+    # only the EXCESS beyond the bucket is new, and counting is stable
+    # in file order: the first stays deferred, the line-9 one reports
+    assert not diff.ok
+    assert [v.line for v in diff.new] == [9]
+    assert diff.baselined == 1
+
+
+def test_baseline_ratchet_baselined_passes_and_stale_reported():
+    bl = _B({"a.py::no-silent-swallow": 2})
+    diff = bl.compare([V("a.py", "no-silent-swallow")])
+    assert diff.ok and diff.baselined == 1
+    assert diff.stale == {"a.py::no-silent-swallow": 1}
+
+
+def test_baseline_other_file_not_borrowed():
+    # counts are per (file, rule): headroom in a.py must not excuse b.py
+    bl = _B({"a.py::no-silent-swallow": 5})
+    diff = bl.compare([V("b.py", "no-silent-swallow")])
+    assert not diff.ok
+
+
+def test_baseline_roundtrip(tmp_path):
+    p = str(tmp_path / "bl.json")
+    _B({"a.py::r": 2, "b.py::q": 1}).save(p)
+    assert Baseline.load(p).entries == {"a.py::r": 2, "b.py::q": 1}
+    assert Baseline.load(str(tmp_path / "missing.json")).entries == {}
+
+
+def test_baseline_rejects_bad_counts(tmp_path):
+    p = tmp_path / "bl.json"
+    p.write_text(json.dumps({"version": 1, "entries": {"a.py::r": 0}}))
+    with pytest.raises(ValueError):
+        Baseline.load(str(p))
+
+
+# ---------------------------------------------------------- CLI / gate
+
+
+def _cli(args, cwd=REPO_ROOT):
+    return subprocess.run([sys.executable, "-m", "tools.lint", *args],
+                          capture_output=True, text=True, cwd=cwd,
+                          timeout=120)
+
+
+def test_cli_live_tree_is_clean_against_committed_baseline():
+    r = _cli(["pbs_plus_tpu"])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_seeded_violation_fails(tmp_path):
+    bad = tmp_path / "seeded.py"
+    bad.write_text("try:\n    x = 1\nexcept Exception:\n    pass\n")
+    r = _cli([str(bad)])
+    assert r.returncode == 1
+    assert "no-silent-swallow" in r.stdout
+
+
+def test_cli_json_output(tmp_path):
+    bad = tmp_path / "seeded.py"
+    bad.write_text("def f(xs=[]):\n    return xs\n")
+    r = _cli(["--json", str(bad)])
+    data = json.loads(r.stdout)
+    assert data["ok"] is False
+    assert data["new"][0]["rule"] == "mutable-default"
+
+
+def test_cli_write_baseline_refuses_growth(tmp_path):
+    bad = tmp_path / "seeded.py"
+    bad.write_text("try:\n    x = 1\nexcept Exception:\n    pass\n")
+    bl = tmp_path / "bl.json"
+    _B({}).save(str(bl))
+    r = _cli(["--baseline", str(bl), "--write-baseline", str(bad)])
+    assert r.returncode == 2 and "refusing to GROW" in r.stderr
+    r = _cli(["--baseline", str(bl), "--write-baseline", "--force",
+              str(bad)])
+    assert r.returncode == 0
+    entries = json.loads(bl.read_text())["entries"]
+    assert list(entries.values()) == [1]
+    # with the forced baseline the same tree now passes
+    r = _cli(["--baseline", str(bl), str(bad)])
+    assert r.returncode == 0
+
+
+def test_cli_parse_error_fails(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    r = _cli([str(bad)])
+    assert r.returncode == 1 and "PARSE ERROR" in r.stdout
+
+
+def test_committed_baseline_is_small():
+    """Acceptance: the committed ratchet defers at most 10 violations."""
+    bl = Baseline.load(os.path.join(REPO_ROOT, "tools",
+                                    "lint_baseline.json"))
+    assert bl.total() <= 10
+
+
+def test_lint_paths_walks_and_sorts(tmp_path):
+    (tmp_path / "b.py").write_text("def f(xs=[]):\n    return xs\n")
+    (tmp_path / "a.py").write_text("def g(m={}):\n    return m\n")
+    (tmp_path / "__pycache__").mkdir()
+    (tmp_path / "__pycache__" / "c.py").write_text("def h(s=set()): pass\n")
+    res = lint_paths([str(tmp_path)], build_rules({"mutable-default"}))
+    assert res.files == 2                       # __pycache__ skipped
+    assert [os.path.basename(v.path) for v in res.violations] == \
+        ["a.py", "b.py"]
+
+
+# ------------------------------------------------- utils.fsio helpers
+# fsio exists because of two rules (resource-ctx funnels small-file IO
+# here; no-blocking-in-async funnels server handlers to the a* forms),
+# so its contract is pinned alongside them.
+
+
+def test_fsio_roundtrip_and_private_mode(tmp_path):
+    from pbs_plus_tpu.utils import fsio
+    p = str(tmp_path / "f.txt")
+    fsio.write_text(p, "hi")
+    assert fsio.read_text(p) == "hi"
+    b = str(tmp_path / "f.bin")
+    fsio.write_bytes(b, b"\x00\x01")
+    assert fsio.read_bytes(b) == b"\x00\x01"
+    k = str(tmp_path / "key.pem")
+    fsio.write_private_bytes(k, b"secret")
+    assert fsio.read_bytes(k) == b"secret"
+    assert os.stat(k).st_mode & 0o777 == 0o600
+
+
+def test_fsio_async_forms(tmp_path):
+    import asyncio
+
+    from pbs_plus_tpu.utils import fsio
+
+    async def go():
+        p = str(tmp_path / "a.txt")
+        await fsio.awrite_text(p, "x")
+        assert await fsio.aread_text(p) == "x"
+        await fsio.awrite_bytes(p, b"y")
+        assert await fsio.aread_bytes(p) == b"y"
+
+    asyncio.run(go())
+
+
+def test_cli_write_baseline_refuses_parse_errors(tmp_path):
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    bl = tmp_path / "bl.json"
+    r = _cli(["--baseline", str(bl), "--write-baseline", "--force",
+              str(tmp_path)])
+    assert r.returncode == 1 and "refusing" in r.stderr
+    assert not bl.exists()
+
+
+def test_cli_write_baseline_bad_existing_baseline_exits_2(tmp_path):
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    bl = tmp_path / "bl.json"
+    bl.write_text("{not json")
+    r = _cli(["--baseline", str(bl), "--write-baseline", str(tmp_path)])
+    assert r.returncode == 2 and "bad baseline" in r.stderr
+
+
+def test_fsio_private_mode_reasserted_on_existing_file(tmp_path):
+    from pbs_plus_tpu.utils import fsio
+    p = str(tmp_path / "key.pem")
+    with open(p, "w") as f:         # pre-existing world-readable file
+        f.write("old")
+    os.chmod(p, 0o644)
+    fsio.write_private_bytes(p, b"new-secret")
+    assert os.stat(p).st_mode & 0o777 == 0o600
+    assert fsio.read_bytes(p) == b"new-secret"
+
+
+def test_locked_store_slots_fallback_still_locks(tmp_path):
+    """A store that rejects attribute memoization still gets a working
+    per-call proxy (with a warning) — never an unwrapped store."""
+    from pbs_plus_tpu.pxar.pipeline import _LockedStore, locked_store
+
+    class SlotsStore:
+        __slots__ = ()
+        def insert(self, d, c, *, verify=True): return True
+        def touch(self, d): pass
+
+    st = SlotsStore()
+    p = locked_store(st)
+    assert isinstance(p, _LockedStore)
+    assert p.insert(b"d", b"c") is True
+
+
+def test_cli_write_baseline_subset_preserves_out_of_scope_buckets(tmp_path):
+    """Reproduces the round-6 finding: ratcheting down on a path subset
+    must not delete deferral state for files it never linted."""
+    sub = tmp_path / "pkg"
+    sub.mkdir()
+    (sub / "clean.py").write_text("x = 1\n")
+    bl = tmp_path / "bl.json"
+    _B({"elsewhere/web.py::no-silent-swallow": 3}).save(str(bl))
+    r = _cli(["--baseline", str(bl), "--write-baseline", str(sub)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    entries = json.loads(bl.read_text())["entries"]
+    assert entries == {"elsewhere/web.py::no-silent-swallow": 3}
+    # but a bucket FOR a linted file does ratchet away when fixed
+    rel = os.path.relpath(str(sub / "clean.py"), REPO_ROOT).replace(
+        os.sep, "/")
+    _B({f"{rel}::mutable-default": 2,
+        "elsewhere/web.py::no-silent-swallow": 3}).save(str(bl))
+    r = _cli(["--baseline", str(bl), "--write-baseline", str(sub)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    entries = json.loads(bl.read_text())["entries"]
+    assert entries == {"elsewhere/web.py::no-silent-swallow": 3}
+
+
+def test_cli_write_baseline_rules_subset_preserves_other_rules(tmp_path):
+    """--rules subset writes must leave other rules' buckets alone."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(xs=[]):\n    return xs\n")
+    rel = os.path.relpath(str(bad), REPO_ROOT).replace(os.sep, "/")
+    bl = tmp_path / "bl.json"
+    _B({f"{rel}::no-silent-swallow": 1}).save(str(bl))
+    r = _cli(["--baseline", str(bl), "--write-baseline", "--force",
+              "--rules", "mutable-default", str(bad)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    entries = json.loads(bl.read_text())["entries"]
+    assert entries == {f"{rel}::no-silent-swallow": 1,
+                       f"{rel}::mutable-default": 1}

@@ -269,3 +269,41 @@ def test_metrics_snapshot_counts_stages(tmp_path):
     assert snap["stages"]["hash"]["bytes"] >= before + len(data)
     assert set(snap["stages"]) == {"scan", "hash", "insert"}
     assert "hash_inflight" in snap["queues"]
+
+
+def test_locked_store_memoized_across_writers(tmp_path):
+    """Concurrent jobs share the server's ONE ChunkStore; every wrap of
+    the same store object must return the same proxy (one lock), or two
+    jobs' committers race the shared zstd context under different
+    locks."""
+    from pbs_plus_tpu.pxar.pipeline import _LockedStore, locked_store
+    from pbs_plus_tpu.pxar.transfer import SessionWriter
+
+    st = ChunkStore(str(tmp_path / "ls"))
+    p1 = locked_store(st)
+    p2 = locked_store(st)
+    assert p1 is p2 and isinstance(p1, _LockedStore)
+    assert locked_store(p1) is p1           # idempotent on the proxy
+
+    w1 = SessionWriter(st, payload_params=P, pipeline_workers=2)
+    w2 = SessionWriter(st, payload_params=P, pipeline_workers=2)
+    assert w1.payload.store is w2.payload.store
+    assert w1.payload.store._lock is w2.payload.store._lock
+    w1.finish()
+    w2.finish()
+
+
+def test_finish_after_close_raises_not_corrupt_records(tmp_path):
+    """finish() on an aborted stream must refuse — returning records
+    with un-committed b'' digest slots would build a corrupt index."""
+    st = ChunkStore(str(tmp_path / "ls"))
+    s = PipelinedStream(st, P, workers=2)
+    s.write(_random_stream(100_000, seed=41))
+    s.close()
+    with pytest.raises(RuntimeError, match="after close"):
+        s.finish()
+    # a successful finish stays idempotent
+    s2 = PipelinedStream(st, P, workers=2)
+    s2.write(_random_stream(50_000, seed=42))
+    recs = s2.finish()
+    assert s2.finish() is recs

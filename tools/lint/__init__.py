@@ -1,0 +1,20 @@
+"""pbslint — project-invariant static analysis for pbs-plus-tpu.
+
+The data plane is concurrent (pxar/pipeline.py) on top of stores that
+are documented non-thread-safe, and the TPU ops depend on jit purity;
+the Go original machine-checks the matching invariants with ``go vet``
+and the race detector.  pbslint is the Python equivalent: one AST walk
+per file, a pluggable rule per hazard class, a checked-in baseline so
+pre-existing violations are ratcheted (never silently grandfathered
+plus one), and inline ``# pbslint: disable=rule`` suppressions for the
+rare deliberate exception.
+
+Run ``python -m tools.lint pbs_plus_tpu`` (see docs/static-analysis.md).
+"""
+
+from .core import Context, Rule, Violation, lint_paths, lint_source
+from .baseline import Baseline
+
+__all__ = [
+    "Baseline", "Context", "Rule", "Violation", "lint_paths", "lint_source",
+]

@@ -1,0 +1,97 @@
+"""Baseline ratchet for pbslint.
+
+The baseline maps ``path::rule`` -> count of intentionally-deferred
+violations.  A run fails only when some bucket exceeds its baselined
+count — so new violations anywhere fail CI, while pre-existing ones
+are grandfathered *per file, per rule* and can only ratchet DOWN:
+``--write-baseline`` refuses to record more violations than the
+current baseline allows (use ``--force`` to seed the first baseline or
+consciously defer a new one).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .core import Violation
+
+_VERSION = 1
+
+
+class Baseline:
+    def __init__(self, entries: dict[str, int] | None = None):
+        self.entries = dict(entries or {})
+
+    # -- io ---------------------------------------------------------------
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        if data.get("version") != _VERSION:
+            raise ValueError(
+                f"{path}: unsupported baseline version {data.get('version')}")
+        entries = data.get("entries", {})
+        if not all(isinstance(v, int) and v > 0 for v in entries.values()):
+            raise ValueError(f"{path}: baseline counts must be positive ints")
+        return cls(entries)
+
+    def save(self, path: str) -> None:
+        data = {
+            "version": _VERSION,
+            "comment": "pbslint ratchet: path::rule -> deferred violation "
+                       "count; see docs/static-analysis.md",
+            "entries": dict(sorted(self.entries.items())),
+        }
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=2, sort_keys=False)
+            fh.write("\n")
+
+    # -- ratchet ----------------------------------------------------------
+    def compare(self, violations: list[Violation]) -> "BaselineDiff":
+        counts: dict[str, int] = {}
+        for v in violations:
+            counts[v.key()] = counts.get(v.key(), 0) + 1
+        # only the EXCESS beyond each bucket's baselined count is new;
+        # counts are positional (baseline has no line info), so the
+        # first `allowed` in file order stay deferred and the rest are
+        # reported — stable because lint output is line-sorted
+        seen: dict[str, int] = {}
+        new: list[Violation] = []
+        for v in violations:
+            seen[v.key()] = seen.get(v.key(), 0) + 1
+            if seen[v.key()] > self.entries.get(v.key(), 0):
+                new.append(v)
+        # buckets whose live count dropped below baseline: ratchet down
+        stale = {
+            k: self.entries[k] - counts.get(k, 0)
+            for k in self.entries
+            if counts.get(k, 0) < self.entries[k]
+        }
+        baselined = sum(min(counts.get(k, 0), n)
+                        for k, n in self.entries.items())
+        return BaselineDiff(new=new, stale=stale, baselined=baselined)
+
+    @classmethod
+    def from_violations(cls, violations: list[Violation]) -> "Baseline":
+        counts: dict[str, int] = {}
+        for v in violations:
+            counts[v.key()] = counts.get(v.key(), 0) + 1
+        return cls(counts)
+
+    def total(self) -> int:
+        return sum(self.entries.values())
+
+
+class BaselineDiff:
+    def __init__(self, new: list[Violation], stale: dict[str, int],
+                 baselined: int):
+        self.new = new          # violations beyond the baselined count
+        self.stale = stale      # bucket -> how far below baseline we are
+        self.baselined = baselined
+
+    @property
+    def ok(self) -> bool:
+        return not self.new

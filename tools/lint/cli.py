@@ -1,0 +1,157 @@
+"""pbslint command line.
+
+    python -m tools.lint [paths ...]          lint (default: pbs_plus_tpu)
+    python -m tools.lint --json               machine-readable output
+    python -m tools.lint --list-rules         show every rule + invariant
+    python -m tools.lint --write-baseline     ratchet the baseline DOWN
+    python -m tools.lint --write-baseline --force   seed/defer (reviewed!)
+
+Exit codes: 0 clean (or fully baselined), 1 new violations or
+unparseable files, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .baseline import Baseline
+from .core import REPO_ROOT, lint_paths
+from .rules import build_rules
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "lint_baseline.json")
+
+
+def _resolve_paths(paths: list[str]) -> list[str]:
+    out = []
+    for p in paths:
+        if not os.path.exists(p):
+            candidate = os.path.join(REPO_ROOT, p)
+            if os.path.exists(candidate):
+                p = candidate
+            else:
+                raise FileNotFoundError(p)
+        out.append(p)
+    return out
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="pbslint: project-invariant static analysis "
+                    "(docs/static-analysis.md)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: pbs_plus_tpu)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="JSON output")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: tools/lint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every violation")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current violations as the new baseline "
+                         "(refuses to grow any bucket unless --force)")
+    ap.add_argument("--force", action="store_true",
+                    help="allow --write-baseline to grow buckets")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in build_rules():
+            print(f"{r.name:26s} {r.invariant}")
+        return 0
+
+    try:
+        only = set(args.rules.split(",")) if args.rules else None
+        rules = build_rules(only)
+        paths = _resolve_paths(args.paths or ["pbs_plus_tpu"])
+    except (ValueError, FileNotFoundError) as e:
+        print(f"pbslint: {e}", file=sys.stderr)
+        return 2
+
+    result = lint_paths(paths, rules)
+
+    if args.write_baseline:
+        if result.errors:
+            # an unparseable file was never linted — a baseline written
+            # now would falsely claim to cover the tree
+            for err in result.errors:
+                print(f"PARSE ERROR {err}", file=sys.stderr)
+            print("pbslint: refusing to write a baseline over parse "
+                  "errors", file=sys.stderr)
+            return 1
+        old = Baseline()
+        if os.path.exists(args.baseline):
+            try:
+                old = Baseline.load(args.baseline)
+            except (ValueError, json.JSONDecodeError) as e:
+                print(f"pbslint: bad baseline: {e}", file=sys.stderr)
+                return 2
+        # merge: only buckets IN SCOPE of this run (its files × its
+        # rules) are replaced — a subset run must not delete deferral
+        # state for everything it never linted
+        linted = set(result.paths)
+        active_rules = {r.name for r in rules}
+        merged = {k: n for k, n in old.entries.items()
+                  if not (k.split("::", 1)[0] in linted
+                          and k.split("::", 1)[1] in active_rules)}
+        merged.update(Baseline.from_violations(result.violations).entries)
+        new_bl = Baseline(merged)
+        if not args.force:
+            grown = {k: (old.entries.get(k, 0), n)
+                     for k, n in new_bl.entries.items()
+                     if n > old.entries.get(k, 0)}
+            if grown:
+                print("pbslint: refusing to GROW the baseline "
+                      "(ratchet goes down, not up); use --force to "
+                      "consciously defer new violations:", file=sys.stderr)
+                for k, (o, n) in sorted(grown.items()):
+                    print(f"  {k}: {o} -> {n}", file=sys.stderr)
+                return 2
+        new_bl.save(args.baseline)
+        print(f"pbslint: wrote {len(new_bl.entries)} bucket(s), "
+              f"{new_bl.total()} violation(s) to {args.baseline}")
+        return 0
+
+    if args.no_baseline or not os.path.exists(args.baseline):
+        baseline = Baseline()
+    else:
+        try:
+            baseline = Baseline.load(args.baseline)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"pbslint: bad baseline: {e}", file=sys.stderr)
+            return 2
+    diff = baseline.compare(result.violations)
+
+    if args.as_json:
+        print(json.dumps({
+            "files": result.files,
+            "errors": result.errors,
+            "violations": [vars(v) for v in result.violations],
+            "new": [vars(v) for v in diff.new],
+            "baselined": diff.baselined,
+            "stale_baseline": diff.stale,
+            "ok": diff.ok and not result.errors,
+        }, indent=2))
+    else:
+        for err in result.errors:
+            print(f"PARSE ERROR {err}")
+        for v in diff.new:
+            print(v)
+        n_total = len(result.violations)
+        print(f"pbslint: {result.files} files, {n_total} violation(s): "
+              f"{len(diff.new)} new, {diff.baselined} baselined")
+        if diff.stale:
+            print("pbslint: baseline is stale (violations fixed — run "
+                  "--write-baseline to ratchet down):")
+            for k, n in sorted(diff.stale.items()):
+                print(f"  {k}: {n} fewer than baselined")
+    return 0 if diff.ok and not result.errors else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

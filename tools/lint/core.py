@@ -1,0 +1,267 @@
+"""pbslint core: one AST walk per file, rule dispatch, suppressions.
+
+The engine parses each file once and drives a single recursive walk
+that maintains the structural context every rule needs (enclosing
+function/class stacks, loop depth, which calls are ``with`` context
+expressions).  Rules declare interest by defining ``visit_<NodeType>``
+methods; the engine builds a dispatch table at startup so a walk costs
+one dict lookup per node, not one isinstance chain per rule.
+
+Suppressions:
+  ``# pbslint: disable=rule1,rule2``   on the offending line (or on a
+                                       comment-only line directly above)
+  ``# pbslint: disable-file=rule``     anywhere in the first 10 lines
+``disable=all`` suppresses every rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_SUPPRESS_RE = re.compile(r"#\s*pbslint:\s*disable=([\w,\-]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*pbslint:\s*disable-file=([\w,\-]+)")
+_COMMENT_ONLY_RE = re.compile(r"^\s*#")
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str          # repo-relative posix path
+    line: int
+    message: str
+
+    def key(self) -> str:
+        """Baseline bucket: violations ratchet per (file, rule)."""
+        return f"{self.path}::{self.rule}"
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Rule:
+    """Base class for pbslint rules.
+
+    Subclasses set ``name`` (the id used in baselines/suppressions) and
+    ``invariant`` (one line: what hazard this guards), then implement
+    any of:
+
+      visit_<NodeType>(ctx, node)   called for every matching AST node
+      begin_file(ctx)               before the walk (may return False to
+                                    skip this file entirely)
+      end_file(ctx)                 after the walk
+
+    Rules are stateless across files unless they keep per-file state
+    initialised in ``begin_file`` — one rule instance lints many files.
+    """
+
+    name: str = ""
+    invariant: str = ""
+
+    def begin_file(self, ctx: "Context"):
+        return True
+
+    def end_file(self, ctx: "Context") -> None:
+        return None
+
+
+class Context:
+    """Per-file lint state handed to every rule callback."""
+
+    def __init__(self, path: str, source: str, tree: ast.AST):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        # structural context, maintained by the engine during the walk
+        self.func_stack: list[ast.AST] = []    # FunctionDef/AsyncFunctionDef
+        self.class_stack: list[ast.ClassDef] = []
+        self.loop_depth = 0
+        # id() of every expression used directly as a `with` context item
+        self.with_ctx_ids: set[int] = set()
+        # id(node) -> parent node, for rules that need upward navigation
+        self.parents: dict[int, ast.AST] = {}
+        self.violations: list[Violation] = []
+        self._line_suppress: dict[int, set[str]] = {}
+        self._file_suppress: set[str] = set()
+        self._scan_suppressions()
+
+    # -- suppression handling ---------------------------------------------
+    def _scan_suppressions(self) -> None:
+        # tokenize so only real COMMENT tokens count — a string literal
+        # that happens to contain "# pbslint: disable=..." must not
+        # silently suppress rules on its line
+        import io
+        import tokenize
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            comments = [(t.start[0], t.string) for t in tokens
+                        if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            comments = []       # ast parsed it; tokenize edge case — no
+                                # suppressions beats false ones
+        for lineno, comment in comments:
+            m = _SUPPRESS_RE.search(comment)
+            if m:
+                names = set(m.group(1).split(","))
+                self._line_suppress.setdefault(lineno, set()).update(names)
+                # a comment-only suppression covers the next line too
+                if lineno <= len(self.lines) and \
+                        _COMMENT_ONLY_RE.match(self.lines[lineno - 1]):
+                    self._line_suppress.setdefault(
+                        lineno + 1, set()).update(names)
+            if lineno <= 10:
+                m = _SUPPRESS_FILE_RE.search(comment)
+                if m:
+                    self._file_suppress.update(m.group(1).split(","))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self._file_suppress or "all" in self._file_suppress:
+            return True
+        names = self._line_suppress.get(line, ())
+        return rule in names or "all" in names
+
+    # -- rule-facing helpers ----------------------------------------------
+    def report(self, rule: Rule, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if self.suppressed(rule.name, line):
+            return
+        self.violations.append(Violation(rule.name, self.path, line, message))
+
+    @property
+    def in_async_def(self) -> bool:
+        """True when the innermost enclosing function is ``async def``."""
+        return bool(self.func_stack) and isinstance(
+            self.func_stack[-1], ast.AsyncFunctionDef)
+
+    @property
+    def current_class(self) -> "ast.ClassDef | None":
+        return self.class_stack[-1] if self.class_stack else None
+
+    def parent(self, node: ast.AST) -> "ast.AST | None":
+        return self.parents.get(id(node))
+
+
+# -- engine ----------------------------------------------------------------
+
+_LOOP_TYPES = (ast.For, ast.AsyncFor, ast.While)
+_FUNC_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class _Engine:
+    def __init__(self, rules: list[Rule]):
+        self.rules = rules
+        # node type name -> [(rule, bound method), ...]
+        self.dispatch: dict[str, list] = {}
+        for rule in rules:
+            for attr in dir(rule):
+                if attr.startswith("visit_"):
+                    self.dispatch.setdefault(attr[6:], []).append(
+                        (rule, getattr(rule, attr)))
+
+    def lint(self, ctx: Context) -> list[Violation]:
+        active = [r for r in self.rules if r.begin_file(ctx) is not False]
+        active_set = {id(r) for r in active}
+        dispatch = {
+            t: [(r, m) for (r, m) in handlers if id(r) in active_set]
+            for t, handlers in self.dispatch.items()
+        }
+        for node in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(node):
+                ctx.parents[id(child)] = node
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ctx.with_ctx_ids.add(id(item.context_expr))
+        self._walk(ctx, ctx.tree, dispatch)
+        for rule in active:
+            rule.end_file(ctx)
+        return ctx.violations
+
+    def _walk(self, ctx: Context, node: ast.AST, dispatch) -> None:
+        handlers = dispatch.get(type(node).__name__)
+        if handlers:
+            for _rule, method in handlers:
+                method(ctx, node)
+        is_func = isinstance(node, _FUNC_TYPES)
+        is_class = isinstance(node, ast.ClassDef)
+        is_loop = isinstance(node, _LOOP_TYPES)
+        if is_func:
+            ctx.func_stack.append(node)
+        if is_class:
+            ctx.class_stack.append(node)
+        if is_loop:
+            ctx.loop_depth += 1
+        for child in ast.iter_child_nodes(node):
+            self._walk(ctx, child, dispatch)
+        if is_func:
+            ctx.func_stack.pop()
+        if is_class:
+            ctx.class_stack.pop()
+        if is_loop:
+            ctx.loop_depth -= 1
+
+
+def _relpath(path: str) -> str:
+    ap = os.path.abspath(path)
+    try:
+        rel = os.path.relpath(ap, REPO_ROOT)
+    except ValueError:          # different drive (windows)
+        rel = ap
+    return rel.replace(os.sep, "/")
+
+
+def lint_source(source: str, path: str, rules: list[Rule],
+                *, relativize: bool = True) -> list[Violation]:
+    """Lint one in-memory source blob (unit tests use this directly)."""
+    tree = ast.parse(source, filename=path)
+    ctx = Context(_relpath(path) if relativize else path, source, tree)
+    return _Engine(rules).lint(ctx)
+
+
+@dataclass
+class LintResult:
+    violations: list[Violation] = field(default_factory=list)
+    errors: list[str] = field(default_factory=list)     # unparseable files
+    files: int = 0
+    # repo-relative paths actually linted — baseline writes must only
+    # touch buckets for THESE files (a subset run must not delete the
+    # deferral state of everything outside it)
+    paths: list[str] = field(default_factory=list)
+
+
+def iter_py_files(paths: list[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def lint_paths(paths: list[str], rules: list[Rule]) -> LintResult:
+    engine = _Engine(rules)
+    result = LintResult()
+    for fp in iter_py_files(paths):
+        result.files += 1
+        try:
+            with open(fp, "r", encoding="utf-8", errors="replace") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=fp)
+        except (SyntaxError, OSError) as e:
+            result.errors.append(f"{_relpath(fp)}: {e}")
+            continue
+        ctx = Context(_relpath(fp), source, tree)
+        result.paths.append(ctx.path)
+        result.violations.extend(engine.lint(ctx))
+    result.violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return result

@@ -1,0 +1,45 @@
+"""pbslint rule registry: one module per hazard class.
+
+Each module defines one ``Rule`` subclass; ``build_rules()`` returns a
+fresh instance of every registered rule (fresh because rules may keep
+per-file state between ``begin_file``/``end_file`` — the engine lints
+files serially).
+"""
+
+from __future__ import annotations
+
+from .swallow import NoSilentSwallow
+from .async_blocking import NoBlockingInAsync
+from .store_discipline import LockedStoreDiscipline
+from .jit_purity import JitPurity
+from .hostsync import NoHostSyncInHotLoop
+from .subproc import SubprocessTimeout
+from .threads import ThreadHygiene
+from .resources import ResourceCtx
+from .mutable_defaults import MutableDefault
+
+RULE_CLASSES = [
+    NoSilentSwallow,
+    NoBlockingInAsync,
+    LockedStoreDiscipline,
+    JitPurity,
+    NoHostSyncInHotLoop,
+    SubprocessTimeout,
+    ThreadHygiene,
+    ResourceCtx,
+    MutableDefault,
+]
+
+
+def build_rules(only: "set[str] | None" = None):
+    rules = [cls() for cls in RULE_CLASSES]
+    if only is not None:
+        unknown = only - {r.name for r in rules}
+        if unknown:
+            raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+        rules = [r for r in rules if r.name in only]
+    return rules
+
+
+def rule_names() -> list[str]:
+    return [cls.name for cls in RULE_CLASSES]

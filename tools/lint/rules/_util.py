@@ -1,0 +1,78 @@
+"""Shared AST helpers for pbslint rules."""
+
+from __future__ import annotations
+
+import ast
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted(call.func)
+
+
+def kwarg(call: ast.Call, name: str) -> ast.keyword | None:
+    for k in call.keywords:
+        if k.arg == name:
+            return k
+    return None
+
+
+def has_kwarg(call: ast.Call, name: str) -> bool:
+    return kwarg(call, name) is not None
+
+
+def is_broad_exception(t: ast.AST | None) -> bool:
+    """True for bare ``except:``, Exception, BaseException, or a tuple
+    containing one of them."""
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in ("Exception", "BaseException")
+    if isinstance(t, ast.Attribute):
+        return t.attr in ("Exception", "BaseException")
+    if isinstance(t, ast.Tuple):
+        return any(is_broad_exception(e) for e in t.elts)
+    return False
+
+
+def body_does_nothing(body: list[ast.stmt]) -> bool:
+    """True when a block has no observable effect: only ``pass``,
+    docstrings, or ``...``."""
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue
+        return False
+    return True
+
+
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical",
+                "log"}
+
+
+def contains_logging_or_raise(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute):
+                if node.func.attr in _LOG_METHODS:
+                    return True
+    return False
+
+
+def enclosing_function(ctx) -> "ast.AST | None":
+    return ctx.func_stack[-1] if ctx.func_stack else None

@@ -1,0 +1,62 @@
+"""no-blocking-in-async — the event loop must not be stalled.
+
+Invariant: one agent's slow disk or hung child process must not stall
+every other connection multiplexed on the server event loop.  Blocking
+primitives inside ``async def`` serialize the whole control plane;
+use ``asyncio.sleep``, ``asyncio.create_subprocess_exec``,
+``asyncio.to_thread`` / ``loop.run_in_executor`` instead.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+from ._util import call_name
+
+_BLOCKING_CALLS = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "subprocess.run": "use `await asyncio.create_subprocess_exec(...)` or "
+                      "`asyncio.to_thread`",
+    "subprocess.call": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.check_call": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.check_output": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.Popen": "use `await asyncio.create_subprocess_exec(...)`",
+    "socket.create_connection": "use `await asyncio.open_connection(...)`",
+    "os.system": "use `await asyncio.create_subprocess_exec(...)`",
+    "os.waitpid": "use `await proc.wait()`",
+    # the sync halves of utils/fsio.py — this suite routed server
+    # handlers onto the a* forms; don't let them creep back
+    "fsio.read_bytes": "use `await fsio.aread_bytes(...)`",
+    "fsio.read_text": "use `await fsio.aread_text(...)`",
+    "fsio.write_bytes": "use `await fsio.awrite_bytes(...)`",
+    "fsio.write_text": "use `await fsio.awrite_text(...)`",
+    "fsio.write_private_bytes": "use `await asyncio.to_thread(...)`",
+}
+
+# blocking file IO is additionally flagged for the server package: the
+# web/jobrpc/s3 event loop serves every agent at once, so even "small"
+# reads go through asyncio.to_thread or happen once at startup
+_FILE_IO_PREFIXES = ("pbs_plus_tpu/server/",)
+
+
+class NoBlockingInAsync(Rule):
+    name = "no-blocking-in-async"
+    invariant = ("async def bodies must not call blocking primitives "
+                 "(time.sleep, subprocess.*, socket dial, server file IO)")
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        if not ctx.in_async_def:
+            return
+        name = call_name(node)
+        if name in _BLOCKING_CALLS:
+            ctx.report(self, node,
+                       f"blocking `{name}` inside async def; "
+                       f"{_BLOCKING_CALLS[name]}")
+            return
+        if (name == "open"
+                and ctx.path.startswith(_FILE_IO_PREFIXES)):
+            ctx.report(self, node,
+                       "blocking file IO inside an async server handler; "
+                       "use `await asyncio.to_thread(...)` or load once at "
+                       "startup")

@@ -1,0 +1,66 @@
+"""no-hostsync-in-hot-loop — device syncs don't belong in scan loops.
+
+Invariant: the chunker/ops/parallel packages are the per-chunk hot
+path (BENCH: the CDC scan runs at hundreds of MiB/s).  A ``.item()``,
+``jax.device_get`` or ``np.asarray``-on-device-array inside a loop
+there serializes the device pipeline once per iteration — the exact
+regression class PR 1 engineered out.  Batch the sync: hoist it out of
+the loop, or accumulate on device and sync once.
+
+Scope: loops in pbs_plus_tpu/{chunker,ops,parallel}/ in modules that
+import jax (the pure-numpy chunker backend is exempt — ``np.asarray``
+on a numpy array is free).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+from ._util import call_name
+
+_SCOPES = ("pbs_plus_tpu/chunker/", "pbs_plus_tpu/ops/",
+           "pbs_plus_tpu/parallel/")
+_SYNC_CALLS = ("jax.device_get",)
+_ASARRAY = ("np.asarray", "numpy.asarray")
+_SYNC_METHODS = ("item", "block_until_ready")
+
+
+class NoHostSyncInHotLoop(Rule):
+    name = "no-hostsync-in-hot-loop"
+    invariant = ("no per-iteration device→host sync (.item, device_get, "
+                 "np.asarray) in chunker/ops/parallel loops")
+
+    def begin_file(self, ctx):
+        if not ctx.path.startswith(_SCOPES):
+            return False
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                mod = getattr(node, "module", None) or ""
+                names = [a.name for a in node.names]
+                if mod.startswith("jax") or \
+                        any(n.startswith("jax") for n in names):
+                    return True
+        return False
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        if ctx.loop_depth == 0:
+            return
+        name = call_name(node)
+        if name in _SYNC_CALLS:
+            ctx.report(self, node,
+                       f"`{name}` inside a hot-path loop syncs the device "
+                       "every iteration; hoist it out or batch the sync")
+            return
+        if name in _ASARRAY:
+            ctx.report(self, node,
+                       f"`{name}` on a device array inside a hot-path loop "
+                       "is a per-iteration transfer; convert once outside "
+                       "the loop")
+            return
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SYNC_METHODS and not node.args:
+            ctx.report(self, node,
+                       f"`.{node.func.attr}()` inside a hot-path loop "
+                       "syncs the device every iteration; accumulate on "
+                       "device and sync once after the loop")

@@ -1,0 +1,46 @@
+"""mutable-default — default argument values must not be shared state.
+
+Invariant: a ``def f(x, acc=[])`` default is ONE object shared by
+every call — in a server that handles many jobs per process, that is
+cross-job state leakage (exactly the bug class the job-isolation
+tests exist for).  Use ``None`` and materialize inside the body.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+
+_MUTABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+            ast.SetComp)
+_MUTABLE_CTORS = {"list", "dict", "set", "bytearray", "defaultdict",
+                  "collections.defaultdict", "Counter", "collections.Counter"}
+
+
+class MutableDefault(Rule):
+    name = "mutable-default"
+    invariant = "no mutable default argument values (shared across calls)"
+
+    def _check(self, ctx, fn) -> None:
+        args = fn.args
+        defaults = list(args.defaults) + [d for d in args.kw_defaults if d]
+        for d in defaults:
+            if isinstance(d, _MUTABLE):
+                ctx.report(self, d,
+                           f"mutable default in `{fn.name}`: one object is "
+                           "shared by every call; default to None and "
+                           "materialize in the body")
+            elif isinstance(d, ast.Call):
+                from ._util import call_name
+                if call_name(d) in _MUTABLE_CTORS:
+                    ctx.report(self, d,
+                               f"mutable default in `{fn.name}` "
+                               f"(constructed once at def time); default "
+                               "to None and materialize in the body")
+
+    def visit_FunctionDef(self, ctx, node) -> None:
+        self._check(ctx, node)
+
+    def visit_AsyncFunctionDef(self, ctx, node) -> None:
+        self._check(ctx, node)

@@ -1,0 +1,108 @@
+"""resource-ctx — file and socket handles are scoped, not GC'd.
+
+Invariant: ``open(p).read()`` leaks the handle until the GC happens to
+run; under the server's connection load (or on Windows agents, where
+an open handle blocks rename/delete) that's a real failure, not
+style.  Handles are opened in a ``with`` block, closed in
+``try/finally``, or explicitly handed off (returned / stored / passed
+to an owner that closes them).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+from ._util import call_name
+
+
+def _scope_node(ctx):
+    return ctx.func_stack[-1] if ctx.func_stack else ctx.tree
+
+
+def _name_is_released(scope: ast.AST, name: str) -> bool:
+    """Is `name` closed, re-scoped by `with`, returned, stored, or
+    passed on somewhere in this scope?  (Coarse by design: any
+    plausible ownership transfer counts — the rule only flags handles
+    with NO visible owner.)"""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in ("close", "detach") \
+                    and isinstance(f.value, ast.Name) and f.value.id == name:
+                return True
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id == name:
+                    return True
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Name) and ce.id == name:
+                    return True
+        elif isinstance(node, ast.Return) and \
+                isinstance(node.value, ast.Name) and node.value.id == name:
+            return True
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Name) and node.value.id == name:
+            for tgt in node.targets:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    return True
+        elif isinstance(node, (ast.Yield, ast.YieldFrom)) and \
+                isinstance(getattr(node, "value", None), ast.Name) and \
+                node.value.id == name:
+            return True
+    return False
+
+
+# stdlib consumers that read from a handle but never close it
+_NON_OWNING_CALLEES = {
+    "json.load", "pickle.load", "marshal.load", "tomllib.load",
+    "yaml.safe_load", "yaml.load", "csv.reader", "csv.DictReader",
+    "ElementTree.parse", "ET.parse", "etree.parse",
+}
+
+
+class ResourceCtx(Rule):
+    name = "resource-ctx"
+    invariant = ("open()/socket() handles live in `with`, try/finally, or "
+                 "an explicit owner — never leaked to the GC")
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        name = call_name(node)
+        if name not in ("open", "io.open", "socket.socket"):
+            return
+        if id(node) in ctx.with_ctx_ids:
+            return
+        parent = ctx.parent(node)
+        if isinstance(parent, ast.Attribute):
+            ctx.report(self, node,
+                       f"`{name}(...).{parent.attr}` leaks the handle to "
+                       "the GC; use `with` (or a read-helper that does)")
+            return
+        if isinstance(parent, ast.Call):
+            # passing the handle to a callee usually transfers ownership
+            # — but the stdlib load/parse family reads and returns
+            # WITHOUT closing, the classic `json.load(open(p))` leak
+            callee = call_name(parent)
+            if callee in _NON_OWNING_CALLEES:
+                ctx.report(self, node,
+                           f"`{callee}({name}(...))` reads but never "
+                           "closes the handle; use `with`")
+            return
+        if isinstance(parent, (ast.Return, ast.withitem, ast.Yield)):
+            return          # ownership transfers to the caller
+        if isinstance(parent, ast.Assign):
+            tgt = parent.targets[0]
+            if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                return      # stored on an owning object
+            if isinstance(tgt, ast.Name) and \
+                    _name_is_released(_scope_node(ctx), tgt.id):
+                return
+            ctx.report(self, node,
+                       f"`{name}` handle is never closed in this scope; "
+                       "use `with`, close in try/finally, or hand it to "
+                       "an owner")
+            return
+        ctx.report(self, node,
+                   f"`{name}` result discarded without closing; "
+                   "use `with`")

@@ -1,0 +1,69 @@
+"""locked-store-discipline — non-thread-safe stores behind one lock.
+
+Invariant (pxar/pipeline.py): neither built-in chunk store is safe for
+concurrent calls — ChunkStore shares one zstd compressor context,
+PBSChunkSink one HTTP connection.  Any module that spawns threads and
+calls ``insert``/``touch`` on a store-shaped object must route through
+the ``_LockedStore`` proxy (``pxar.pipeline.locked_store``) so meta
+and payload streams share one lock.
+
+Scope: modules under pbs_plus_tpu/pxar/ and pbs_plus_tpu/server/ that
+create threads or executors.  A store call is exempt inside the
+``_LockedStore`` proxy itself, or when the receiver is wrapped at the
+call site (``locked_store(s).insert(...)``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Rule
+from ._util import call_name, dotted
+
+_SCOPES = ("pbs_plus_tpu/pxar/", "pbs_plus_tpu/server/")
+_STORE_ATTR = re.compile(r"(^|_)(store|chunks|chunkstore|chunk_store|sink)$")
+_THREAD_SPAWNERS = ("threading.Thread", "ThreadPoolExecutor",
+                    "concurrent.futures.ThreadPoolExecutor",
+                    "futures.ThreadPoolExecutor", "Thread")
+
+
+class LockedStoreDiscipline(Rule):
+    name = "locked-store-discipline"
+    invariant = ("threaded pxar/server modules must call store "
+                 "insert/touch through the _LockedStore proxy")
+
+    def begin_file(self, ctx):
+        if not ctx.path.startswith(_SCOPES):
+            return False
+        self._threaded = False
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    call_name(node) in _THREAD_SPAWNERS:
+                self._threaded = True
+                break
+        return self._threaded
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        func = node.func
+        if not isinstance(func, ast.Attribute) or \
+                func.attr not in ("insert", "touch"):
+            return
+        recv = func.value
+        # wrapped at the call site: locked_store(s).insert(...)
+        if isinstance(recv, ast.Call) and \
+                call_name(recv) in ("locked_store", "pipeline.locked_store"):
+            return
+        recv_name = dotted(recv)
+        if recv_name is None:
+            return
+        leaf = recv_name.rsplit(".", 1)[-1]
+        if not _STORE_ATTR.search(leaf):
+            return
+        cls = ctx.current_class
+        if cls is not None and cls.name == "_LockedStore":
+            return
+        ctx.report(self, node,
+                   f"`{recv_name}.{func.attr}` in a threaded module: "
+                   "stores are not thread-safe (shared zstd ctx / HTTP "
+                   "conn) — wrap with pxar.pipeline.locked_store()")

@@ -1,0 +1,56 @@
+"""subprocess-timeout — every child process gets a deadline.
+
+Invariant: the agent shells out to snapshot tooling (btrfs/zfs/vss),
+drive enumeration, tape changers, and the g++ native-chunker build; a
+hung binary without ``timeout=`` wedges the whole job (or the agent's
+drive-inventory loop) forever.  The native chunker probe must FAIL
+CLOSED on a hung toolchain — tests/test_lint.py pins that.
+
+``subprocess.Popen`` has no timeout parameter; it is flagged too so
+the author either switches to ``run(timeout=...)`` or suppresses with
+a comment explaining who reaps the child.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+from ._util import call_name, has_kwarg
+
+_NEEDS_TIMEOUT = ("subprocess.run", "subprocess.call",
+                  "subprocess.check_call", "subprocess.check_output")
+_BARE_NAMES = {"run", "call", "check_call", "check_output"}
+
+
+class SubprocessTimeout(Rule):
+    name = "subprocess-timeout"
+    invariant = "every subprocess invocation carries an explicit timeout="
+
+    def begin_file(self, ctx):
+        # names imported straight off subprocess count as bare calls
+        self._bare: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module == "subprocess":
+                for a in node.names:
+                    if a.name in _BARE_NAMES or a.name == "Popen":
+                        self._bare.add(a.asname or a.name)
+        return True
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        name = call_name(node)
+        if name in _NEEDS_TIMEOUT or name in self._bare:
+            if not has_kwarg(node, "timeout"):
+                ctx.report(self, node,
+                           f"`{name}` without timeout=: a hung child "
+                           "wedges the job forever; fail closed instead")
+        elif name == "subprocess.Popen":
+            ctx.report(self, node,
+                       "`subprocess.Popen` has no timeout; prefer "
+                       "subprocess.run(timeout=...) or document the "
+                       "reaper with a pbslint disable comment")
+        elif name == "os.system":
+            ctx.report(self, node,
+                       "`os.system` cannot time out; use "
+                       "subprocess.run(timeout=...)")

@@ -1,0 +1,39 @@
+"""no-silent-swallow — broad excepts must log or re-raise.
+
+Invariant: a failure on the data plane must leave a trace.  PR 1 made
+chunk hashing/insert concurrent; a swallowed store error there turns
+into silent backup corruption discovered at restore time.  The scoped
+logger (``utils.log.L``) exists precisely so cleanup paths can log
+with job/chunk context instead of going dark.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+from ._util import body_does_nothing, contains_logging_or_raise, \
+    is_broad_exception
+
+
+class NoSilentSwallow(Rule):
+    name = "no-silent-swallow"
+    invariant = ("broad except handlers (bare / Exception / BaseException) "
+                 "must log via the scoped logger or re-raise")
+
+    def visit_ExceptHandler(self, ctx, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            if not contains_logging_or_raise(node.body):
+                ctx.report(self, node,
+                           "bare `except:` also catches SystemExit/"
+                           "KeyboardInterrupt and logs nothing; catch "
+                           "Exception and log via utils.log, or re-raise")
+            return
+        if not is_broad_exception(node.type):
+            return
+        if body_does_nothing(node.body):
+            ctx.report(self, node,
+                       "broad except silently swallows the error; log via "
+                       "the scoped logger (utils.log.L / self.log) with "
+                       "job/chunk context, narrow the exception type, or "
+                       "re-raise")

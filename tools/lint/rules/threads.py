@@ -1,0 +1,62 @@
+"""thread-hygiene — explicit daemon flags, locks that actually lock.
+
+Invariant: a non-daemon worker thread blocks interpreter shutdown —
+the pipeline committer and backup writer threads must state their
+lifetime explicitly (pipeline.py sets ``daemon=True`` and joins in
+``finish``).  And a lock constructed per call/iteration guards
+nothing: every caller locks a different object (the bug class behind
+"re-created per call" module locks).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+from ._util import call_name, has_kwarg
+
+_LOCK_TYPES = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore", "Event", "Barrier"}
+
+
+class ThreadHygiene(Rule):
+    name = "thread-hygiene"
+    invariant = ("threading.Thread declares daemon= explicitly; locks are "
+                 "never constructed inside a loop")
+
+    def begin_file(self, ctx):
+        self._thread_names: set[str] = set()
+        self._lock_names: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module == "threading":
+                for a in node.names:
+                    if a.name == "Thread":
+                        self._thread_names.add(a.asname or a.name)
+                    elif a.name in _LOCK_TYPES:
+                        self._lock_names.add(a.asname or a.name)
+        return True
+
+    def _is_lock_ctor(self, name: "str | None") -> bool:
+        if name is None:
+            return False
+        if name in self._lock_names:
+            return True
+        mod, _, leaf = name.rpartition(".")
+        return mod in ("threading", "multiprocessing") and leaf in _LOCK_TYPES
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        name = call_name(node)
+        if name == "threading.Thread" or name in self._thread_names:
+            if not has_kwarg(node, "daemon"):
+                ctx.report(self, node,
+                           "threading.Thread without explicit daemon=: "
+                           "state the thread's shutdown contract (daemon="
+                           "True + join on the owning object's close path, "
+                           "or daemon=False with a documented joiner)")
+            return
+        if ctx.loop_depth > 0 and self._is_lock_ctor(name):
+            ctx.report(self, node,
+                       f"`{name}()` constructed inside a loop: every "
+                       "iteration locks a different object, so the lock "
+                       "guards nothing — hoist it to __init__/module scope")

@@ -1,0 +1,24 @@
+#!/bin/sh
+# Static-analysis gate — run before tier-1 tests (docs/static-analysis.md).
+#
+#   tools/verify_lint.sh            # pbslint vs the committed baseline,
+#                                   # plus ruff (pyflakes-class) if installed
+#
+# Exit non-zero on any new violation.  The container image does not bake
+# ruff in, so the ruff leg is gated on availability; pbslint is the gate
+# of record either way.
+set -eu
+
+cd "$(dirname "$0")/.."
+
+echo "== pbslint =="
+python -m tools.lint pbs_plus_tpu
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff (pyflakes-class, pyproject.toml) =="
+    ruff check pbs_plus_tpu tools
+else
+    echo "== ruff not installed; skipped (pbslint is the gate of record) =="
+fi
+
+echo "verify_lint: OK"
