@@ -10,7 +10,7 @@ from __future__ import annotations
 import struct
 from typing import Callable
 
-from ..utils import conf
+from ..utils import conf, failpoints
 from .mux import MuxError, MuxStream
 
 MAGIC = b"TPBS"
@@ -26,6 +26,7 @@ async def send_data_from_reader(stream: MuxStream, reader,
     .read(n) → bytes, or bytes-like)."""
     if total_len < 0 or total_len > MAX_FRAME:
         raise MuxError(f"frame length {total_len} exceeds cap")
+    await failpoints.ahit("arpc.binary.send")
     await stream.write(_HDR.pack(MAGIC, VERSION, total_len))
     if isinstance(reader, (bytes, bytearray, memoryview)):
         data = memoryview(reader)[:total_len]
@@ -54,6 +55,7 @@ async def receive_data_into(stream: MuxStream,
     a callable per block.  If the frame exceeds ``max_len``, the excess is
     drained and discarded (reference's drain-on-short-buffer) and the
     consumed length is still returned."""
+    await failpoints.ahit("arpc.binary.receive")
     hdr = await stream.readexactly(_HDR.size)
     magic, ver, length = _HDR.unpack(hdr)
     if magic != MAGIC:

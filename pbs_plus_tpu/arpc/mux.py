@@ -18,7 +18,7 @@ import struct
 import time
 from typing import Optional
 
-from ..utils import conf
+from ..utils import conf, failpoints
 from ..utils.log import L
 
 _HDR = struct.Struct("<BII")
@@ -207,6 +207,11 @@ class MuxConnection:
             raise MuxError("connection closed")
         async with self._wlock:
             try:
+                # drop/corrupt here injects a transport-death / bitflip at
+                # the frame layer; ConnectionResetError takes the same
+                # shutdown path as a real dead socket
+                payload = await failpoints.ahit("arpc.mux.write_frame",
+                                                payload)
                 self.writer.write(_HDR.pack(ftype, sid, len(payload)))
                 if payload:
                     self.writer.write(payload)
@@ -221,6 +226,8 @@ class MuxConnection:
                 hdr = await self.reader.readexactly(_HDR.size)
                 ftype, sid, ln = _HDR.unpack(hdr)
                 payload = await self.reader.readexactly(ln) if ln else b""
+                payload = await failpoints.ahit("arpc.mux.read_frame",
+                                                payload)
                 self._last_rx = time.monotonic()
                 await self._dispatch(ftype, sid, payload)
         except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:

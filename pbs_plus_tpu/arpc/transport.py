@@ -18,7 +18,7 @@ import struct
 from dataclasses import dataclass
 from typing import Awaitable, Callable, Optional
 
-from ..utils import codec
+from ..utils import codec, failpoints
 from ..utils.log import L
 from .mux import MuxConnection
 
@@ -87,6 +87,7 @@ async def connect_to_server(host: str, port: int, tls: TlsClientConfig, *,
     """Dial + handshake; returns a started MuxConnection (reference:
     arpc.ConnectToServer with header X-PBS-Plus-BackupID etc.)."""
     async def _dial() -> MuxConnection:
+        await failpoints.ahit("arpc.transport.connect")
         reader, writer = await asyncio.open_connection(
             host, port, ssl=tls.context())
         try:

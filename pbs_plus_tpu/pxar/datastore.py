@@ -34,7 +34,7 @@ try:
 except ImportError:                 # image lacks the wheel; ctypes shim
     from ..utils import zstdshim as zstandard
 
-from ..utils import validate
+from ..utils import failpoints, validate
 
 DIDX_MAGIC = b"TPXD"
 DIDX_VERSION = 1
@@ -119,6 +119,10 @@ class ChunkStore:
         for corrupt-write containment — writers that just computed the
         digest from the same buffer pass verify=False to avoid double
         hashing on the hot path."""
+        # fires BEFORE the tmp write so an injected fault models ENOSPC/
+        # EIO at the store boundary; the tmp+rename discipline below is
+        # what "no orphaned partial chunks" rests on either way
+        failpoints.hit("pbsstore.chunk.insert")
         p = self._path(digest)
         if os.path.exists(p):
             if self.blob_format == "pbs":

@@ -61,7 +61,7 @@ except ImportError:                 # image lacks the wheel; ctypes shim
     from ..utils import zstdshim as zstandard
 
 from ..chunker import ChunkerParams
-from ..utils import validate
+from ..utils import failpoints, validate
 from ..utils.log import L
 from .datastore import (
     DIDX_MAGIC, DIDX_VERSION, Datastore, DynamicIndex, SnapshotRef, _HDR,
@@ -354,6 +354,7 @@ class PBSChunkSink:
     def insert(self, digest: bytes, data: bytes, *, verify: bool = True) -> bool:
         if digest in self.known:
             return False
+        failpoints.hit("pbsstore.pbs.insert")
         if verify and hashlib.sha256(data).digest() != digest:
             raise ValueError("chunk digest mismatch on insert")
         enc = self._cctx.compress(data)

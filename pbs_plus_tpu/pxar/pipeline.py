@@ -46,6 +46,7 @@ import weakref
 from concurrent.futures import ThreadPoolExecutor
 
 from ..chunker import ChunkerParams
+from ..utils import failpoints
 from ..utils.log import L
 from .transfer import (
     _HASH_BATCH_BYTES, _HASH_BATCH_COUNT, BatchHasher, ChunkerFactory,
@@ -247,6 +248,9 @@ class PipelinedStream(_ChunkedStream):
 
     def _hash_one(self, chunk) -> bytes:
         t0 = time.perf_counter()
+        # worker-thread fault: surfaces through fut.result() in the
+        # committer, which must drain queues and wake the caller
+        failpoints.hit("pipeline.hash")
         d = hashlib.sha256(chunk).digest()
         METRICS.add("hash", len(chunk), time.perf_counter() - t0, 1)
         self._hash_inflight -= 1

@@ -154,6 +154,14 @@ class _ChunkedStream:
                  batch_hasher: BatchHasher | None = None):
         self.store = store
         self.params = params
+        # a factory exposing bind_stream() pins its backend decision ONCE
+        # per stream (sidecar ResilientSidecarFactory: sidecar-vs-CPU
+        # degradation happens at stream open only, never at the
+        # flush_chunker/append_ref restarts mid-stream — cut-point
+        # stability across the stream's runs)
+        bind = getattr(chunker_factory, "bind_stream", None)
+        if bind is not None:
+            chunker_factory = bind(params)
         self._factory = chunker_factory
         self._chunker = chunker_factory(params)
         self._buf = _ChunkBuffer()

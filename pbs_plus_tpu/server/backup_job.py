@@ -29,7 +29,7 @@ import threading
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 from ..agent.agentfs import AgentFSClient
 from ..arpc import Session
@@ -40,7 +40,9 @@ from ..pxar.format import (
     Entry, KIND_BLOCKDEV, KIND_DEVICE, KIND_DIR, KIND_FIFO, KIND_FILE,
     KIND_HARDLINK, KIND_SOCKET, KIND_SYMLINK,
 )
+from ..utils import failpoints
 from ..utils.log import L
+from ..utils.resilience import CircuitBreaker, with_retry
 from . import database
 
 READ_BLOCK = 8 << 20          # agentfs read granularity
@@ -132,9 +134,11 @@ def make_chunker_factory(kind: str):
             return TpuChunker(p)
         return factory
     if kind.startswith("sidecar:"):
-        from ..sidecar.client import SidecarChunker, SidecarClient
-        client = SidecarClient(kind.split(":", 1)[1])
-        return lambda p: SidecarChunker(p, client)
+        # breaker-gated factory: degrades to the CPU chunker when the
+        # sidecar is unreachable, decided per stream at OPEN time only
+        # (sidecar/client.py ResilientSidecarFactory docstring)
+        from ..sidecar.client import ResilientSidecarFactory
+        return ResilientSidecarFactory(kind.split(":", 1)[1])
     if kind not in ("", "cpu"):
         raise ValueError(f"unknown chunker backend {kind!r} "
                          "(want cpu | tpu | sidecar:<host:port>)")
@@ -271,6 +275,11 @@ class RemoteTreeBackup:
         seen_inodes = self._seen_inodes
         try:
             entries = await self.fs.read_dir(rel)
+        except ConnectionError:
+            # transport death fails the JOB (the job-level retry may
+            # re-run it); swallowing it as a per-dir error would grind
+            # through every remaining path against a dead session
+            raise
         except Exception as e:
             self.result.errors.append(f"{rel}: {e}")
             return
@@ -313,6 +322,8 @@ class RemoteTreeBackup:
         """Prefetch file blocks over aRPC into the writer queue."""
         try:
             handle = await self.fs.open(rel)
+        except ConnectionError:
+            raise                       # dead transport: fail the job
         except Exception as e:
             self.result.errors.append(f"{rel}: open: {e}")
             return
@@ -324,6 +335,7 @@ class RemoteTreeBackup:
             while True:
                 if reader.dead:      # writer died; its drain empties fq
                     break
+                await failpoints.ahit("backup.file.stream")
                 block = await self.fs.read_at(handle, off, READ_BLOCK)
                 if not block:
                     break
@@ -331,6 +343,14 @@ class RemoteTreeBackup:
                     None, fq.put, block)
                 off += len(block)
                 self.result.bytes_total += len(block)
+        except ConnectionError as e:
+            # dead transport: fail the writer's file AND the job (the
+            # job-level retry re-runs incrementally — committed chunks
+            # are already in the store)
+            await asyncio.get_running_loop().run_in_executor(
+                None, fq.put, RuntimeError(f"read {rel}: {e}"))
+            self.result.errors.append(f"{rel}: read: {e}")
+            raise
         except Exception as e:
             await asyncio.get_running_loop().run_in_executor(
                 None, fq.put, RuntimeError(f"read {rel}: {e}"))
@@ -425,11 +445,24 @@ async def run_target_backup(row: database.BackupJobRow, *,
                             db: database.Database,
                             agents: AgentsManager,
                             store: LocalStore,
-                            on_pump=None) -> BackupResult:
+                            on_pump=None,
+                            breaker_factory: Callable[
+                                [], CircuitBreaker] | None = None,
+                            attempts: int = 1) -> BackupResult:
     """Dispatch by target kind (reference: Target(agent|local|s3),
     internal/server/database/types.go) — agent targets stream over aRPC,
     local targets walk the server's own filesystem, s3 targets pull a
-    bucket tree through the SigV4 client."""
+    bucket tree through the SigV4 client.
+
+    Agent targets get the resilience wrap — applied HERE, at the single
+    kind-dispatch point, so callers need not duplicate the kind
+    defaulting: ``breaker_factory`` lazily yields the per-target circuit
+    (JobsManager.breaker — one dead agent must not burn the scheduler's
+    whole retry budget) and ``attempts > 1`` enables the job-level
+    retry, which the dedup store makes cheap — chunks committed by a
+    failed attempt are already present, so the re-run is incremental by
+    construction.  ``CircuitOpenError``/cancellation are never retried
+    (utils/resilience.py)."""
     target = db.get_target(row.target)
     kind = (target or {}).get("kind", "agent")
     if kind == "local":
@@ -442,8 +475,18 @@ async def run_target_backup(row: database.BackupJobRow, *,
         # "agent not connected" from the fall-through
         raise RuntimeError(f"unknown target kind {kind!r} "
                            "(want agent | local | s3)")
-    return await run_backup_job(row, db=db, agents=agents, store=store,
-                                on_pump=on_pump)
+
+    async def once() -> BackupResult:
+        return await run_backup_job(row, db=db, agents=agents, store=store,
+                                    on_pump=on_pump)
+
+    breaker = breaker_factory() if breaker_factory is not None else None
+    guarded = once if breaker is None else (lambda: breaker.call(once))
+    if attempts <= 1 and breaker is None:
+        return await once()
+    return await with_retry(guarded, attempts=max(1, attempts),
+                            base_delay_s=0.5, max_delay_s=5.0,
+                            name=f"backup:{row.id}")
 
 
 async def run_local_backup(row: database.BackupJobRow, *, db, store,

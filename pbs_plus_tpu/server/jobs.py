@@ -13,8 +13,9 @@ import asyncio
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Optional
 
-from ..utils import conf
+from ..utils import conf, failpoints
 from ..utils.log import L
+from ..utils.resilience import CircuitBreaker
 
 AsyncFn = Callable[[], Awaitable[None]]
 
@@ -36,6 +37,10 @@ class JobsManager:
         self._sem = asyncio.Semaphore(self.max_concurrent)
         self._active: dict[str, asyncio.Task] = {}
         self._startup_mu = asyncio.Lock()      # reference: StartupMu
+        # per-key circuit breakers (keyed "agent:<target>" by the backup
+        # path): a dead agent fails fast instead of burning the
+        # scheduler's retry budget on every tick
+        self._breakers: dict[str, CircuitBreaker] = {}
         self.stats = {"enqueued": 0, "completed": 0, "failed": 0,
                       "deduped": 0}
 
@@ -49,6 +54,17 @@ class JobsManager:
         self._active[job.id] = task
         self.stats["enqueued"] += 1
         return True
+
+    def breaker(self, key: str, *, failure_threshold: int = 5,
+                reset_timeout_s: float = 30.0) -> CircuitBreaker:
+        """Per-key CircuitBreaker, created on first use (thresholds only
+        apply at creation; later callers share the existing circuit)."""
+        cb = self._breakers.get(key)
+        if cb is None:
+            cb = self._breakers[key] = CircuitBreaker(
+                failure_threshold=failure_threshold,
+                reset_timeout_s=reset_timeout_s, name=key)
+        return cb
 
     def is_active(self, job_id: str) -> bool:
         return job_id in self._active
@@ -84,6 +100,7 @@ class JobsManager:
                 # before the execution slot: target mounts while queued
                 await job.pre_exec()
             async with self._sem:
+                await failpoints.ahit("server.job.execute")
                 if job.execute is not None:
                     await job.execute()
         except asyncio.CancelledError as e:

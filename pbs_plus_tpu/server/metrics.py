@@ -265,6 +265,22 @@ class MetricsRegistry:
               [({"queue": q}, float(v))
                for q, v in snap["queues"].items()])
 
+        # -- fault injection (utils/failpoints.py; armed only in chaos
+        #    runs — all three gauges render empty in production) -------------
+        from ..utils import failpoints as _failpoints
+        fp = _failpoints.snapshot()
+        gauge("pbs_plus_failpoints_armed", "Currently armed failpoint sites",
+              [({"site": s, "action": a}, 1.0)
+               for s, a in fp["armed"].items()])
+        gauge("pbs_plus_failpoint_hits_total",
+              "Hits per failpoint site while armed (cumulative)",
+              [({"site": s}, float(c["hits"]))
+               for s, c in fp["counters"].items()])
+        gauge("pbs_plus_failpoint_fires_total",
+              "Faults injected per failpoint site (cumulative)",
+              [({"site": s}, float(c["fires"]))
+               for s, c in fp["counters"].items()])
+
         # -- mounts / server --------------------------------------------------
         ms = getattr(s, "mount_service", None)
         gauge("pbs_plus_mounts_active", "Active snapshot mounts",

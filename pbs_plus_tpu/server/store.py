@@ -76,6 +76,15 @@ class ServerConfig:
     prune_keep_daily: int = 0
     prune_keep_weekly: int = 0
     prune_schedule: str = ""
+    # resilience (docs/data-plane.md "Resilience wiring"): job-level
+    # retry count for agent backups (1 = no retry — a mid-backup
+    # disconnect stays a hard, promptly-reported error; >1 retries with
+    # backoff, cheap because committed chunks dedup on the re-run) and
+    # the per-target circuit breaker that keeps one dead agent from
+    # burning the scheduler's retry budget every tick
+    backup_retry_attempts: int = 1
+    target_breaker_threshold: int = 5
+    target_breaker_reset_s: float = 30.0
 
 
 class Server:
@@ -471,7 +480,14 @@ class Server:
                 self.live_progress[row.id] = (t0, result)
             res = await run_target_backup(
                 run_row, db=self.db, agents=self.agents, store=store,
-                on_pump=on_pump)
+                on_pump=on_pump,
+                # applied by run_target_backup on the agent branch only
+                # (the one place the target kind is resolved)
+                breaker_factory=lambda: self.jobs.breaker(
+                    f"agent:{run_row.target}",
+                    failure_threshold=self.config.target_breaker_threshold,
+                    reset_timeout_s=self.config.target_breaker_reset_s),
+                attempts=self.config.backup_retry_attempts)
             result_box["res"] = res
             result_box["t0"] = t0
             self.db.append_task_log(

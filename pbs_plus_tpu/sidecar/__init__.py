@@ -13,6 +13,7 @@ gRPC with an application-defined message encoding).
 """
 
 from .service import DedupService, serve_sidecar
-from .client import SidecarClient, SidecarChunker
+from .client import ResilientSidecarFactory, SidecarClient, SidecarChunker
 
-__all__ = ["DedupService", "serve_sidecar", "SidecarClient", "SidecarChunker"]
+__all__ = ["DedupService", "serve_sidecar", "SidecarClient",
+           "SidecarChunker", "ResilientSidecarFactory"]

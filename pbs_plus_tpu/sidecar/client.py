@@ -1,31 +1,84 @@
-"""Sidecar client + the chunker-interface adapter that routes a writer's
-CDC through the sidecar (``chunker = "sidecar:host:port"``)."""
+"""Sidecar client + the chunker-interface adapters that route a writer's
+CDC through the sidecar (``chunker = "sidecar:host:port"``).
+
+Resilience wiring (docs/fault-injection.md, docs/data-plane.md):
+
+- one cached stub per method (the old code rebuilt the ``unary_unary``
+  callable on every RPC), per-call deadline from ``conf`` (override via
+  ``PBS_PLUS_SIDECAR_TIMEOUT``, default 300 s);
+- a per-client ``CircuitBreaker`` records every call's outcome;
+  *idempotent* methods (stats/probe/insert-index/similarity) get a
+  short bounded retry, the stateful ``Chunk`` method never retries (a
+  replayed feed would double-append to the sidecar's stream carry);
+- ``ResilientSidecarFactory`` degrades to the CPU chunker when the
+  sidecar is unreachable — decided at stream-OPEN time only, never
+  mid-stream: CPU and sidecar cuts are parity-tested identical, but a
+  mid-stream swap after a partial carry would move every later cut
+  point and silently destroy dedup ("A Thorough Investigation of
+  Content-Defined Chunking Algorithms" — cut-point stability is the
+  whole game).
+"""
 
 from __future__ import annotations
 
 import grpc
 
 from ..chunker.spec import ChunkerParams
-from ..utils import codec
+from ..utils import codec, conf, failpoints
+from ..utils.log import L
+from ..utils.resilience import CircuitBreaker, retry_sync
+
+# transient transport classes worth a second attempt on idempotent RPCs
+_RETRYABLE = (grpc.RpcError, ConnectionError, OSError)
 
 
 class SidecarClient:
-    def __init__(self, address: str):
+    def __init__(self, address: str, *, timeout_s: float | None = None,
+                 breaker: CircuitBreaker | None = None):
+        self.address = address
         self.channel = grpc.insecure_channel(
             address,
             options=[("grpc.max_receive_message_length", 128 << 20),
                      ("grpc.max_send_message_length", 128 << 20)])
+        self._stubs: dict[str, object] = {}
+        self.timeout_s = (conf.env().sidecar_timeout_s
+                          if timeout_s is None else float(timeout_s))
+        self.breaker = breaker or CircuitBreaker(
+            failure_threshold=3, reset_timeout_s=15.0,
+            name=f"sidecar:{address}")
 
-    def _call(self, method: str, req: dict) -> dict:
-        fn = self.channel.unary_unary(
-            method,
-            request_serializer=lambda b: b,
-            response_deserializer=lambda b: b)
-        return codec.decode_map(fn(codec.encode(req), timeout=300))
+    def _stub(self, method: str):
+        fn = self._stubs.get(method)
+        if fn is None:
+            fn = self._stubs[method] = self.channel.unary_unary(
+                method,
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+        return fn
+
+    def _call(self, method: str, req: dict, *,
+              idempotent: bool = True) -> dict:
+        fn = self._stub(method)
+
+        def once() -> dict:
+            failpoints.hit("sidecar.call")
+            return codec.decode_map(fn(codec.encode(req),
+                                       timeout=self.timeout_s))
+
+        def guarded() -> dict:
+            return self.breaker.call_sync(once)
+
+        if not idempotent:
+            return guarded()
+        return retry_sync(guarded, attempts=3, base_delay_s=0.2,
+                          max_delay_s=2.0, name=f"sidecar{method}",
+                          retry_on=_RETRYABLE)
 
     def chunk(self, stream_id: str, data: bytes, *, eof: bool = False) -> dict:
+        # stateful per stream_id: NEVER retried (see module docstring)
         return self._call("/pbsplus.Dedup/Chunk",
-                          {"stream_id": stream_id, "data": data, "eof": eof})
+                          {"stream_id": stream_id, "data": data, "eof": eof},
+                          idempotent=False)
 
     def probe_index(self, digests: list[bytes]) -> list[bool]:
         return self._call("/pbsplus.Dedup/ProbeIndex",
@@ -84,3 +137,49 @@ class SidecarChunker:
             return []
         self._finalized = True
         return list(self.client.chunk(self.stream_id, b"", eof=True)["cuts"])
+
+
+class ResilientSidecarFactory:
+    """Chunker factory with breaker-gated CPU degradation.
+
+    ``_ChunkedStream`` calls ``bind_stream(params)`` once per stream; the
+    sidecar-vs-CPU decision is pinned there for the stream's whole life
+    (``flush_chunker``/``append_ref`` restarts reuse the pinned factory).
+    A sidecar that dies MID-stream therefore fails the stream — the
+    job-level retry reopens it, finds the breaker open, and degrades to
+    CPU for the rerun (incremental by construction: committed chunks are
+    already in the store).
+    """
+
+    def __init__(self, address: str, *,
+                 client: SidecarClient | None = None):
+        self.client = client or SidecarClient(address)
+
+    def bind_stream(self, params: ChunkerParams):
+        from ..chunker import CpuChunker
+        try:
+            # explicit liveness probe through the breaker + bounded retry
+            # (NOT just the params check — that is cached per client, and
+            # a stream opened after a mid-stream sidecar death must still
+            # observe the outage here, where degrading is safe)
+            self.client.stats()
+            probe = SidecarChunker(params, self.client)
+        except Exception as e:
+            L.warning("sidecar %s unavailable at stream open (%s: %s); "
+                      "degrading this stream to the CPU chunker",
+                      self.client.address, type(e).__name__, e)
+            return CpuChunker
+        first = [probe]
+
+        def factory(p: ChunkerParams):
+            # reuse the probe only for the params it was built with —
+            # a chunker for different params must be a fresh one
+            if first and p == params:
+                return first.pop()
+            return SidecarChunker(p, self.client)
+        return factory
+
+    def __call__(self, params: ChunkerParams):
+        """Plain-factory compatibility (callers that never bind): one
+        chunker, no degradation."""
+        return SidecarChunker(params, self.client)

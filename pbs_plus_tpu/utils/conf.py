@@ -56,6 +56,9 @@ class Env:
     chunker: str = "cpu"            # "cpu" | "tpu"  — the one-line config
                                     # change from BASELINE.json's north star
     log_dedup_window_s: float = 5.0
+    # per-RPC deadline for the dedup sidecar's gRPC calls (the old
+    # hard-coded 300 in sidecar/client.py, now an operator knob)
+    sidecar_timeout_s: float = 300.0
     extra: dict = field(default_factory=dict)
 
 
@@ -77,6 +80,7 @@ def env() -> Env:
         cert_dir=e.get("PBS_PLUS_CERT_DIR", DEFAULT_CERT_DIR),
         chunker=e.get("PBS_PLUS_CHUNKER", "cpu"),
         log_dedup_window_s=_float_env(e, "LOG_DEDUP_WINDOW", "5"),
+        sidecar_timeout_s=_float_env(e, "PBS_PLUS_SIDECAR_TIMEOUT", "300"),
     )
 
 

@@ -12,10 +12,22 @@ import hashlib
 import hmac
 import os
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:         # image lacks the wheel: importing this module
+    AESGCM = None           # must not poison every transitive importer
+                            # (server.database, server.backup_job, agent.
+                            # registry); seal/unseal raise lazily instead
 
 _NONCE_LEN = 12
 _KEY_LEN = 32
+
+
+def _require_aesgcm() -> None:
+    if AESGCM is None:
+        raise RuntimeError(
+            "secret sealing unavailable: the 'cryptography' package is "
+            "not installed in this image")
 
 
 def generate_key() -> bytes:
@@ -24,6 +36,7 @@ def generate_key() -> bytes:
 
 def seal(key: bytes, plaintext: bytes, aad: bytes = b"") -> bytes:
     """AES-256-GCM seal: nonce || ciphertext+tag."""
+    _require_aesgcm()
     if len(key) != _KEY_LEN:
         raise ValueError("seal key must be 32 bytes")
     nonce = os.urandom(_NONCE_LEN)
@@ -31,6 +44,7 @@ def seal(key: bytes, plaintext: bytes, aad: bytes = b"") -> bytes:
 
 
 def unseal(key: bytes, sealed: bytes, aad: bytes = b"") -> bytes:
+    _require_aesgcm()
     if len(key) != _KEY_LEN:
         raise ValueError("seal key must be 32 bytes")
     if len(sealed) < _NONCE_LEN + 16:

@@ -1,6 +1,7 @@
 """Aux subsystem tests: resilience, memlimit, agent registry."""
 
 import asyncio
+import logging
 import os
 
 import pytest
@@ -8,7 +9,7 @@ import pytest
 from pbs_plus_tpu.agent.registry import Registry, normalize_pem
 from pbs_plus_tpu.utils import memlimit
 from pbs_plus_tpu.utils.resilience import (
-    CircuitBreaker, CircuitOpenError, with_retry,
+    CircuitBreaker, CircuitOpenError, retry_sync, with_retry,
 )
 
 
@@ -38,6 +39,78 @@ def test_circuit_breaker_trips_and_recovers():
     asyncio.run(main())
 
 
+def test_half_open_admits_exactly_one_probe():
+    """Regression (half-open stampede): while a half-open probe is in
+    flight every other caller gets CircuitOpenError — they must not all
+    re-hammer the recovering backend at once.  The transition to
+    half-open is persisted in _state, not recomputed per read."""
+    async def main():
+        cb = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.05,
+                            name="hp")
+
+        async def boom():
+            raise IOError("down")
+
+        with pytest.raises(IOError):
+            await cb.call(boom)
+        assert cb.state == "open"
+        await asyncio.sleep(0.06)
+        assert cb.state == "half-open"
+        assert cb._state == "half-open"      # persisted, not derived
+
+        gate = asyncio.Event()
+        entered = asyncio.Event()
+
+        async def slow_probe():
+            entered.set()
+            await gate.wait()
+            return "probed"
+
+        probe = asyncio.create_task(cb.call(slow_probe))
+        await entered.wait()                 # probe admitted, in flight
+        for _ in range(3):                   # concurrent callers: rejected
+            with pytest.raises(CircuitOpenError, match="probe"):
+                await cb.call(slow_probe)
+        gate.set()
+        assert await probe == "probed"
+        assert cb.state == "closed"
+
+        # failing probe re-opens and re-arms the timer
+        with pytest.raises(IOError):
+            await cb.call(boom)
+        assert cb.state == "open"
+        await asyncio.sleep(0.06)
+
+        async def failing_probe():
+            raise IOError("still down")
+
+        with pytest.raises(IOError):
+            await cb.call(failing_probe)
+        assert cb.state == "open"            # probe verdict: stay open
+    asyncio.run(main())
+
+
+def test_breaker_sync_and_async_share_state():
+    async def main():
+        cb = CircuitBreaker(failure_threshold=2, reset_timeout_s=60,
+                            name="mix")
+
+        def sync_boom():
+            raise IOError("x")
+
+        async def async_boom():
+            raise IOError("y")
+
+        with pytest.raises(IOError):
+            cb.call_sync(sync_boom)
+        with pytest.raises(IOError):
+            await cb.call(async_boom)
+        assert cb.state == "open"            # 1 sync + 1 async = tripped
+        with pytest.raises(CircuitOpenError):
+            cb.call_sync(lambda: 1)
+    asyncio.run(main())
+
+
 def test_with_retry_backoff():
     async def main():
         attempts = {"n": 0}
@@ -58,6 +131,82 @@ def test_with_retry_backoff():
     asyncio.run(main())
 
 
+def test_with_retry_logs_each_retry(caplog):
+    """Regression (silent retries): each retry logs at warning with the
+    site name, attempt number, delay, and the exception."""
+    async def main():
+        attempts = {"n": 0}
+
+        async def flaky():
+            attempts["n"] += 1
+            if attempts["n"] < 3:
+                raise ConnectionError("flap")
+            return "ok"
+
+        with caplog.at_level(logging.WARNING, logger="pbs_plus_tpu"):
+            out = await with_retry(flaky, attempts=3, base_delay_s=0.01,
+                                   name="unit.site")
+        assert out == "ok"
+        msgs = [r.getMessage() for r in caplog.records
+                if "retry unit.site" in r.getMessage()]
+        assert len(msgs) == 2
+        assert "attempt 1/3" in msgs[0] and "ConnectionError" in msgs[0]
+        assert "flap" in msgs[0] and "next try in" in msgs[0]
+        assert "attempt 2/3" in msgs[1]
+    asyncio.run(main())
+
+
+def test_with_retry_never_retries_cancel_or_open_circuit():
+    """Regression: a broad retry_on must not retry cancellation or an
+    intentionally-open circuit — both are decisions, not flakes."""
+    async def main():
+        calls = {"n": 0}
+
+        async def cancelled():
+            calls["n"] += 1
+            raise asyncio.CancelledError()
+
+        with pytest.raises(asyncio.CancelledError):
+            await with_retry(cancelled, attempts=5, base_delay_s=0.01,
+                             retry_on=(BaseException,))
+        assert calls["n"] == 1
+
+        calls["n"] = 0
+
+        async def circuit_open():
+            calls["n"] += 1
+            raise CircuitOpenError("open")
+
+        with pytest.raises(CircuitOpenError):
+            await with_retry(circuit_open, attempts=5, base_delay_s=0.01,
+                             retry_on=(Exception,))
+        assert calls["n"] == 1
+    asyncio.run(main())
+
+
+def test_retry_sync_mirror():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise OSError("blip")
+        return 7
+
+    assert retry_sync(flaky, attempts=3, base_delay_s=0.01,
+                      name="sync.site") == 7
+    assert calls["n"] == 2
+
+    def open_circuit():
+        calls["n"] += 1
+        raise CircuitOpenError("open")
+
+    calls["n"] = 0
+    with pytest.raises(CircuitOpenError):
+        retry_sync(open_circuit, attempts=4, base_delay_s=0.01)
+    assert calls["n"] == 1
+
+
 def test_memlimit_effective():
     limit = memlimit.effective_limit()
     assert 0 < limit < (1 << 50)
@@ -66,6 +215,7 @@ def test_memlimit_effective():
 
 
 def test_registry_secrets_and_seed(tmp_path):
+    pytest.importorskip("cryptography")     # secret sealing needs AESGCM
     reg = Registry(str(tmp_path / "agent" / "config.json"))
     reg.set("server_url", "https://pbs:8017")
     reg.set_secret("bootstrap_secret", b"s3cr3t")

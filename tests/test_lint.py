@@ -36,7 +36,61 @@ def test_registry_has_expected_rules():
         "locked-store-discipline", "jit-purity",
         "no-hostsync-in-hot-loop", "subprocess-timeout",
         "thread-hygiene", "resource-ctx", "mutable-default",
+        "failpoint-discipline",
     }
+
+
+# ------------------------------------------------- failpoint-discipline
+
+
+def test_failpoint_literal_required():
+    v = run_lint("""
+        from pbs_plus_tpu.utils import failpoints
+        name = "arpc.mux.read_frame"
+        failpoints.hit(name)
+    """, rules=["failpoint-discipline"])
+    assert names(v) == ["failpoint-discipline"]
+    assert "string literal" in v[0].message
+
+
+def test_failpoint_duplicate_name_flagged():
+    v = run_lint("""
+        from pbs_plus_tpu.utils import failpoints
+        failpoints.hit("arpc.mux.read_frame")
+        failpoints.ahit("arpc.mux.read_frame")
+    """, rules=["failpoint-discipline"])
+    assert names(v) == ["failpoint-discipline"]
+    assert "globally unique" in v[0].message
+    assert v[0].line == 4
+
+
+def test_failpoint_undocumented_name_flagged():
+    v = run_lint("""
+        from pbs_plus_tpu.utils import failpoints
+        failpoints.hit("totally.bogus.site")
+    """, rules=["failpoint-discipline"])
+    assert names(v) == ["failpoint-discipline"]
+    assert "fault-injection.md" in v[0].message
+
+
+def test_failpoint_documented_literal_clean():
+    # a catalogued name used once, via the plain and aliased receivers
+    v = run_lint("""
+        from pbs_plus_tpu.utils import failpoints
+        from pbs_plus_tpu.utils import failpoints as _failpoints
+        failpoints.hit("arpc.mux.read_frame")
+        _failpoints.ahit("pipeline.hash", b"x")
+        unrelated.hit("not a failpoint")
+    """, rules=["failpoint-discipline"])
+    assert v == []
+
+
+def test_failpoint_sites_in_tree_match_catalog():
+    """Acceptance: the live tree's instrumented sites lint clean with
+    the rule active (literal + unique + catalogued)."""
+    res = lint_paths([os.path.join(REPO_ROOT, "pbs_plus_tpu")],
+                     build_rules({"failpoint-discipline"}))
+    assert res.violations == [], [str(x) for x in res.violations]
 
 
 def test_swallow_flags_broad_pass():

@@ -142,6 +142,7 @@ def test_web_api_namespace_roundtrip_and_delete(tmp_path):
     """API surface: the job namespace field round-trips through
     POST/GET /backup, the ns-aware listing emits it, and the delete
     route addresses slash-bearing namespaced refs."""
+    pytest.importorskip("cryptography")     # full server env needs mTLS
     async def main():
         import aiohttp
 
@@ -197,6 +198,7 @@ def test_web_api_namespace_roundtrip_and_delete(tmp_path):
 def test_backup_job_with_namespace(tmp_path):
     """Server job path: a job row carrying namespace publishes into the
     ns tree, records the full ns ref, and stays incrementally linked."""
+    pytest.importorskip("cryptography")     # full server env needs mTLS
     async def main():
         from pbs_plus_tpu.server.store import Server, ServerConfig
         server = Server(ServerConfig(

@@ -11,6 +11,7 @@ import threading
 import time
 
 import numpy as np
+import pytest
 from aiohttp import ClientSession
 
 from pbs_plus_tpu.server import database
@@ -54,6 +55,7 @@ def test_capture_profile_clamps_and_excludes_self():
 
 
 def test_profile_endpoint_server_agent_and_job_child(tmp_path):
+    pytest.importorskip("cryptography")     # full server env needs mTLS
     from test_job_isolation import _env
 
     async def main():

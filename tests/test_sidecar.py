@@ -62,6 +62,61 @@ def test_similarity_endpoint(sidecar):
     assert sig1 == sig2 and len(sig1) == 128
 
 
+def test_stub_cached_per_method_and_timeout_plumbed(sidecar, monkeypatch):
+    """Regression: _call used to rebuild the unary_unary stub on every
+    RPC and hard-code timeout=300; now one stub per method is cached and
+    the deadline comes from conf (PBS_PLUS_SIDECAR_TIMEOUT) or the
+    constructor."""
+    client, _ = sidecar
+    client._stubs.clear()
+    client.stats()
+    client.stats()
+    client.probe_index([hashlib.sha256(b"q").digest()])
+    assert set(client._stubs) == {"/pbsplus.Dedup/Stats",
+                                  "/pbsplus.Dedup/ProbeIndex"}
+    stats_stub = client._stubs["/pbsplus.Dedup/Stats"]
+    client.stats()
+    assert client._stubs["/pbsplus.Dedup/Stats"] is stats_stub
+
+    # default comes from conf; explicit constructor arg wins
+    from pbs_plus_tpu.sidecar.client import SidecarClient
+    from pbs_plus_tpu.utils import conf
+    assert client.timeout_s == conf.env().sidecar_timeout_s == 300.0
+    c2 = SidecarClient("127.0.0.1:1", timeout_s=7.5)
+    assert c2.timeout_s == 7.5
+    c2.close()
+
+    # env knob: a fresh conf.env() picks the override up
+    monkeypatch.setenv("PBS_PLUS_SIDECAR_TIMEOUT", "12.5")
+    conf.env.cache_clear()
+    try:
+        c3 = SidecarClient("127.0.0.1:1")
+        assert c3.timeout_s == 12.5
+        c3.close()
+    finally:
+        conf.env.cache_clear()
+
+
+def test_chunk_rpc_failure_is_not_retried(sidecar):
+    """The stateful Chunk feed must never be replayed (a retry would
+    double-append to the sidecar's stream carry); idempotent methods do
+    retry.  Injected via the sidecar.call failpoint."""
+    from pbs_plus_tpu.utils import failpoints
+
+    client, _ = sidecar
+    before = client.breaker._failures
+    with failpoints.armed("sidecar.call", "drop", once=True) as fp:
+        with pytest.raises(ConnectionResetError):
+            client.chunk("retrytest", b"abc")
+        assert fp.hits == 1              # exactly one attempt
+    assert client.breaker._failures == before + 1
+    # idempotent path retries through the same (one-shot) fault
+    with failpoints.armed("sidecar.call", "drop", once=True) as fp:
+        assert client.stats()["chunker"]["avg"] == P.avg_size
+        assert fp.hits >= 2              # first attempt dropped, retried
+    client.breaker._record_success()     # leave the shared fixture clean
+
+
 def test_sidecar_chunker_in_writer(sidecar, tmp_path):
     client, _ = sidecar
     import io

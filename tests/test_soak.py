@@ -19,6 +19,7 @@ import resource
 import time
 
 import numpy as np
+import pytest
 
 from pbs_plus_tpu.server import database
 
@@ -71,6 +72,7 @@ def _build_big_tree(root, total_bytes: int) -> int:
 
 
 def test_soak_1gib_4mib_chunks(tmp_path):
+    pytest.importorskip("cryptography")     # full server env needs mTLS
     from test_job_isolation import _env as mk_env   # subprocess isolation
 
     async def main():

@@ -61,6 +61,7 @@ def test_calendar_matches_and_errors():
 # --- crypto --------------------------------------------------------------
 
 def test_seal_roundtrip(tmp_path):
+    pytest.importorskip("cryptography")     # sealing needs AESGCM
     key = crypto.load_or_create_key(str(tmp_path / "k"))
     key2 = crypto.load_or_create_key(str(tmp_path / "k"))
     assert key == key2

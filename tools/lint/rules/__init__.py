@@ -17,6 +17,7 @@ from .subproc import SubprocessTimeout
 from .threads import ThreadHygiene
 from .resources import ResourceCtx
 from .mutable_defaults import MutableDefault
+from .failpoint_discipline import FailpointDiscipline
 
 RULE_CLASSES = [
     NoSilentSwallow,
@@ -28,6 +29,7 @@ RULE_CLASSES = [
     ThreadHygiene,
     ResourceCtx,
     MutableDefault,
+    FailpointDiscipline,
 ]
 
 

@@ -1,0 +1,92 @@
+"""failpoint-discipline — failpoint sites are literal, unique, documented.
+
+Invariant (utils/failpoints.py, docs/fault-injection.md): every
+``failpoints.hit(...)`` / ``failpoints.ahit(...)`` call site names its
+site with a STRING LITERAL (a computed name can't be grepped, armed
+from the env, or audited), each name appears at exactly ONE call site
+in the tree (duplicate names would make "fire on the Nth hit"
+nondeterministic across layers and merge their metrics counters), and
+each name is listed in the docs/fault-injection.md catalog (the
+operator-facing contract for what can be armed).
+
+The catalog is parsed once per lint run: any backticked token in the
+doc counts as documented.  A missing catalog file reports on the first
+hit site found — an instrumented tree without the catalog is exactly
+the drift this rule exists to stop.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import REPO_ROOT, Rule
+
+_DOC_PATH = os.path.join(REPO_ROOT, "docs", "fault-injection.md")
+_BACKTICKED = re.compile(r"`([A-Za-z0-9_.\-]+)`")
+_HIT_ATTRS = ("hit", "ahit")
+
+
+class FailpointDiscipline(Rule):
+    name = "failpoint-discipline"
+    invariant = ("failpoints.hit/ahit sites take literal, globally unique "
+                 "names listed in docs/fault-injection.md")
+
+    def __init__(self):
+        # (path, line) of the first sighting per site — instance state
+        # spans files on purpose: uniqueness is a TREE property and the
+        # engine lints files serially with one rule instance
+        self._seen: dict[str, tuple[str, int]] = {}
+        self._catalog: set[str] | None = None
+        self._doc_missing = False
+
+    def _load_catalog(self) -> set[str]:
+        if self._catalog is None:
+            try:
+                with open(_DOC_PATH, "r", encoding="utf-8") as f:
+                    self._catalog = set(_BACKTICKED.findall(f.read()))
+            except OSError:
+                self._catalog = set()
+                self._doc_missing = True
+        return self._catalog
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        func = node.func
+        if not isinstance(func, ast.Attribute) or \
+                func.attr not in _HIT_ATTRS:
+            return
+        recv = func.value
+        # match `failpoints.hit(...)` and aliased `_failpoints.ahit(...)`
+        if not (isinstance(recv, ast.Name)
+                and recv.id.lstrip("_") == "failpoints"):
+            return
+        if not node.args or not (
+                isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            ctx.report(self, node,
+                       f"`failpoints.{func.attr}` must take a string "
+                       "literal site name (computed names can't be armed "
+                       "from the env or audited against the catalog)")
+            return
+        site = node.args[0].value
+        prev = self._seen.get(site)
+        if prev is not None and prev != (ctx.path, node.lineno):
+            ctx.report(self, node,
+                       f"failpoint site {site!r} already instrumented at "
+                       f"{prev[0]}:{prev[1]} — names must be globally "
+                       "unique (Nth-hit triggers and metrics counters "
+                       "are per-name)")
+            return
+        self._seen.setdefault(site, (ctx.path, node.lineno))
+        catalog = self._load_catalog()
+        if self._doc_missing:
+            ctx.report(self, node,
+                       "docs/fault-injection.md is missing — every "
+                       "failpoint site must be cataloged there")
+            return
+        if site not in catalog:
+            ctx.report(self, node,
+                       f"failpoint site {site!r} is not documented in "
+                       "docs/fault-injection.md — add it to the site "
+                       "catalog")

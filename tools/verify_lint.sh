@@ -12,6 +12,8 @@ set -eu
 cd "$(dirname "$0")/.."
 
 echo "== pbslint =="
+# includes failpoint-discipline: every failpoints.hit/ahit site must be
+# a literal, globally unique name cataloged in docs/fault-injection.md
 python -m tools.lint pbs_plus_tpu
 
 if command -v ruff >/dev/null 2>&1; then
