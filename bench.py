@@ -132,6 +132,96 @@ def _pipeline_bench(mib: int = 256) -> dict:
     }
 
 
+def _resume_bench(mib: int = 64) -> dict | None:
+    """Crash-at-50% resume benchmark (docs/data-plane.md "Checkpointed
+    resumable backups"): back a tree up with per-file checkpointing,
+    kill it via the `pbsstore.chunk.insert` failpoint halfway, resume,
+    and report the bytes-re-read ratio plus resume wall-clock.  The
+    re-read ratio is (source bytes streamed again) / (source bytes) —
+    0.5 means the resume did no better than the crash point, lower is
+    the checkpoint splice working."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from pbs_plus_tpu.chunker import ChunkerParams
+    from pbs_plus_tpu.pxar.backupproxy import LocalStore
+    from pbs_plus_tpu.pxar.walker import backup_tree
+    from pbs_plus_tpu.server import checkpoint
+    from pbs_plus_tpu.utils import failpoints
+
+    params = ChunkerParams(avg_size=1 << 20)
+    tmp = tempfile.mkdtemp(prefix="pbs-resume-bench-")
+    try:
+        src = os.path.join(tmp, "src")
+        os.makedirs(src)
+        rng = np.random.default_rng(7)
+        files = 16
+        per = (mib << 20) // files
+        total_bytes = files * per
+        for i in range(files):
+            with open(os.path.join(src, f"f{i:02d}.bin"), "wb") as f:
+                f.write(rng.integers(0, 256, per, dtype=np.uint8).tobytes())
+
+        def run(store, *, backup_id, crash_nth=None):
+            resume_ctx = checkpoint.open_resume(
+                store, backup_type="host", backup_id=backup_id)
+            kw = {"previous_reader": resume_ctx[0]} if resume_ctx else {}
+            sess = store.start_session(backup_type="host",
+                                       backup_id=backup_id, **kw)
+            try:
+                if resume_ctx:
+                    sess.resume_plan = resume_ctx[1]
+                checkpoint.attach(sess, "2c")
+                if crash_nth:
+                    with failpoints.armed("pbsstore.chunk.insert",
+                                          "raise", nth=crash_nth):
+                        backup_tree(sess, src)
+                        man = sess.finish()
+                else:
+                    backup_tree(sess, src)
+                    man = sess.finish()
+                checkpoint.clear(store.datastore, "host", backup_id)
+                return man, resume_ctx[1] if resume_ctx else None
+            except BaseException:
+                sess.abort()
+                raise
+
+        # probe: total insert count for this tree (checkpointing on)
+        probe = LocalStore(os.path.join(tmp, "probe"), params)
+        with failpoints.armed("pbsstore.chunk.insert", "delay",
+                              arg=0.0) as fp:
+            run(probe, backup_id="b")
+            total_inserts = fp.hits
+
+        store = LocalStore(os.path.join(tmp, "ds"), params)
+        crashed = False
+        try:
+            run(store, backup_id="b", crash_nth=max(2, total_inserts // 2))
+        except Exception:
+            crashed = True
+        if not crashed:
+            return {"note": "crash point never reached; resume not "
+                            "measured", "total_inserts": total_inserts}
+        t0 = time.perf_counter()
+        man, plan = run(store, backup_id="b")
+        resume_s = time.perf_counter() - t0
+        reread = plan.bytes_reread if plan else total_bytes
+        return {
+            "source_mib": total_bytes >> 20,
+            "crash_at_insert": max(2, total_inserts // 2),
+            "total_inserts": total_inserts,
+            "files_skipped": plan.files_skipped if plan else 0,
+            "bytes_reread": reread,
+            "reread_ratio": round(reread / total_bytes, 3),
+            "resume_wall_s": round(resume_s, 3),
+            "resume_mib_s": round((total_bytes >> 20) / resume_s, 1),
+        }
+    finally:
+        failpoints.disarm_all()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 from pbs_plus_tpu.utils.jaxdev import probe_relay  # shared tunnel probe
 
 
@@ -443,6 +533,13 @@ def main() -> None:
     if pipe is not None:
         result["pipelined_mib_s"] = pipe["pipelined_mib_s"]
         result["detail"]["pipeline"] = pipe
+    try:
+        resume = _resume_bench()
+    except Exception as e:
+        sys.stderr.write(f"[bench] resume bench unavailable: {e}\n")
+        resume = None
+    if resume is not None:
+        result["detail"]["resume"] = resume
     print(json.dumps(result))
 
 

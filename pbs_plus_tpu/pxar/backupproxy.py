@@ -40,17 +40,27 @@ class PreviousBackupRef:
 
 
 class BackupSession:
-    """One backup run: exposes a DedupWriter, publishes on finish."""
+    """One backup run: exposes a DedupWriter, publishes on finish.
+
+    ``previous_reader`` overrides the snapshot-backed previous with a
+    caller-supplied SplitReader — the checkpoint-resume path
+    (server/checkpoint.py) feeds the crashed run's committed prefix here
+    so unchanged entries splice via ``write_entry_ref``.  ``resume_plan``
+    is the matching fast-skip plan, consumed by the walkers
+    (pxar/walker.py, server/backup_job.py)."""
+
+    resume_plan = None          # set by the checkpoint-resume wiring
 
     def __init__(self, store: "LocalStore", ref: SnapshotRef,
                  previous: SnapshotRef | None,
                  chunker_factory: ChunkerFactory,
-                 pipeline_workers: int | None = None):
+                 pipeline_workers: int | None = None,
+                 previous_reader: SplitReader | None = None):
         self.store = store
         self.ref = ref
         self.previous_ref = previous
-        self._prev_reader: SplitReader | None = None
-        if previous is not None:
+        self._prev_reader: SplitReader | None = previous_reader
+        if previous is not None and previous_reader is None:
             self._prev_reader = SplitReader.open_snapshot(store.datastore, previous)
         self.writer = DedupWriter(
             store.datastore.chunks,
@@ -179,11 +189,14 @@ class LocalStore:
                       previous: SnapshotRef | PreviousBackupRef | None = None,
                       auto_previous: bool = True,
                       namespace: str | None = None,
-                      pipeline_workers: int | None = None) -> BackupSession:
+                      pipeline_workers: int | None = None,
+                      previous_reader=None) -> BackupSession:
         """Open a session.  ``previous`` enables ref-dedup against that
         snapshot; by default the latest snapshot of the same group (same
-        ``namespace``) is used.  Same-second collisions bump the timestamp
-        +1 s (reference behavior,
+        ``namespace``) is used.  ``previous_reader`` (a SplitReader)
+        overrides both — the checkpoint-resume path, which embeds any
+        prior snapshot's reuse in its own indexes.  Same-second
+        collisions bump the timestamp +1 s (reference behavior,
         /root/reference/internal/pxarmount/commit_orchestrate.go: same-second
         commits bump timestamp)."""
         parse_backup_type(backup_type)
@@ -195,6 +208,8 @@ class LocalStore:
         validate.namespace_path(namespace)
         if isinstance(previous, PreviousBackupRef):
             previous = previous.ref
+        if previous_reader is not None:
+            previous, auto_previous = None, False
         if previous is None and auto_previous:
             previous = self.datastore.last_snapshot(backup_type, backup_id,
                                                     namespace)
@@ -221,7 +236,8 @@ class LocalStore:
             ref = dataclasses.replace(ref,
                                       backup_time=format_backup_time(t))
         return BackupSession(self, ref, previous, self._chunker_factory,
-                             pipeline_workers=pipeline_workers)
+                             pipeline_workers=pipeline_workers,
+                             previous_reader=previous_reader)
 
     def open_snapshot(self, ref: SnapshotRef, **kw) -> SplitReader:
         return SplitReader.open_snapshot(self.datastore, ref, **kw)

@@ -193,6 +193,10 @@ class ChunkStore:
         """Remove chunks with atime/mtime older than ``before``; returns
         (count_removed, bytes_removed).  Caller is responsible for having
         touched all live chunks after the mark (GC phase 1)."""
+        # fires BEFORE any unlink: an injected fault proves the mark→sweep
+        # ordering (a sweep that dies here has removed nothing, so marked
+        # chunks — including checkpoint-referenced ones — are untouched)
+        failpoints.hit("pbsstore.chunk.sweep")
         removed = 0
         freed = 0
         for sub in os.listdir(self.base):

@@ -132,6 +132,16 @@ class PBSError(RuntimeError):
         self.status = status
 
 
+class SessionLostError(ConnectionError):
+    """The transport under a connection-bound PBS session died.  The
+    session holds server-side state (writer ids, the backup-group lock)
+    that a fresh connection can never recover, so the whole ATTEMPT is
+    lost — typed (instead of the generic ConnectionError/OSError that
+    used to surface here) so ``run_target_backup``'s retry
+    classification is precise: the job-level retry opens a brand-new
+    session, and per-file swallow paths must never eat this."""
+
+
 class _PBSHttp:
     """Minimal synchronous HTTP client for the backup-writer session.
     Synchronous on purpose: the DedupWriter runs on the backup job's
@@ -205,13 +215,17 @@ class _PBSHttp:
                 if isinstance(e, (ConnectionError, OSError)):
                     # a mid-stream transport failure leaves the h2
                     # session desynced; like the session-bound h1 path,
-                    # drop it and surface the failure (the session holds
-                    # server-side state and cannot be re-dialed)
+                    # drop it and surface the typed session loss (the
+                    # session holds server-side state and cannot be
+                    # re-dialed)
                     self.close()
+                    raise SessionLostError(
+                        f"PBS session lost mid-stream: {e}") from e
                 raise
             return status, data, rhdrs.get("content-type", "")
         # pre-session requests may retry once on a stale keepalive; once
-        # the session is connection-bound a reconnect can never succeed
+        # the session is connection-bound a reconnect can never succeed —
+        # transport death there surfaces as the typed SessionLostError
         attempts = (0,) if self.session_bound else (0, 1)
         for attempt in attempts:
             conn = self._connect()
@@ -226,8 +240,11 @@ class _PBSHttp:
                 r = conn.getresponse()
                 data = r.read()
                 return r.status, data, r.getheader("Content-Type", "")
-            except (ConnectionError, http.client.HTTPException, OSError):
+            except (ConnectionError, http.client.HTTPException, OSError) as e:
                 self.close()
+                if self.session_bound:
+                    raise SessionLostError(
+                        f"PBS session lost: {e!r}") from e
                 if attempt == attempts[-1]:
                     raise
         raise AssertionError("unreachable")

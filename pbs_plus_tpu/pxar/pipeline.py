@@ -279,6 +279,25 @@ class PipelinedStream(_ChunkedStream):
         # here — the committer owns record slots; finish() drains instead
         raise AssertionError("unused on the pipelined stream")
 
+    def sync(self) -> None:
+        """Checkpoint support (same contract as the sequential stream's
+        ``sync``): cut at the current offset, dispatch pending batches,
+        and BLOCK until the committer has inserted every in-flight chunk
+        — ``records`` is then final and fully committed, and the stream
+        stays writable.  The barrier rides the commit queue, so ordering
+        with earlier chunks is structural, not timed."""
+        self._check_failed()
+        if self._closed:
+            return               # committer gone; records already final
+        if self._buf:
+            self.flush_chunker()
+        if self._hasher is not None:
+            self._flush_batch()
+        done = threading.Event()
+        self._commit_q.put(("drain", done))
+        done.wait()
+        self._check_failed()
+
     def finish(self) -> list[tuple[int, bytes]]:
         if self._finished:
             # finish() after close()/failure must never hand back
@@ -320,6 +339,9 @@ class PipelinedStream(_ChunkedStream):
                 slot = self._commit_q.get()
                 if slot is _DONE:
                     return
+                if slot[0] == "drain":
+                    slot[1].set()        # sync() barrier: all prior
+                    continue             # queue items are committed
                 if slot[0] == "chunk":
                     _, idx, chunk, fut = slot
                     try:
@@ -337,12 +359,15 @@ class PipelinedStream(_ChunkedStream):
         except BaseException as e:
             self._exc = e
             # drain until the finish()/close() sentinel so a caller
-            # blocked on backpressure permits always wakes up
+            # blocked on backpressure permits OR a sync() barrier always
+            # wakes up (sync re-raises via _check_failed after waking)
             while True:
                 slot = self._commit_q.get()
                 if slot is _DONE:
                     return
-                if slot[0] == "chunk":
+                if slot[0] == "drain":
+                    slot[1].set()
+                elif slot[0] == "chunk":
                     self._slots.release()
                 else:
                     self._batch_slots.release()

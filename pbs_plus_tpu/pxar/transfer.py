@@ -252,6 +252,16 @@ class _ChunkedStream:
         self._flush_hashes()
         return self.records
 
+    def sync(self) -> None:
+        """Checkpoint support: force a cut at the current offset and
+        resolve every pending digest, so ``records`` is final and every
+        chunk it names is committed to the store — WITHOUT finishing;
+        the stream stays writable.  Only meaningful between entries
+        (the buffer then holds only completed files' bytes)."""
+        if self._buf:
+            self.flush_chunker()
+        self._flush_hashes()
+
 
 class SessionWriter:
     """Builds a tpxar split archive: entries in sorted-path order, file
@@ -316,6 +326,10 @@ class SessionWriter:
         # per-file divergence reports (size mismatches etc.) for the
         # caller's session stats / task log
         self.file_errors: list[str] = []
+        # called (with this writer) after every completed entry — the
+        # durable-checkpoint hook (server/checkpoint.py Checkpointer);
+        # runs on the writer thread, may call sync_streams()
+        self.checkpoint_hook: Callable[["SessionWriter"], None] | None = None
 
     # -- entry emission ---------------------------------------------------
     @staticmethod
@@ -342,6 +356,23 @@ class SessionWriter:
         else:
             self.meta.write(entry.encode())
 
+    def _notify_entry(self) -> None:
+        """One entry is fully written — give the checkpoint hook a shot.
+        Called from the public entry points only (never from inside
+        ``_flush_refs``'s own emission loop, whose pending state must
+        not be re-entered)."""
+        hook = self.checkpoint_hook
+        if hook is not None:
+            hook(self)
+
+    def sync_streams(self) -> None:
+        """Force both streams to a fully-committed cut (chunker flushed,
+        pending digests resolved, pipelined commits drained) without
+        finishing — the checkpoint primitive.  Only valid between
+        entries."""
+        self.meta.sync()
+        self.payload.sync()
+
     def write_entry(self, entry: Entry) -> None:
         """Metadata-only entry (dir, symlink, empty file, special)."""
         self._check_order(entry)
@@ -351,9 +382,11 @@ class SessionWriter:
             # pxar2: even an empty file owns a real zero-length PAYLOAD
             # item so its ref validates under a stock accessor
             self._write_file_pxar2(entry, io.BytesIO(b""), 1 << 16)
+            self._notify_entry()
             return
         self._emit_meta(entry)
         self._entries += 1
+        self._notify_entry()
 
     def write_entry_reader(self, entry: Entry, reader: io.RawIOBase | io.BufferedIOBase,
                            *, bufsize: int = 4 << 20) -> bytes:
@@ -368,7 +401,9 @@ class SessionWriter:
         the S3/tape ingest pumps) is spooled once to learn it."""
         self._check_order(entry)
         if self._codec is not None:
-            return self._write_file_pxar2(entry, reader, bufsize)
+            digest = self._write_file_pxar2(entry, reader, bufsize)
+            self._notify_entry()
+            return digest
         entry.payload_offset = self.payload.offset
         h = hashlib.sha256()
         total = 0
@@ -383,6 +418,7 @@ class SessionWriter:
         entry.digest = h.digest()
         self._emit_meta(entry)
         self._entries += 1
+        self._notify_entry()
         return entry.digest
 
     def _ensure_payload_started(self) -> None:
@@ -566,6 +602,7 @@ class DedupWriter(SessionWriter):
             self._pend_entries.append((entry, a))
             self._entries += 1
             self._flush_refs()
+            self._notify_entry()
             return
         if size and self._codec is not None and v2_prev:
             a = old_payload_offset - PAYLOAD_HDR_SIZE   # include stored hdr
@@ -590,6 +627,13 @@ class DedupWriter(SessionWriter):
         entry.size = size
         self._pend_entries.append((entry, old_payload_offset))
         self._entries += 1
+        self._notify_entry()
+
+    def sync_streams(self) -> None:
+        # pending coalesced refs must land before the streams are cut —
+        # a checkpoint taken mid-run would otherwise miss them
+        self._flush_refs()
+        super().sync_streams()
 
     def write_entry(self, entry: Entry) -> None:
         self._flush_refs()

@@ -112,11 +112,28 @@ def backup_tree(session, root: str, *, exclude: ExcludeFn | None = None,
     """Stream a directory tree into a BackupSession's writer.  Returns the
     number of entries written; ``counters`` (optional dict) accumulates
     ``files``/``bytes`` for job stats.  (The minimal end-to-end slice's
-    local-target path; the agent path streams the same entries over aRPC.)"""
+    local-target path; the agent path streams the same entries over aRPC.)
+
+    When the session carries a ``resume_plan`` (checkpoint resume,
+    server/checkpoint.py), files the crashed run fully committed with
+    unchanged stat are spliced via ``write_entry_ref`` — no re-read, no
+    re-chunk, no re-hash; only the tail streams."""
     w = session.writer
+    plan = getattr(session, "resume_plan", None)
     n = 0
     for entry, src in iter_tree(root, exclude=exclude, on_error=on_error):
         if src is not None:
+            if plan is not None:
+                src_e = plan.skip_ref(entry.path, entry.size,
+                                      entry.mtime_ns)
+                if src_e is not None:
+                    entry.digest = src_e.digest
+                    w.write_entry_ref(entry, src_e.payload_offset,
+                                      src_e.size)
+                    if counters is not None:
+                        counters["files"] = counters.get("files", 0) + 1
+                    n += 1
+                    continue
             try:
                 with open(src, "rb") as f:
                     w.write_entry_reader(entry, f)
@@ -124,6 +141,8 @@ def backup_tree(session, root: str, *, exclude: ExcludeFn | None = None,
                 if on_error:
                     on_error(entry.path, e)
                 continue
+            if plan is not None:
+                plan.note_reread(entry.size, files=1)
             if counters is not None:
                 counters["files"] = counters.get("files", 0) + 1
                 counters["bytes"] = counters.get("bytes", 0) + entry.size

@@ -43,7 +43,7 @@ from ..pxar.format import (
 from ..utils import failpoints
 from ..utils.log import L
 from ..utils.resilience import CircuitBreaker, with_retry
-from . import database
+from . import checkpoint, database
 
 READ_BLOCK = 8 << 20          # agentfs read granularity
 QUEUE_DEPTH = 8               # prefetched blocks in flight
@@ -207,6 +207,10 @@ class RemoteTreeBackup:
         self.exclusions = exclusions or []
         self.log = job_log or L
         self.result = BackupResult()
+        # checkpoint resume (server/checkpoint.py): files the crashed
+        # run fully committed splice via write_entry_ref with ZERO agent
+        # reads — only the tail of the tree re-streams
+        self.resume = getattr(session, "resume_plan", None)
         self._wq: queue.Queue = queue.Queue(maxsize=QUEUE_DEPTH)
         self._writer_exc: BaseException | None = None
         self._seen_inodes: dict[tuple[int, int], str] = {}
@@ -302,7 +306,20 @@ class RemoteTreeBackup:
                 else:
                     if m.get("nlink", 1) > 1:
                         seen_inodes[key] = child
-                    await self._stream_file(child, e)
+                    src_e = (self.resume.skip_ref(child, e.size, e.mtime_ns)
+                             if self.resume is not None else None)
+                    if src_e is not None:
+                        # digest rides along from the checkpoint entry so
+                        # verification sees the whole-file sha256 (the
+                        # mount commit engine's ref discipline)
+                        e.digest = src_e.digest
+                        await self._put(
+                            ("ref", e, (src_e.payload_offset, src_e.size)))
+                        # spliced files count as completed files, same
+                        # as the local walker's skip branch
+                        self.result.files += 1
+                    else:
+                        await self._stream_file(child, e)
             elif kind == KIND_SYMLINK:
                 # multiply-linked symlinks are hardlink entries here too
                 # (same rsync -H parity as pxar/walker.py's local walk)
@@ -343,6 +360,8 @@ class RemoteTreeBackup:
                     None, fq.put, block)
                 off += len(block)
                 self.result.bytes_total += len(block)
+                if self.resume is not None:
+                    self.resume.note_reread(len(block))
         except ConnectionError as e:
             # dead transport: fail the writer's file AND the job (the
             # job-level retry re-runs incrementally — committed chunks
@@ -364,6 +383,8 @@ class RemoteTreeBackup:
             except Exception as e:
                 self.log.debug("agentfs close failed for %s: %s", rel, e)
         self.result.files += 1
+        if self.resume is not None:
+            self.resume.note_reread(0, files=1)
 
     def _drain_reader(self, reader) -> None:
         """Unblock the async producer of a dropped/aborted file: mark the
@@ -421,6 +442,10 @@ class RemoteTreeBackup:
                 tag, entry, reader = item
                 if tag == "entry":
                     w.write_entry(entry)
+                elif tag == "ref":
+                    # checkpoint fast-skip: splice the previous payload
+                    # range (reader is the (old_offset, size) pair)
+                    w.write_entry_ref(entry, reader[0], reader[1])
                 else:
                     current = reader
                     w.write_entry_reader(entry, reader)
@@ -441,6 +466,24 @@ class RemoteTreeBackup:
                     self._drain_reader(item[2])
 
 
+def crashed_backup_job_ids(db: database.Database,
+                           tasks: list[dict]) -> list[str]:
+    """Which of the tasks found 'running' at startup (they died with the
+    previous process) should be re-enqueued as resumable backups: backup
+    tasks whose job row still exists and is enabled, deduped in task
+    order.  The policy half of Server._cleanup_orphaned_tasks, split out
+    so the startup self-heal is testable without the server's TLS
+    stack."""
+    out: list[str] = []
+    for t in tasks:
+        if t.get("kind") != "backup":
+            continue
+        row = db.get_backup_job(t["job_id"])
+        if row is not None and row.enabled:
+            out.append(row.id)
+    return list(dict.fromkeys(out))
+
+
 async def run_target_backup(row: database.BackupJobRow, *,
                             db: database.Database,
                             agents: AgentsManager,
@@ -448,7 +491,8 @@ async def run_target_backup(row: database.BackupJobRow, *,
                             on_pump=None,
                             breaker_factory: Callable[
                                 [], CircuitBreaker] | None = None,
-                            attempts: int = 1) -> BackupResult:
+                            attempts: int = 1,
+                            checkpoint_interval: str = "") -> BackupResult:
     """Dispatch by target kind (reference: Target(agent|local|s3),
     internal/server/database/types.go) — agent targets stream over aRPC,
     local targets walk the server's own filesystem, s3 targets pull a
@@ -462,12 +506,19 @@ async def run_target_backup(row: database.BackupJobRow, *,
     retry, which the dedup store makes cheap — chunks committed by a
     failed attempt are already present, so the re-run is incremental by
     construction.  ``CircuitOpenError``/cancellation are never retried
-    (utils/resilience.py)."""
+    (utils/resilience.py).
+
+    ``checkpoint_interval`` (conf: ``PBS_PLUS_CHECKPOINT_INTERVAL``)
+    arms durable checkpoints on agent and local targets backed by a
+    local datastore — a crashed or retried attempt then resumes from the
+    last checkpoint instead of byte zero (server/checkpoint.py); s3
+    pulls and PBS push sessions are not checkpointed."""
     target = db.get_target(row.target)
     kind = (target or {}).get("kind", "agent")
     if kind == "local":
         return await run_local_backup(row, db=db, store=store,
-                                      target=target)
+                                      target=target,
+                                      checkpoint_interval=checkpoint_interval)
     if kind == "s3":
         return await run_s3_backup(row, db=db, store=store, target=target)
     if kind != "agent":
@@ -478,7 +529,8 @@ async def run_target_backup(row: database.BackupJobRow, *,
 
     async def once() -> BackupResult:
         return await run_backup_job(row, db=db, agents=agents, store=store,
-                                    on_pump=on_pump)
+                                    on_pump=on_pump,
+                                    checkpoint_interval=checkpoint_interval)
 
     breaker = breaker_factory() if breaker_factory is not None else None
     guarded = once if breaker is None else (lambda: breaker.call(once))
@@ -490,7 +542,8 @@ async def run_target_backup(row: database.BackupJobRow, *,
 
 
 async def run_local_backup(row: database.BackupJobRow, *, db, store,
-                           target: dict | None) -> BackupResult:
+                           target: dict | None,
+                           checkpoint_interval: str = "") -> BackupResult:
     """Local-path target: snapshot (btrfs/lvm/freeze fall-through) and
     walk the server's own filesystem — no agent involved (reference:
     local targets back up paths on the PBS host itself)."""
@@ -502,6 +555,7 @@ async def run_local_backup(row: database.BackupJobRow, *, db, store,
         raise RuntimeError(f"local source {src!r} is not a directory")
     result = BackupResult()
     exclusions = row.exclusions + db.list_exclusions(row.id)
+    backup_id = row.backup_id or row.target
 
     def excluded(rel: str) -> bool:
         return match_exclusion(rel, exclusions)
@@ -510,11 +564,18 @@ async def run_local_backup(row: database.BackupJobRow, *, db, store,
         snaps = SnapshotManager()
         snap = snaps.create(src)
         try:
+            resume_ctx = checkpoint.open_resume(
+                store, backup_type="host", backup_id=backup_id,
+                namespace=row.namespace or "")
+            kw = {"previous_reader": resume_ctx[0]} if resume_ctx else {}
             session = store.start_session(
-                backup_type="host", backup_id=row.backup_id or row.target,
+                backup_type="host", backup_id=backup_id,
                 namespace=row.namespace or None,
-                pipeline_workers=row.pipeline_workers)
+                pipeline_workers=row.pipeline_workers, **kw)
             try:
+                if resume_ctx is not None:
+                    session.resume_plan = resume_ctx[1]
+                checkpoint.attach(session, checkpoint_interval)
                 counters = {"files": 0, "bytes": 0}
                 n = backup_tree(
                     session, snap.snapshot_path, exclude=excluded,
@@ -524,9 +585,19 @@ async def run_local_backup(row: database.BackupJobRow, *, db, store,
                 result.entries = n
                 result.files = counters["files"]
                 result.bytes_total = counters["bytes"]
-                result.manifest = session.finish(
-                    {"job": row.id, "errors": result.errors[:100]})
+                extra = {"job": row.id, "errors": result.errors[:100]}
+                if resume_ctx is not None:
+                    extra["resume"] = resume_ctx[1].summary()
+                result.manifest = session.finish(extra)
                 result.snapshot = str(session.ref)
+                # the published snapshot supersedes the group's
+                # checkpoints — reap them now instead of waiting for
+                # prune's sweep (store may be a PBSStore when the job
+                # row says store='pbs': no local datastore, nothing to
+                # clear)
+                if getattr(store, "datastore", None) is not None:
+                    checkpoint.clear(store.datastore, "host", backup_id,
+                                     row.namespace or "")
             except BaseException:
                 session.abort()
                 raise
@@ -585,7 +656,8 @@ async def run_backup_job(row: database.BackupJobRow, *,
                          agents: AgentsManager,
                          store: LocalStore,
                          job_suffix: str | None = None,
-                         on_pump=None) -> BackupResult:
+                         on_pump=None,
+                         checkpoint_interval: str = "") -> BackupResult:
     """End-to-end agent backup: ask the agent to open a job session, walk
     its agentfs, stream into a datastore session, publish the snapshot."""
     job_id = job_suffix or f"{row.id}-{uuid.uuid4().hex[:8]}"
@@ -619,14 +691,34 @@ async def run_backup_job(row: database.BackupJobRow, *,
         job_sess_info = await agents.wait_session(client_id, timeout=60)
         fs = AgentFSClient(Session(job_sess_info.conn))
 
+        # checkpoint resume (datastore-backed stores only): a valid
+        # checkpoint from a crashed or retried run becomes the writer's
+        # `previous`, and its plan fast-skips committed unchanged files
+        loop = asyncio.get_running_loop()
+        resume_ctx = await loop.run_in_executor(
+            None, lambda: checkpoint.open_resume(
+                store, backup_type="host",
+                backup_id=row.backup_id or row.target,
+                namespace=row.namespace or ""))
+        session_kw = {"previous_reader": resume_ctx[0]} if resume_ctx else {}
         # start_session can do network I/O (PBSStore: TLS connect, session
         # establish, previous-index downloads) — keep it off the event loop
-        session = await asyncio.get_running_loop().run_in_executor(
+        session = await loop.run_in_executor(
             None, lambda: store.start_session(
                 backup_type="host", backup_id=row.backup_id or row.target,
                 namespace=row.namespace or None,
-                pipeline_workers=row.pipeline_workers))
+                pipeline_workers=row.pipeline_workers, **session_kw))
         try:
+            if resume_ctx is not None:
+                session.resume_plan = resume_ctx[1]
+                log.info("resuming from checkpoint %s: %d skippable "
+                         "files", resume_ctx[1].summary()["checkpoint"],
+                         len(resume_ctx[1]))
+            # attach scans the group's .ckpt dir — datastore I/O stays
+            # off the event loop like the session/resume calls around it
+            await loop.run_in_executor(
+                None, lambda: checkpoint.attach(session,
+                                                checkpoint_interval))
             pump = RemoteTreeBackup(
                 fs, session,
                 exclusions=row.exclusions + db.list_exclusions(row.id),
@@ -658,9 +750,17 @@ async def run_backup_job(row: database.BackupJobRow, *,
                 if not pump_task.done():
                     pump_task.cancel()
                     await asyncio.gather(pump_task, return_exceptions=True)
-            manifest = await asyncio.get_running_loop().run_in_executor(
-                None, session.finish,
-                {"job": row.id, "errors": pump.result.errors[:100]})
+            extra = {"job": row.id, "errors": pump.result.errors[:100]}
+            if resume_ctx is not None:
+                extra["resume"] = resume_ctx[1].summary()
+            manifest = await loop.run_in_executor(
+                None, session.finish, extra)
+            if getattr(store, "datastore", None) is not None:
+                # published snapshot supersedes the group's checkpoints
+                await loop.run_in_executor(
+                    None, lambda: checkpoint.clear(
+                        store.datastore, "host",
+                        row.backup_id or row.target, row.namespace or ""))
             result.snapshot = str(session.ref)
             result.manifest = manifest
             log.info("backup complete: %d entries, %d bytes, snapshot %s",

@@ -42,7 +42,12 @@ class JobsManager:
         # scheduler's retry budget on every tick
         self._breakers: dict[str, CircuitBreaker] = {}
         self.stats = {"enqueued": 0, "completed": 0, "failed": 0,
-                      "deduped": 0}
+                      "deduped": 0, "resumed": 0}
+
+    def note_resumed(self) -> None:
+        """A backup completed from a durable checkpoint instead of byte
+        zero (server/checkpoint.py) — surfaced via pbs_plus_jobs_total."""
+        self.stats["resumed"] += 1
 
     def enqueue(self, job: Job) -> bool:
         """Returns False if a job with the same id is already active

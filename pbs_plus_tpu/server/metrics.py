@@ -265,6 +265,33 @@ class MetricsRegistry:
               [({"queue": q}, float(v))
                for q, v in snap["queues"].items()])
 
+        # -- durable checkpoints / resume (server/checkpoint.py) -------------
+        from . import checkpoint as _checkpoint
+        cp = _checkpoint.metrics_snapshot()
+        gauge("pbs_plus_checkpoints_written_total",
+              "Backup checkpoints persisted", [({}, float(cp["written"]))])
+        gauge("pbs_plus_checkpoint_write_failures_total",
+              "Checkpoint flushes that failed (backup continued)",
+              [({}, float(cp["write_failures"]))])
+        gauge("pbs_plus_checkpoint_resumes_total",
+              "Backups resumed from a checkpoint",
+              [({}, float(cp["resumes"]))])
+        gauge("pbs_plus_checkpoint_files_skipped_total",
+              "Files spliced from checkpoints without agent reads",
+              [({}, float(cp["files_skipped"]))])
+        gauge("pbs_plus_checkpoint_bytes_skipped_total",
+              "Bytes spliced from checkpoints without agent reads",
+              [({}, float(cp["bytes_skipped"]))])
+        gauge("pbs_plus_checkpoint_files_reread_total",
+              "Files re-streamed by resumed runs (the tail)",
+              [({}, float(cp["files_reread"]))])
+        gauge("pbs_plus_checkpoint_bytes_reread_total",
+              "Bytes re-streamed by resumed runs (the tail)",
+              [({}, float(cp["bytes_reread"]))])
+        gauge("pbs_plus_checkpoints_swept_total",
+              "Stale checkpoints reaped by prune",
+              [({}, float(cp["swept"]))])
+
         # -- fault injection (utils/failpoints.py; armed only in chaos
         #    runs — all three gauges render empty in production) -------------
         from ..utils import failpoints as _failpoints

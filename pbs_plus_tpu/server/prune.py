@@ -95,7 +95,10 @@ def mark_live_chunks(ds: Datastore) -> int:
     """GC phase 1: touch every chunk referenced by any snapshot index —
     once per unique digest (a deduplicated store shares chunks across
     many snapshots; per-entry utime would be millions of redundant
-    syscalls)."""
+    syscalls).  Live backup CHECKPOINTS (server/checkpoint.py) count as
+    references too: a crashed job's resume is about to splice exactly
+    those chunks, so the sweep must never take them."""
+    from . import checkpoint as _checkpoint
     live: set[bytes] = set()
     for ref in ds.list_snapshots(all_namespaces=True):
         try:
@@ -105,6 +108,7 @@ def mark_live_chunks(ds: Datastore) -> int:
         for idx in indexes:
             for i in range(len(idx.ends)):
                 live.add(idx.digests[i].tobytes())
+    live.update(_checkpoint.live_checkpoint_digests(ds))
     for dg in live:
         ds.chunks.touch(dg)
     return len(live)
@@ -112,9 +116,13 @@ def mark_live_chunks(ds: Datastore) -> int:
 
 def run_prune(ds: Datastore, policy: PrunePolicy, *,
               dry_run: bool = False, gc: bool = True,
-              gc_grace_s: float = GC_GRACE_S) -> PruneReport:
+              gc_grace_s: float = GC_GRACE_S,
+              ckpt_max_age_s: float | None = None) -> PruneReport:
     """Apply ``policy`` to every snapshot group, then (optionally)
-    mark-and-sweep the chunk store."""
+    mark-and-sweep the chunk store.  Stale backup checkpoints are
+    reaped FIRST (before the mark), so a checkpoint superseded by a
+    published snapshot or older than ``ckpt_max_age_s`` stops
+    protecting its chunks in the same run."""
     report = PruneReport(dry_run=dry_run)
     groups: dict[tuple[str, str, str], list[SnapshotRef]] = {}
     for ref in ds.list_snapshots(all_namespaces=True):
@@ -134,6 +142,10 @@ def run_prune(ds: Datastore, policy: PrunePolicy, *,
     # (snapshot DELETE route, an earlier grace-shielded sweep), so it
     # must not be conditional on THIS run having removed anything
     if gc and not dry_run:
+        from . import checkpoint as _checkpoint
+        _checkpoint.sweep_stale(
+            ds, max_age_s=_checkpoint.CKPT_MAX_AGE_S
+            if ckpt_max_age_s is None else ckpt_max_age_s)
         # mark_start must come from the FILE clock, not time.time(): the
         # kernel stamps utime with the coarse clock, which can lag the
         # precise clock by ~1 ms — a wall-clock mark would sweep chunks

@@ -85,6 +85,16 @@ class ServerConfig:
     backup_retry_attempts: int = 1
     target_breaker_threshold: int = 5
     target_breaker_reset_s: float = 30.0
+    # durable checkpoints (server/checkpoint.py): "<N>c/<M>s" persists
+    # the in-flight session every N committed payload chunks and/or M
+    # seconds so a crashed/retried backup resumes from progress instead
+    # of byte zero.  "" falls back to PBS_PLUS_CHECKPOINT_INTERVAL from
+    # the environment (conf.env), which defaults to disabled.
+    checkpoint_interval: str = ""
+    # startup self-heal: jobs found 'running' at boot (they died with
+    # the previous process) are re-enqueued as resumable after this
+    # settle delay (lets agents reconnect first); < 0 disables requeue
+    resume_requeue_delay_s: float = 5.0
 
 
 class Server:
@@ -237,15 +247,50 @@ class Server:
     def _cleanup_orphaned_tasks(self) -> None:
         """Tasks still 'running' at startup died with the previous process —
         convert them to error tasks (reference: cleanupQueuedBackups,
-        internal/server/bootstrap.go:136-171)."""
-        n = 0
-        for t in self.db.list_running_tasks():
+        internal/server/bootstrap.go:136-171), then re-enqueue the backup
+        jobs among them as resumable: with durable checkpoints
+        (server/checkpoint.py) the re-run picks up from the last
+        checkpoint, so a server crash mid-backup self-heals on restart."""
+        from .backup_job import crashed_backup_job_ids
+        orphans = self.db.list_running_tasks()
+        requeue = crashed_backup_job_ids(self.db, orphans)
+        for t in orphans:
             self.db.append_task_log(
                 t["upid"], "error: interrupted by server restart")
             self.db.finish_task(t["upid"], database.STATUS_ERROR)
-            n += 1
-        if n:
-            self.log.warning("converted %d orphaned tasks to errors", n)
+        if orphans:
+            self.log.warning("converted %d orphaned tasks to errors",
+                             len(orphans))
+        if not requeue or self.config.resume_requeue_delay_s < 0:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self.log.warning("no running event loop: %d crashed "
+                             "backup(s) not re-enqueued", len(requeue))
+            return
+        self._tasks.append(loop.create_task(
+            self._requeue_crashed(requeue)))
+        # logged only once the requeue is actually scheduled, so the
+        # task log never promises a resume that was disabled/failed
+        for t in orphans:
+            if t["kind"] == "backup" and t["job_id"] in requeue:
+                self.db.append_task_log(
+                    t["upid"], "re-enqueued for resume after restart")
+
+    async def _requeue_crashed(self, job_ids: list[str]) -> None:
+        """Startup self-heal: give agents a moment to reconnect, then
+        re-enqueue the backups that died with the previous process."""
+        if self.config.resume_requeue_delay_s:
+            await asyncio.sleep(self.config.resume_requeue_delay_s)
+        for jid in job_ids:
+            try:
+                self.enqueue_backup(jid)
+                self.log.info("re-enqueued crashed backup %s for resume",
+                              jid)
+            except Exception as e:
+                self.log.warning("re-enqueue of crashed backup %s "
+                                 "failed: %s", jid, e)
 
     async def stop(self) -> None:
         if getattr(self, "job_rpc", None) is not None:
@@ -487,8 +532,12 @@ class Server:
                     f"agent:{run_row.target}",
                     failure_threshold=self.config.target_breaker_threshold,
                     reset_timeout_s=self.config.target_breaker_reset_s),
-                attempts=self.config.backup_retry_attempts)
+                attempts=self.config.backup_retry_attempts,
+                checkpoint_interval=self.config.checkpoint_interval
+                or conf.env().checkpoint_interval)
             result_box["res"] = res
+            if res.manifest.get("resume"):
+                self.jobs.note_resumed()
             result_box["t0"] = t0
             self.db.append_task_log(
                 upid, f"backup complete: {res.entries} entries, "

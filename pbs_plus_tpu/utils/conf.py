@@ -59,6 +59,10 @@ class Env:
     # per-RPC deadline for the dedup sidecar's gRPC calls (the old
     # hard-coded 300 in sidecar/client.py, now an operator knob)
     sidecar_timeout_s: float = 300.0
+    # durable backup checkpoints (server/checkpoint.py): "<N>c/<M>s"
+    # persists in-flight session state every N committed payload chunks
+    # and/or M seconds; "" (default) disables checkpointing
+    checkpoint_interval: str = ""
     extra: dict = field(default_factory=dict)
 
 
@@ -81,6 +85,7 @@ def env() -> Env:
         chunker=e.get("PBS_PLUS_CHUNKER", "cpu"),
         log_dedup_window_s=_float_env(e, "LOG_DEDUP_WINDOW", "5"),
         sidecar_timeout_s=_float_env(e, "PBS_PLUS_SIDECAR_TIMEOUT", "300"),
+        checkpoint_interval=e.get("PBS_PLUS_CHECKPOINT_INTERVAL", ""),
     )
 
 

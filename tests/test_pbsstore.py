@@ -359,6 +359,47 @@ def test_finish_requires_closed_writers(pbs):
     http_.close()
 
 
+def test_bound_session_transport_death_is_session_lost(pbs):
+    """A transport death under a connection-BOUND session surfaces the
+    typed SessionLostError (a ConnectionError subclass, so the pump's
+    ConnectionError-is-job-fatal classification still applies), never a
+    silent reconnect: the fresh connection would have no server-side
+    session state."""
+    from pbs_plus_tpu.pxar.pbsstore import SessionLostError, _PBSHttp
+    http_ = _PBSHttp(PBSConfig(base_url=pbs.base_url, datastore="tank",
+                               auth_token=pbs.token))
+    http_.call("GET", "/api2/json/backup",
+               params={"store": "tank", "backup-type": "host",
+                       "backup-id": "sl", "backup-time": 1},
+               headers={"Upgrade": "proxmox-backup-protocol-v1"})
+    http_.session_bound = True
+    pbs.close()                       # murder the server mid-session
+    http_._conn.close()               # and sever the kept-alive socket:
+    # the next request re-dials (refused — the listener is gone), which
+    # for a BOUND session must surface as a typed session loss
+    with pytest.raises(SessionLostError) as ei:
+        http_.call("POST", "/dynamic_index",
+                   json_body={"archive-name": "root.pidx"})
+    assert isinstance(ei.value, ConnectionError)   # retry classification
+    http_.close()
+
+
+def test_unbound_transport_failure_stays_generic(pbs):
+    """Before the session binds, transport errors keep their generic
+    class (the one-shot keepalive retry path) — SessionLostError is
+    reserved for the unrecoverable bound state."""
+    from pbs_plus_tpu.pxar.pbsstore import SessionLostError, _PBSHttp
+    http_ = _PBSHttp(PBSConfig(base_url=pbs.base_url, datastore="tank",
+                               auth_token=pbs.token))
+    pbs.close()
+    with pytest.raises(OSError) as ei:
+        http_.call("GET", "/api2/json/backup",
+                   params={"store": "tank", "backup-type": "host",
+                           "backup-id": "x", "backup-time": 1})
+    assert not isinstance(ei.value, SessionLostError)
+    http_.close()
+
+
 def test_cli_mount_commit_against_pbs(pbs, tmp_path):
     """CLI end-to-end: `mount --pbs-url` serves a PBS snapshot through a
     kernel FUSE mountpoint; an edit through the kernel and a

@@ -4,6 +4,7 @@ the keep-last/refcount chunk discipline of internal/pxarmount/
 
 import asyncio
 import os
+import time
 
 import numpy as np
 import pytest
@@ -120,6 +121,104 @@ def test_mark_touches_all_live(tmp_path):
     store, refs = _make_snapshots(tmp_path, n=2)
     n = mark_live_chunks(store.datastore)
     assert n > 0
+
+
+# -- GC vs live backup checkpoints (server/checkpoint.py) -------------------
+
+
+def _crashed_job_checkpoint(tmp_path, *, backup_id="crashed"):
+    """A crashed job's live checkpoint: backup a tree with per-entry
+    checkpointing, abort before publish (exactly what a mid-run death
+    leaves behind).  Returns (store, checkpoint, unique chunk digests
+    referenced ONLY by the checkpoint)."""
+    from pbs_plus_tpu.server import checkpoint
+
+    src = tmp_path / f"src-{backup_id}"
+    src.mkdir()
+    rng = np.random.default_rng(7)
+    for i in range(3):
+        (src / f"f{i}.bin").write_bytes(
+            rng.integers(0, 256, 30_000, dtype=np.uint8).tobytes())
+    store = LocalStore(str(tmp_path / "ds"), P)
+    sess = store.start_session(backup_type="host", backup_id=backup_id)
+    ck = checkpoint.Checkpointer(sess, every_chunks=1)
+    try:
+        backup_tree(sess, str(src))
+        ck.flush(sess.writer)
+    finally:
+        sess.abort()                      # crash: nothing published
+    loaded = checkpoint.load_latest(store.datastore, "host", backup_id,
+                                    params=P)
+    assert loaded is not None
+    digests = {loaded.pidx.digest(i) for i in range(len(loaded.pidx))}
+    digests.update(loaded.midx.digest(i) for i in range(len(loaded.midx)))
+    return store, loaded, digests
+
+
+def test_gc_never_sweeps_live_checkpoint_chunks(tmp_path):
+    """The GC-vs-checkpoint core: prune+GC running while a crashed job's
+    checkpoint is live must not sweep checkpoint-referenced chunks, even
+    with ZERO grace and ancient atimes — the mark phase touches them.
+    Deleting the checkpoint makes the same sweep collect them."""
+    from pbs_plus_tpu.server import checkpoint
+
+    store, loaded, ck_digests = _crashed_job_checkpoint(tmp_path)
+    ds = store.datastore
+    # age every chunk far past any grace window: only the mark protects
+    old = time.time() - 7 * 24 * 3600
+    for dg in ds.chunks.iter_digests():
+        os.utime(ds.chunks._path(dg), (old, old))
+
+    rep = run_prune(ds, PrunePolicy(keep_last=1), gc_grace_s=0.0)
+    assert rep.chunks_removed == 0
+    for dg in ck_digests:
+        assert ds.chunks.has(dg), "GC swept a checkpoint-referenced chunk"
+    # the checkpoint itself survived (not superseded, not aged out)
+    assert checkpoint.load_latest(ds, "host", "crashed",
+                                  params=P) is not None
+
+    # resume still works end to end after the GC pass
+    rc = checkpoint.open_resume(store, backup_type="host",
+                                backup_id="crashed")
+    assert rc is not None and len(rc[1]) == 3
+
+    # now drop the checkpoint: the very same sweep collects its chunks
+    checkpoint.clear(ds, "host", "crashed")
+    for dg in ds.chunks.iter_digests():
+        os.utime(ds.chunks._path(dg), (old, old))
+    rep = run_prune(ds, PrunePolicy(keep_last=1), gc_grace_s=0.0)
+    assert rep.chunks_removed >= len(ck_digests)
+    for dg in ck_digests:
+        assert not ds.chunks.has(dg)
+
+
+def test_sweep_failpoint_fires_after_mark(tmp_path):
+    """`pbsstore.chunk.sweep` site discipline: an injected sweep death
+    aborts GC AFTER the mark touched live+checkpoint chunks and BEFORE
+    any unlink — the store is untouched, deterministically."""
+    from pbs_plus_tpu.utils import failpoints
+    from pbs_plus_tpu.utils.failpoints import FailpointError
+
+    store, loaded, ck_digests = _crashed_job_checkpoint(tmp_path)
+    ds = store.datastore
+    before = sorted(d.hex() for d in ds.chunks.iter_digests())
+    old = time.time() - 7 * 24 * 3600
+    for dg in ds.chunks.iter_digests():
+        os.utime(ds.chunks._path(dg), (old, old))
+    try:
+        with failpoints.armed("pbsstore.chunk.sweep", "raise") as fp:
+            with pytest.raises(FailpointError):
+                run_prune(ds, PrunePolicy(keep_last=1), gc_grace_s=0.0)
+            assert fp.fires == 1
+    finally:
+        failpoints.disarm_all()
+    assert sorted(d.hex() for d in ds.chunks.iter_digests()) == before
+    # the mark ran before the (failed) sweep: checkpoint chunks were
+    # touched, so even a rerun with the fault cleared keeps them
+    rep = run_prune(ds, PrunePolicy(keep_last=1), gc_grace_s=0.0)
+    assert rep.chunks_removed == 0
+    for dg in ck_digests:
+        assert ds.chunks.has(dg)
 
 
 def test_prune_web_route_and_snapshot_delete(tmp_path):

@@ -1,0 +1,87 @@
+"""Startup self-heal (server/store.py _cleanup_orphaned_tasks + the
+crashed_backup_job_ids policy): backup jobs found 'running' at boot died
+with the previous process — they are marked dead AND re-enqueued as
+resumable, so a server crash mid-backup picks its backup up from the
+last durable checkpoint without operator action."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from pbs_plus_tpu.server import database
+from pbs_plus_tpu.server.backup_job import crashed_backup_job_ids
+
+
+@pytest.fixture
+def db(tmp_path):
+    d = database.Database(str(tmp_path / "t.db"), seal_key=os.urandom(32))
+    yield d
+    d.close()
+
+
+def test_crashed_backup_job_ids_policy(db):
+    """Only backup tasks whose job row exists and is enabled are
+    requeued; restores/verifications, deleted jobs, and disabled jobs
+    are not; duplicates collapse in task order."""
+    db.upsert_backup_job(database.BackupJobRow(
+        id="alive", target="t1", source_path="/src"))
+    db.upsert_backup_job(database.BackupJobRow(
+        id="off", target="t1", source_path="/src", enabled=False))
+    tasks = [
+        {"kind": "backup", "job_id": "alive"},
+        {"kind": "backup", "job_id": "alive"},      # duplicate task rows
+        {"kind": "backup", "job_id": "off"},        # disabled
+        {"kind": "backup", "job_id": "deleted"},    # row gone
+        {"kind": "restore", "job_id": "alive"},     # wrong kind
+        {"kind": "verify", "job_id": "alive"},
+    ]
+    assert crashed_backup_job_ids(db, tasks) == ["alive"]
+    assert crashed_backup_job_ids(db, []) == []
+
+
+def test_server_requeues_crashed_backup_on_start(tmp_path):
+    """End to end (needs the TLS stack): a 'running' backup task left in
+    the DB by a dead process is converted to an error task at start()
+    and the job re-runs to success on a local target."""
+    pytest.importorskip("cryptography")
+    from pbs_plus_tpu.server.store import Server, ServerConfig, make_upid
+
+    src = tmp_path / "src"
+    src.mkdir()
+    rng = np.random.default_rng(5)
+    (src / "data.bin").write_bytes(
+        rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes())
+
+    async def main():
+        cfg = ServerConfig(state_dir=str(tmp_path / "state"),
+                           cert_dir=str(tmp_path / "certs"),
+                           datastore_dir=str(tmp_path / "ds"),
+                           chunk_avg=1 << 14, max_concurrent=2,
+                           resume_requeue_delay_s=0.0,
+                           checkpoint_interval="4c")
+        server = Server(cfg)
+        try:
+            server.db.upsert_target("lt", "local", root_path=str(src))
+            server.db.upsert_backup_job(database.BackupJobRow(
+                id="rq", target="lt", source_path=str(src)))
+            # the crashed process's still-'running' task
+            upid = make_upid("backup", "rq")
+            server.db.create_task(upid, "rq", "backup")
+            await server.start()
+            for _ in range(200):               # requeue task is async
+                if server.jobs.is_active("backup:rq"):
+                    break
+                await asyncio.sleep(0.05)
+            await server.jobs.wait("backup:rq", timeout=60)
+            old = server.db.get_task(upid)
+            assert old["status"] == database.STATUS_ERROR
+            assert "re-enqueued for resume" in old["log"]
+            row = server.db.get_backup_job("rq")
+            assert row.last_status == database.STATUS_SUCCESS
+            assert server.datastore.datastore.list_snapshots() != []
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
