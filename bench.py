@@ -35,6 +35,41 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
+def _machine_context() -> dict:
+    """Host context recorded in EVERY bench JSON so run-to-run CPU
+    numbers are comparable (round-5: the CPU fallback halved with no way
+    to tell noise from regression — cpu model/cores/load make that
+    call possible)."""
+    ctx: dict = {
+        "python": sys.version.split()[0],
+        "cores": os.cpu_count(),
+        "platform": sys.platform,
+    }
+    try:
+        ctx["loadavg_1m_5m_15m"] = [round(x, 2) for x in os.getloadavg()]
+    except OSError:
+        pass
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    ctx["cpu_model"] = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    try:
+        import jax
+        ctx["jax"] = jax.__version__
+    except Exception:
+        ctx["jax"] = None
+    try:
+        import numpy as _np
+        ctx["numpy"] = _np.__version__
+    except Exception:
+        pass
+    return ctx
+
+
 def _cpu_baseline(mib: int = 256) -> dict:
     """Single-core CPU: native buzhash candidates + greedy cuts + OpenSSL
     sha256 per chunk (sequential, as the reference's writer hot loop)."""
@@ -219,6 +254,112 @@ def _resume_bench(mib: int = 64) -> dict | None:
         }
     finally:
         failpoints.disarm_all()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _read_bench(mib: int = 64, *, window_kib: int = 128,
+                chunk_avg: int = 1 << 20) -> dict:
+    """Read-path benchmark (docs/data-plane.md "Read path"): restore and
+    windowed-read throughput through the chunk cache vs the cold
+    single-chunk path.
+
+    Workload: one `mib`-MiB file read (a) end-to-end (restore) and
+    (b) in `window_kib`-KiB sequential windows (the ranged `read_at`
+    pattern an agent-side restore or FUSE mount produces — ~8 windows
+    per 1-MiB chunk, so the uncached path decompresses every chunk ~8x).
+    Reported: cold (cache disabled) vs warm (cache + readahead) MiB/s
+    and the re-decompression ratio (store loads / distinct chunks; the
+    cache should pin it at ~1.0)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from pbs_plus_tpu.chunker import ChunkerParams
+    from pbs_plus_tpu.pxar import chunkcache
+    from pbs_plus_tpu.pxar.backupproxy import LocalStore
+    from pbs_plus_tpu.pxar.format import KIND_DIR, KIND_FILE, Entry
+
+    class _CountingStore:
+        def __init__(self, inner):
+            self.inner = inner
+            self.loads = 0
+
+        def get(self, digest):
+            self.loads += 1
+            return self.inner.get(digest)
+
+    params = ChunkerParams(avg_size=chunk_avg)
+    tmp = tempfile.mkdtemp(prefix="pbs-read-bench-")
+    try:
+        import io
+        store = LocalStore(os.path.join(tmp, "ds"), params)
+        rng = np.random.default_rng(11)
+        blob = rng.integers(0, 256, mib << 20, dtype=np.uint8).tobytes()
+        sess = store.start_session(backup_type="host", backup_id="rb")
+        sess.writer.write_entry(Entry(path="", kind=KIND_DIR))
+        sess.writer.write_entry_reader(
+            Entry(path="f.bin", kind=KIND_FILE), io.BytesIO(blob))
+        sess.finish()
+
+        window = window_kib << 10
+
+        def run(cache, *, windowed):
+            reader = store.open_snapshot(sess.ref, cache=cache)
+            counting = _CountingStore(store.datastore.chunks)
+            reader.store = counting
+            e = reader.lookup("f.bin")
+            t0 = time.perf_counter()
+            if windowed:
+                for off in range(0, e.size, window):
+                    reader.read_file(e, off, window)
+            else:
+                reader.read_file(e)
+            dt = time.perf_counter() - t0
+            cache.drain()      # settle in-flight prefetch load counts
+            return mib / dt, counting.loads
+
+        chunks = 0
+        reader = store.open_snapshot(sess.ref,
+                                     cache=chunkcache.ChunkCache(0))
+        chunks = len(reader.payload_index)
+
+        # cold single-chunk path: cache disabled, every window pays
+        # open+read+decompress+sha per overlapping chunk
+        cold_windowed_mib_s, cold_loads = run(
+            chunkcache.ChunkCache(0), windowed=True)
+        cold_restore_mib_s, _ = run(chunkcache.ChunkCache(0),
+                                    windowed=False)
+
+        # warm path: one cache across both passes — the first windowed
+        # pass populates (each chunk loaded once), the second measures
+        # steady-state serving
+        cache = chunkcache.ChunkCache(max(256 << 20, 2 * (mib << 20)),
+                                      readahead_chunks=4)
+        _, first_pass_loads = run(cache, windowed=True)
+        warm_windowed_mib_s, warm_loads = run(cache, windowed=True)
+        warm_restore_mib_s, _ = run(cache, windowed=False)
+
+        return {
+            "source_mib": mib,
+            "window_kib": window_kib,
+            "chunk_avg": chunk_avg,
+            "payload_chunks": chunks,
+            "cold_windowed_mib_s": round(cold_windowed_mib_s, 1),
+            "cold_restore_mib_s": round(cold_restore_mib_s, 1),
+            "warm_windowed_mib_s": round(warm_windowed_mib_s, 1),
+            "warm_restore_mib_s": round(warm_restore_mib_s, 1),
+            "warm_vs_cold_windowed": round(
+                warm_windowed_mib_s / cold_windowed_mib_s, 2),
+            "warm_vs_cold_restore": round(
+                warm_restore_mib_s / cold_restore_mib_s, 2),
+            # store loads per distinct chunk for the windowed workload:
+            # the uncached path re-decompresses ~window-per-chunk times,
+            # the cache pins it at 1.0 (populating pass) / 0.0 (warm)
+            "cold_redecompress_ratio": round(cold_loads / chunks, 2),
+            "cached_redecompress_ratio": round(
+                (first_pass_loads + warm_loads) / chunks, 2),
+        }
+    finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
@@ -494,6 +635,7 @@ def main() -> None:
                 "TPU result captured mid-round by tools/warm_bench.py; "
                 "relay window closed again before the end-of-round run")
             captured["detail"]["end_of_round_probe"] = probe_diag
+            captured["machine"] = _machine_context()
             print(json.dumps(captured))
             return
     # the captured path above carries its own baseline — only the live
@@ -540,6 +682,14 @@ def main() -> None:
         resume = None
     if resume is not None:
         result["detail"]["resume"] = resume
+    try:
+        read = _read_bench()
+    except Exception as e:
+        sys.stderr.write(f"[bench] read bench unavailable: {e}\n")
+        read = None
+    if read is not None:
+        result["detail"]["read"] = read
+    result["machine"] = _machine_context()
     print(json.dumps(result))
 
 

@@ -253,8 +253,10 @@ def _cmd_mount(args: argparse.Namespace) -> int:
                                pbs_format=args.datastore_format == "pbs")
         previous = None
         if args.snapshot:
+            from .pxar import chunkcache
             previous = parse_snapshot_ref(args.snapshot)
-            view = ArchiveView(store.open_snapshot(previous))
+            view = ArchiveView(store.open_snapshot(
+                previous, cache=chunkcache.shared_cache()))
         else:
             view = ArchiveView(None)     # init mode: empty archive
         state = os.path.abspath(args.mount_state)

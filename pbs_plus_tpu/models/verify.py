@@ -11,6 +11,7 @@ min(NumCPU,16) worker pool.
 
 from __future__ import annotations
 
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -61,10 +62,19 @@ class VerifyPipeline:
         return res
 
     def verify_snapshot(self, reader, *, sample_rate: float = 1.0,
-                        rng: np.random.Generator | None = None) -> VerifyResult:
+                        rng: np.random.Generator | None = None,
+                        workers: int = 0) -> VerifyResult:
         """Spot-check a snapshot (SplitReader): systematic sampling of file
         entries, batched re-hash vs stored entry digests (reference:
-        systematic/stratified file sampling, verification/job.go:41-130)."""
+        systematic/stratified file sampling, verification/job.go:41-130).
+
+        ``workers > 1`` fetches file content / chunks on a thread pool
+        (the reference's min(NumCPU,16) verify workers); verdicts are
+        bit-identical to the sequential run — parallelism only reorders
+        the IO, never the per-item check or the reported order.  All
+        chunk reads go through the reader's chunk cache (verify-once:
+        corruption surfaces as a load failure on the digest's FIRST
+        read; resident chunks were verified when loaded)."""
         rng = rng or np.random.default_rng(0)
         files = [e for e in reader.entries()
                  if e.is_file and e.size and e.digest]
@@ -73,18 +83,25 @@ class VerifyPipeline:
             # has none) — fall back to chunk-level verification against
             # the index digests, which is exactly what a stock PBS
             # verify job recomputes
-            return self._verify_snapshot_chunks(reader, sample_rate, rng)
+            return self._verify_snapshot_chunks(reader, sample_rate, rng,
+                                                workers=workers)
         if sample_rate < 1.0:
             k = max(1, int(len(files) * sample_rate))
             idx = np.sort(rng.choice(len(files), size=k, replace=False))
             files = [files[i] for i in idx]
-        chunks = [reader.read_file(e) for e in files]
+        if workers and workers > 1 and len(files) > 1:
+            with ThreadPoolExecutor(max_workers=workers,
+                                    thread_name_prefix="verify") as pool:
+                chunks = list(pool.map(reader.read_file, files))
+        else:
+            chunks = [reader.read_file(e) for e in files]
         res = self.verify_chunks(chunks, [e.digest for e in files])
         res.corrupt_paths = [files[i].path for i in res.corrupt]
         return res
 
     def _verify_snapshot_chunks(self, reader, sample_rate: float,
-                                rng: np.random.Generator) -> VerifyResult:
+                                rng: np.random.Generator,
+                                *, workers: int = 0) -> VerifyResult:
         digests: list[bytes] = []
         for index in (reader.meta_index, reader.payload_index):
             digests.extend(index.digest(i) for i in range(len(index.ends)))
@@ -106,35 +123,63 @@ class VerifyPipeline:
         except Exception as e:
             L.debug("device backend probe failed; verifying with "
                     "hashlib: %s", e)
+
+        def fetch(d: bytes) -> bytes | None:
+            # the cache path verifies sha256 on load (ChunkStore.get /
+            # PBSReaderSource.get) and never admits a failed load, so a
+            # successful fetch IS the verification verdict for d
+            try:
+                return reader.fetch_chunk(d)
+            except Exception as e:
+                L.debug("verify: chunk %s unreadable: %s", d.hex()[:16], e)
+                return None
+
+        pool = (ThreadPoolExecutor(max_workers=workers,
+                                   thread_name_prefix="verify")
+                if workers and workers > 1 and len(digests) > 1 else None)
+        # waves bound in-flight decompressed memory (old code capped a
+        # batch at 64 MiB of fetched bytes; 8 chunks ≤ 8×chunk_max keeps
+        # the same order of magnitude with the pool).  Wave size is
+        # FIXED — independent of the worker count — so device-flush
+        # boundaries and therefore verdict order are bit-identical
+        # between sequential and parallel runs.
+        wave = 8
+        pending: list[tuple[int, bytes, bytes]] = []    # device cross-check
+        pending_bytes = 0
         batch_bytes = 64 << 20
-        i = 0
-        while i < len(digests):
-            chunks: list[bytes] = []
-            expect: list[tuple[int, bytes]] = []
-            size = 0
-            while i < len(digests) and size < batch_bytes:
-                d = digests[i]
-                try:
-                    data = reader.store.get(d)
-                except Exception:
-                    res.corrupt.append(i)
-                    res.corrupt_paths.append(f"chunk:{d.hex()}")
-                    i += 1
-                    continue
-                chunks.append(data)
-                expect.append((i, d))
-                size += len(data)
-                i += 1
-            if not chunks:
-                continue
-            if use_device:
-                sub = self.verify_chunks(chunks, [d for _, d in expect])
-                bad = sub.corrupt
-            else:
-                import hashlib
-                bad = [j for j, (_, d) in enumerate(expect)
-                       if hashlib.sha256(chunks[j]).digest() != d]
-            for j in bad:
-                res.corrupt.append(expect[j][0])
-                res.corrupt_paths.append(f"chunk:{expect[j][1].hex()}")
+
+        def flush_device() -> None:
+            nonlocal pending, pending_bytes
+            if not pending:
+                return
+            # device cross-check keeps the TPU batch-hash path
+            # exercised; on CPU the load-time digest check above
+            # already proved every fetched chunk
+            sub = self.verify_chunks([g[2] for g in pending],
+                                     [g[1] for g in pending])
+            for j in sub.corrupt:
+                res.corrupt.append(pending[j][0])
+                res.corrupt_paths.append(f"chunk:{pending[j][1].hex()}")
+            pending, pending_bytes = [], 0
+
+        try:
+            for base in range(0, len(digests), wave):
+                batch = digests[base:base + wave]
+                datas = list(pool.map(fetch, batch)) if pool is not None \
+                    else [fetch(d) for d in batch]
+                for j, (d, data) in enumerate(zip(batch, datas)):
+                    if data is None:
+                        res.corrupt.append(base + j)
+                        res.corrupt_paths.append(f"chunk:{d.hex()}")
+                    elif use_device:
+                        pending.append((base + j, d, data))
+                        pending_bytes += len(data)
+                # non-device runs retain nothing: the fetch itself was
+                # the verdict, and the bytes are released per wave
+                if pending_bytes >= batch_bytes:
+                    flush_device()
+            flush_device()
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=False)
         return res

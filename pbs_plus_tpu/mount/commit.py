@@ -223,7 +223,11 @@ class CommitEngine:
                 raise
 
             new_ref = session.ref
-            reader = self.store.open_snapshot(new_ref)
+            # the hot-swapped view serves FUSE reads — share the process
+            # cache so the post-commit re-read of just-written chunks hits
+            from ..pxar import chunkcache
+            reader = self.store.open_snapshot(
+                new_ref, cache=chunkcache.shared_cache())
             if not pre_verify:
                 prog.emit("verify")
                 try:

@@ -65,7 +65,14 @@ class ArchiveView:
         r = self.reader
         if r is None:
             raise FileNotFoundError(entry.path)
+        # goes through the reader's chunk cache (pxar/chunkcache.py):
+        # FUSE issues window-sized reads, the cache's readahead turns a
+        # sequential file read into prefetched whole-chunk loads and the
+        # window re-reads into decompress-free hits
         data = r.read_file(entry, off, size)
         self.stats["reads"] += 1
         self.stats["bytes"] += len(data)
+        hits, misses = r.cache_stats
+        self.stats["cache_hits"] = hits
+        self.stats["cache_misses"] = misses
         return data

@@ -1,0 +1,299 @@
+"""Shared read-path chunk cache: byte-budgeted LRU of decompressed,
+verified chunks, with single-flight fetch and sequential readahead.
+
+Every read consumer — restore, verification, FUSE mounts, zip download,
+ranged ``pxar.read_at`` over aRPC — used to go through ``ChunkStore.get``
+one chunk at a time, paying open+read+decompress+SHA-256 per call with
+zero caching; a file served in small RPC windows re-decompressed the
+same 2-4 MiB chunk dozens of times.  This module puts one process-wide
+cache in front of every chunk source (docs/data-plane.md "Read path"):
+
+- **Verify-once**: a chunk is SHA-256-checked when it is loaded (every
+  chunk source's ``get`` verifies against the digest) and never
+  re-hashed on a hit.  Safe because chunks are content-addressed and
+  immutable — sweep/re-insert cannot change a digest's bytes, so a
+  verified resident copy stays correct for the digest's lifetime.  A
+  load failure (corrupt on disk, transport fault) propagates to the
+  caller and the chunk is NEVER admitted.
+- **Single-flight**: concurrent readers of one digest trigger exactly
+  one underlying load (``utils.singleflight.ThreadSingleFlight``); the
+  rest block and share the decompressed bytes.
+- **Readahead**: ``ReadaheadState`` (one per reader stream) detects
+  forward scans over a ``DynamicIndex`` and prefetches the next N
+  chunks on a small shared thread pool, never past the index.
+
+Keyed by digest alone: content addressing makes the mapping
+digest→bytes store-independent, so one cache serves every open reader
+(local ChunkStore and PBS reader sessions alike).  Budget comes from
+``PBS_PLUS_CHUNK_CACHE_MB`` (``conf.Env.chunk_cache_mb``), overridable
+per server via ``ServerConfig.chunk_cache_mb``; 0 disables caching
+(every get is a verified pass-through load).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterable
+
+from ..utils.log import L
+from ..utils.singleflight import ThreadSingleFlight
+
+_PREFETCH_WORKERS = 2
+_PREFETCH_QUEUE_CAP = 64        # advisory work only: shed, never queue deep
+
+# ONE prefetch pool per process, shared by every cache instance (a pool
+# per cache would leak 2 threads per open reader in a long-lived server)
+_pool_lock = threading.Lock()
+_pool: ThreadPoolExecutor | None = None
+
+
+def _prefetch_pool() -> ThreadPoolExecutor:
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = ThreadPoolExecutor(
+                max_workers=_PREFETCH_WORKERS,
+                thread_name_prefix="chunk-prefetch")
+        return _pool
+
+
+class ChunkCache:
+    """Byte-budgeted LRU of decompressed, verified chunks."""
+
+    def __init__(self, max_bytes: int, *, readahead_chunks: int = 4):
+        self.max_bytes = max(0, int(max_bytes))
+        self.readahead_chunks = max(0, int(readahead_chunks))
+        self._lock = threading.Lock()
+        # digest -> [data, prefetched_flag]; flag clears on first hit so
+        # prefetch_used counts chunks a prefetch actually saved a load for
+        self._d: "OrderedDict[bytes, list]" = OrderedDict()
+        self._size = 0
+        self._flight = ThreadSingleFlight()
+        self._inflight_prefetch = 0
+        self.counters = {
+            "hits": 0, "misses": 0, "evictions": 0,
+            "prefetch_issued": 0, "prefetch_used": 0,
+            "load_errors": 0,
+        }
+
+    # -- core get ----------------------------------------------------------
+    def get(self, store, digest: bytes, stats: dict | None = None) -> bytes:
+        """Decompressed, verified bytes for ``digest``.  Cache hit: no
+        disk IO, no re-hash.  Miss: exactly one ``store.get`` across all
+        concurrent callers (which verifies SHA-256 on load), admitted on
+        success only.  ``stats`` is an optional per-caller dict whose
+        ``hits``/``misses`` keys are incremented alongside the global
+        counters (per-reader cache stats for ``pxar.stats``)."""
+        with self._lock:
+            ent = self._d.get(digest)
+            if ent is not None:
+                self._d.move_to_end(digest)
+                self.counters["hits"] += 1
+                if ent[1]:
+                    ent[1] = False
+                    self.counters["prefetch_used"] += 1
+                if stats is not None:
+                    stats["hits"] = stats.get("hits", 0) + 1
+                return ent[0]
+            self.counters["misses"] += 1
+            if stats is not None:
+                stats["misses"] = stats.get("misses", 0) + 1
+        return self._flight.do(digest, lambda: self._load(store, digest))
+
+    def _load(self, store, digest: bytes, *, prefetched: bool = False) -> bytes:
+        """Single-flight body: verified load + admission.  Runs on the
+        calling thread (foreground miss) or the prefetch pool."""
+        with self._lock:
+            # a caller that lost the lookup race to a just-landed flight
+            # must not issue a second disk read for resident bytes
+            ent = self._d.get(digest)
+            if ent is not None:
+                self._d.move_to_end(digest)
+                return ent[0]
+        try:
+            data = store.get(digest)     # verifies sha256(data) == digest
+        except BaseException:
+            with self._lock:
+                self.counters["load_errors"] += 1
+            raise
+        self._admit(digest, data, prefetched=prefetched)
+        return data
+
+    def _admit(self, digest: bytes, data: bytes, *,
+               prefetched: bool = False) -> None:
+        n = len(data)
+        if self.max_bytes <= 0 or n > self.max_bytes:
+            return                       # disabled, or would evict everything
+        with self._lock:
+            if digest in self._d:
+                return
+            self._d[digest] = [data, prefetched]
+            self._size += n
+            while self._size > self.max_bytes and self._d:
+                _, (old, _fl) = self._d.popitem(last=False)
+                self._size -= len(old)
+                self.counters["evictions"] += 1
+
+    def contains(self, digest: bytes) -> bool:
+        with self._lock:
+            return digest in self._d
+
+    # -- prefetch ----------------------------------------------------------
+    def prefetch(self, store, digests: Iterable[bytes]) -> int:
+        """Schedule background loads for ``digests`` (advisory: errors
+        are logged and surface on the foreground read instead; work is
+        shed when the queue is saturated).  Returns the number of loads
+        actually issued."""
+        if self.max_bytes <= 0:
+            return 0
+        issued = 0
+        for digest in digests:
+            if self._flight.in_flight(digest):
+                continue                 # someone is already loading it
+            with self._lock:
+                if digest in self._d:
+                    continue
+                if self._inflight_prefetch >= _PREFETCH_QUEUE_CAP:
+                    break
+                self._inflight_prefetch += 1
+                self.counters["prefetch_issued"] += 1
+            issued += 1
+            _prefetch_pool().submit(self._prefetch_one, store, digest)
+        return issued
+
+    def _prefetch_one(self, store, digest: bytes) -> None:
+        try:
+            if not self.contains(digest):
+                self._flight.do(
+                    digest, lambda: self._load(store, digest,
+                                               prefetched=True))
+        except Exception as e:
+            # advisory work: the foreground read of this digest will
+            # surface the real error with full context
+            L.debug("chunk prefetch failed for %s: %s",
+                    digest.hex()[:16], e)
+        finally:
+            with self._lock:
+                self._inflight_prefetch -= 1
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Block until no prefetch is in flight (tests/bench: settles
+        load counters; the pool stays usable)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._inflight_prefetch == 0:
+                    return
+            time.sleep(0.002)
+
+    # -- management --------------------------------------------------------
+    def resize(self, max_bytes: int) -> None:
+        with self._lock:
+            self.max_bytes = max(0, int(max_bytes))
+            while self._size > self.max_bytes and self._d:
+                _, (old, _fl) = self._d.popitem(last=False)
+                self._size -= len(old)
+                self.counters["evictions"] += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+            self._size = 0
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._size
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self.counters)
+            out["resident_bytes"] = self._size
+            out["resident_chunks"] = len(self._d)
+            out["budget_bytes"] = self.max_bytes
+        sf = self._flight.stats
+        out["singleflight_shared"] = sf["shared"]
+        return out
+
+
+class ReadaheadState:
+    """Forward-scan detector for one indexed stream (one instance per
+    (reader, index) pair — SplitReader keeps one for meta and one for
+    payload).  A read whose first chunk continues the previous read's
+    window (same chunk or the next one) is a forward scan: prefetch the
+    ``cache.readahead_chunks`` chunks after the window, clamped to the
+    index — the prefetcher never reads past the last chunk."""
+
+    __slots__ = ("_last_ci", "_horizon")
+
+    def __init__(self) -> None:
+        self._last_ci = -1
+        self._horizon = -1     # furthest chunk already handed to prefetch
+
+    def on_read(self, cache: ChunkCache, store, index,
+                first_ci: int, last_ci: int) -> int:
+        """Notify a read that covered chunks [first_ci, last_ci]."""
+        sequential = 0 <= self._last_ci and \
+            self._last_ci <= first_ci <= self._last_ci + 1
+        self._last_ci = last_ci
+        if not sequential:
+            self._horizon = last_ci      # a seek resets the window
+            return 0
+        if cache.readahead_chunks <= 0:
+            return 0
+        start = max(last_ci + 1, self._horizon + 1)
+        stop = min(last_ci + 1 + cache.readahead_chunks, len(index))
+        if start >= stop:
+            return 0
+        self._horizon = stop - 1
+        return cache.prefetch(
+            store, (index.digest(ci) for ci in range(start, stop)))
+
+
+# -- process-shared cache ---------------------------------------------------
+
+_shared_lock = threading.Lock()
+_shared: ChunkCache | None = None
+
+
+def shared_cache() -> ChunkCache:
+    """The process-wide cache every reader shares by default, sized from
+    ``PBS_PLUS_CHUNK_CACHE_MB`` on first use."""
+    global _shared
+    with _shared_lock:
+        if _shared is None:
+            from ..utils import conf
+            e = conf.env()
+            _shared = ChunkCache(
+                int(e.chunk_cache_mb) << 20,
+                readahead_chunks=int(e.chunk_readahead))
+        return _shared
+
+
+def configure_shared(*, max_bytes: int | None = None,
+                     readahead_chunks: int | None = None) -> ChunkCache:
+    """Server-config override of the shared cache (ServerConfig.
+    chunk_cache_mb); resizing evicts down to the new budget in place so
+    already-open readers see the new limit."""
+    cache = shared_cache()
+    if max_bytes is not None:
+        cache.resize(max_bytes)
+    if readahead_chunks is not None:
+        cache.readahead_chunks = max(0, int(readahead_chunks))
+    return cache
+
+
+def metrics_snapshot() -> dict:
+    """Shared-cache counters for server/metrics.py (zeros before first
+    use — rendering must not force readers into existence elsewhere)."""
+    with _shared_lock:
+        cache = _shared
+    if cache is None:
+        return {"hits": 0, "misses": 0, "evictions": 0,
+                "prefetch_issued": 0, "prefetch_used": 0, "load_errors": 0,
+                "resident_bytes": 0, "resident_chunks": 0,
+                "budget_bytes": 0, "singleflight_shared": 0}
+    return cache.snapshot()

@@ -105,7 +105,25 @@ class ChunkStore:
         self.blob_format = blob_format
         self._level = compression_level
         self._cctx = zstandard.ZstdCompressor(level=compression_level)
-        self._dctx = zstandard.ZstdDecompressor()
+        # reads happen concurrently (chunk-cache prefetch pool, parallel
+        # verification workers) and a zstd decompressor is NOT
+        # thread-safe — one per reading thread
+        self._dctx_local = threading.local()
+        # digests whose on-disk file this process has already confirmed
+        # (or made) a DataBlob — the pbs-format dedup-hit path skips the
+        # full read+decompress upgrade probe after the first sighting.
+        # Bounded: past the cap the set resets and probes re-run (the
+        # probe is an optimization; open-ended growth on a store with
+        # tens of millions of chunks is not)
+        self._datablob_seen: set[bytes] = set()
+        self._datablob_seen_cap = 1 << 20
+
+    @property
+    def _dctx(self):
+        d = getattr(self._dctx_local, "d", None)
+        if d is None:
+            d = self._dctx_local.d = zstandard.ZstdDecompressor()
+        return d
 
     def _path(self, digest: bytes) -> str:
         h = digest.hex()
@@ -124,14 +142,28 @@ class ChunkStore:
         # what "no orphaned partial chunks" rests on either way
         failpoints.hit("pbsstore.chunk.insert")
         p = self._path(digest)
-        if os.path.exists(p):
-            if self.blob_format == "pbs":
+        # dedup-hit probe + GC-mark touch in ONE syscall (the old
+        # os.path.exists + touch pair double-statted every hit)
+        exists = True
+        try:
+            os.utime(p)
+        except FileNotFoundError:
+            exists = False
+        except OSError:
+            # utime denied (read-only store surface) but the chunk may
+            # exist — fall back to the explicit stat before rewriting
+            exists = os.path.exists(p)
+        if exists:
+            if self.blob_format == "pbs" \
+                    and digest not in self._datablob_seen:
                 # a dedup hit against a NATIVE raw-zstd chunk would leave
                 # this pbs-format snapshot referencing a file a stock PBS
                 # cannot decode — upgrade it to a DataBlob in place (this
-                # build reads both, so nothing else notices)
+                # build reads both, so nothing else notices).  Confirmed
+                # once per digest per process: chunks are immutable, so
+                # the probe never needs repeating on later dedup hits.
                 self._upgrade_to_datablob(p)
-            self.touch(digest)
+                self._remember_datablob(digest)
             return False
         if verify and hashlib.sha256(data).digest() != digest:
             raise ValueError("chunk digest mismatch on insert")
@@ -145,7 +177,14 @@ class ChunkStore:
         with open(tmp, "wb") as f:
             f.write(payload)
         os.replace(tmp, p)
+        if self.blob_format == "pbs":
+            self._remember_datablob(digest)
         return True
+
+    def _remember_datablob(self, digest: bytes) -> None:
+        if len(self._datablob_seen) >= self._datablob_seen_cap:
+            self._datablob_seen.clear()
+        self._datablob_seen.add(digest)
 
     def _upgrade_to_datablob(self, p: str) -> None:
         from .pbsformat import blob_encode, is_datablob
@@ -162,6 +201,11 @@ class ChunkStore:
     def get(self, digest: bytes) -> bytes:
         with open(self._path(digest), "rb") as f:
             raw = f.read()
+        # read-side fault injection (docs/fault-injection.md): `raise`/
+        # `delay` model EIO/slow disks; `corrupt` flips a bit in the raw
+        # frame so the digest check below must catch it — proving a bad
+        # chunk is never admitted to the read cache
+        raw = failpoints.hit("pbsstore.chunk.read", raw)
         from .pbsformat import blob_decode, is_datablob
         if is_datablob(raw):
             data = blob_decode(raw, dctx=self._dctx)

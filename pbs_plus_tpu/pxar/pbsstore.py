@@ -50,6 +50,7 @@ import hashlib
 import http.client
 import json
 import ssl
+import threading
 import time
 import urllib.parse
 from dataclasses import dataclass, field
@@ -406,6 +407,12 @@ class PBSReaderSource:
         self._http: _PBSHttp | None = None
         self._dctx = zstandard.ZstdDecompressor()
         self.chunks_fetched = 0
+        # the chunk cache's readahead pool and the verification worker
+        # pool call get() concurrently; this source owns ONE HTTP
+        # connection and ONE zstd context, neither thread-safe — all
+        # session traffic serializes here (concurrent readers of one
+        # digest already coalesce via the cache's single-flight)
+        self._lock = threading.RLock()
 
     def _session(self) -> _PBSHttp:
         if self._http is None:
@@ -421,15 +428,17 @@ class PBSReaderSource:
         writer session, a reader session is read-only and safe to
         re-establish — without this, a keep-alive timeout on a long-lived
         hot-swapped mount view would poison every later read."""
-        try:
-            return self._session().call("GET", path, params=params)
-        except (ConnectionError, http.client.HTTPException, OSError):
-            self.close()
-            return self._session().call("GET", path, params=params)
+        with self._lock:
+            try:
+                return self._session().call("GET", path, params=params)
+            except (ConnectionError, http.client.HTTPException, OSError):
+                self.close()
+                return self._session().call("GET", path, params=params)
 
     def get(self, digest: bytes) -> bytes:
         raw = self._call("/chunk", {"digest": digest.hex()})
-        data = self._dctx.decompress(raw, max_output_size=1 << 30)
+        with self._lock:
+            data = self._dctx.decompress(raw, max_output_size=1 << 30)
         if hashlib.sha256(data).digest() != digest:
             raise IOError(f"reader chunk {digest.hex()} digest mismatch")
         self.chunks_fetched += 1
@@ -444,9 +453,10 @@ class PBSReaderSource:
         pass
 
     def close(self) -> None:
-        if self._http is not None:
-            self._http.close()
-            self._http = None
+        with self._lock:
+            if self._http is not None:
+                self._http.close()
+                self._http = None
 
 
 class PBSBackupSession:

@@ -93,11 +93,15 @@ class RemoteArchiveServer:
         n = int(req.payload["n"])
         if n < 0 or n > MAX_READ:
             raise HandlerError(f"read size {n} out of range", status=400)
-        data = self.reader.read_file(e, off, n)
+        # chunk-aligned pump through the shared chunk cache: the range is
+        # never materialized whole, and agents reading a file in small
+        # windows decompress each underlying chunk once, not once per
+        # window (docs/data-plane.md "Read path")
+        rdr, size = self.reader.file_reader(e, off, n)
 
         async def pump(stream):
-            await send_data_from_reader(stream, data, len(data))
-        return RawStreamHandler(pump, data={"n": len(data)})
+            await send_data_from_reader(stream, rdr, size)
+        return RawStreamHandler(pump, data={"n": size})
 
     async def _stats(self, req, ctx):
         hits, misses = self.reader.cache_stats

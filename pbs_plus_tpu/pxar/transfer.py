@@ -29,7 +29,7 @@ import io
 import json
 import os
 import time
-from collections import OrderedDict, deque
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Iterator
 
@@ -698,50 +698,43 @@ class DedupWriter(SessionWriter):
         return super().finish()
 
 
-class _LRUCache:
-    """Byte-budgeted LRU of decompressed chunks (reference: per-reader chunk
-    caches, vfs.NewLocalFS(reader).SetMaxCache)."""
-
-    def __init__(self, max_bytes: int):
-        self.max_bytes = max_bytes
-        self._d: OrderedDict[bytes, bytes] = OrderedDict()
-        self._size = 0
-        self.hits = 0
-        self.misses = 0
-
-    def get(self, key: bytes) -> bytes | None:
-        v = self._d.get(key)
-        if v is not None:
-            self._d.move_to_end(key)
-            self.hits += 1
-        else:
-            self.misses += 1
-        return v
-
-    def put(self, key: bytes, value: bytes) -> None:
-        if key in self._d:
-            return
-        self._d[key] = value
-        self._size += len(value)
-        while self._size > self.max_bytes and self._d:
-            _, old = self._d.popitem(last=False)
-            self._size -= len(old)
-
-
 class SplitReader:
     """Random-access reader over a (meta_didx, payload_didx, chunk store)
     triple (reference: transfer.NewSplitReader,
-    /root/reference/internal/pxar/format.go:108-126)."""
+    /root/reference/internal/pxar/format.go:108-126).
+
+    Chunk reads go through a ``chunkcache.ChunkCache`` (decompressed+
+    verified LRU with single-flight fetch and sequential readahead —
+    docs/data-plane.md "Read path").  Default: a private per-reader
+    cache (``max_cache_bytes``, 256 MiB), preserving the old per-reader
+    isolation — a fresh reader always re-reads (and re-verifies) the
+    disk.  Server read consumers pass ``cache=chunkcache.shared_cache()``
+    explicitly to share verified chunks process-wide."""
 
     def __init__(self, meta_index: DynamicIndex, payload_index: DynamicIndex,
-                 store: ChunkStore, *, max_cache_bytes: int = 256 << 20):
+                 store: ChunkStore, *, max_cache_bytes: int | None = None,
+                 cache: "chunkcache.ChunkCache | None" = None):
+        from . import chunkcache
         self.meta_index = meta_index
         self.payload_index = payload_index
         self.store = store
-        self._cache = _LRUCache(max_cache_bytes)
+        if cache is not None:
+            self._cache = cache
+        else:
+            self._cache = chunkcache.ChunkCache(
+                 256 << 20 if max_cache_bytes is None else max_cache_bytes)
+        # per-reader hit/miss counts (the shared cache aggregates across
+        # every reader; pxar.stats wants THIS reader's locality)
+        self._stats = {"hits": 0, "misses": 0}
+        self._ra = {id(self.meta_index): chunkcache.ReadaheadState(),
+                    id(self.payload_index): chunkcache.ReadaheadState()}
         self._tree: dict[str, Entry] | None = None
         self._children: dict[str, list[str]] | None = None
         self._codec: str | None = None
+
+    @property
+    def cache(self) -> "chunkcache.ChunkCache":
+        return self._cache
 
     @property
     def codec(self) -> str:
@@ -755,6 +748,12 @@ class SplitReader:
         return self._codec
 
     # -- low-level stream reads ------------------------------------------
+    def fetch_chunk(self, digest: bytes) -> bytes:
+        """Decompressed, verified bytes of one chunk, through the cache
+        (the ONLY sanctioned path to the chunk source on the read side —
+        pbslint rule ``cache-discipline``)."""
+        return self._cache.get(self.store, digest, self._stats)
+
     def _read_stream(self, index: DynamicIndex, offset: int, size: int) -> bytes:
         if size <= 0:
             return b""
@@ -762,15 +761,19 @@ class SplitReader:
         if offset >= end:
             return b""
         parts: list[bytes] = []
+        first_ci = last_ci = -1
         for ci in index.chunks_overlapping(offset, end):
             cs, ce = index.chunk_bounds(ci)
-            digest = index.digest(ci)
-            data = self._cache.get(digest)
-            if data is None:
-                data = self.store.get(digest)
-                self._cache.put(digest, data)
+            data = self.fetch_chunk(index.digest(ci))
             lo, hi = max(cs, offset), min(ce, end)
             parts.append(data[lo - cs:hi - cs])
+            if first_ci < 0:
+                first_ci = ci
+            last_ci = ci
+        if first_ci >= 0:
+            ra = self._ra.get(id(index))
+            if ra is not None:
+                ra.on_read(self._cache, self.store, index, first_ci, last_ci)
         return b"".join(parts)
 
     def read_payload(self, offset: int, size: int) -> bytes:
@@ -815,26 +818,68 @@ class SplitReader:
             raise FileNotFoundError(path)
         return [self._tree[p] for p in sorted(self._children.get(key, []))]
 
-    def read_file(self, entry: Entry, offset: int = 0, size: int = -1) -> bytes:
+    def _file_range(self, entry: Entry, offset: int, size: int) -> tuple[int, int]:
+        """Clamped (payload_offset, size) for a ranged file read."""
         if not entry.is_file:
             raise IsADirectoryError(entry.path)
         if entry.size == 0 or entry.payload_offset < 0:
-            return b""
+            return 0, 0
         if size < 0:
             size = entry.size - offset
-        size = max(0, min(size, entry.size - offset))
-        return self.read_payload(entry.payload_offset + offset, size)
+        return entry.payload_offset + offset, \
+            max(0, min(size, entry.size - offset))
+
+    def read_file(self, entry: Entry, offset: int = 0, size: int = -1) -> bytes:
+        off, n = self._file_range(entry, offset, size)
+        return self.read_payload(off, n) if n else b""
+
+    def file_reader(self, entry: Entry, offset: int = 0,
+                    size: int = -1) -> "tuple[_RangeIO, int]":
+        """(sequential file-like over the clamped range, range size) —
+        the chunk-aligned pump: consumers read in their own window size
+        while each underlying chunk is decompressed at most once (cache
+        hits serve every later window), and the whole range is never
+        materialized at once (remote.read_at, zip streaming)."""
+        off, n = self._file_range(entry, offset, size)
+        return _RangeIO(self, self.payload_index, off, n), n
 
     @property
     def cache_stats(self) -> tuple[int, int]:
-        return self._cache.hits, self._cache.misses
+        """(hits, misses) of THIS reader against the (shared) cache."""
+        return self._stats["hits"], self._stats["misses"]
 
     # -- construction helpers --------------------------------------------
     @classmethod
     def open_snapshot(cls, ds: Datastore, ref: SnapshotRef,
-                      *, max_cache_bytes: int = 256 << 20) -> "SplitReader":
+                      *, max_cache_bytes: int | None = None,
+                      cache=None) -> "SplitReader":
         midx, pidx = ds.load_indexes(ref)
-        return cls(midx, pidx, ds.chunks, max_cache_bytes=max_cache_bytes)
+        return cls(midx, pidx, ds.chunks, max_cache_bytes=max_cache_bytes,
+                   cache=cache)
+
+
+class _RangeIO(io.RawIOBase):
+    """Sequential file-like over one [offset, offset+size) stream range.
+    Each ``read(n)`` goes through ``SplitReader._read_stream`` — i.e.
+    the chunk cache — so window-sized consumers pay one decompress per
+    chunk, not one per window."""
+
+    def __init__(self, reader: "SplitReader", index: DynamicIndex,
+                 offset: int, size: int):
+        self._r = reader
+        self._idx = index
+        self._pos = offset
+        self._end = offset + size
+
+    def read(self, n: int = -1) -> bytes:
+        remaining = self._end - self._pos
+        if remaining <= 0:
+            return b""
+        if n < 0 or n > remaining:
+            n = remaining
+        out = self._r._read_stream(self._idx, self._pos, n)
+        self._pos += len(out)
+        return out
 
 
 class _StreamIO(io.RawIOBase):

@@ -9,11 +9,26 @@ from __future__ import annotations
 
 import io
 import os
+import shutil
 import zipfile
 from typing import BinaryIO
 
 from .format import Entry, KIND_DIR, KIND_FILE, KIND_HARDLINK, KIND_SYMLINK
 from .transfer import SplitReader
+
+_COPY_WINDOW = 1 << 20
+
+
+def _write_file(zf: zipfile.ZipFile, info: zipfile.ZipInfo,
+                reader: SplitReader, entry: Entry) -> None:
+    """Stream one file into the zip through the chunk cache: window-sized
+    copies from a sequential range reader (readahead-friendly) instead of
+    materializing the whole file — a multi-GiB member costs one chunk of
+    resident memory, and shared chunks across members decompress once."""
+    src, size = reader.file_reader(entry)
+    info.file_size = size
+    with zf.open(info, "w", force_zip64=size >= (1 << 31)) as dst:
+        shutil.copyfileobj(src, dst, _COPY_WINDOW)
 
 
 def zip_subtree(reader: SplitReader, subpath: str = "", *,
@@ -49,7 +64,7 @@ def zip_subtree(reader: SplitReader, subpath: str = "", *,
             elif e.kind == KIND_FILE:
                 info = zipfile.ZipInfo(rel, date_time=date)
                 info.external_attr = ((0o100000 | (e.mode & 0o7777)) << 16)
-                zf.writestr(info, reader.read_file(e))
+                _write_file(zf, info, reader, e)
             elif e.kind == KIND_SYMLINK:
                 info = zipfile.ZipInfo(rel, date_time=date)
                 info.external_attr = ((0o120000 | 0o777) << 16)
@@ -59,8 +74,10 @@ def zip_subtree(reader: SplitReader, subpath: str = "", *,
                 target = reader.lookup(e.link_target)
                 info = zipfile.ZipInfo(rel, date_time=date)
                 info.external_attr = ((0o100000 | (e.mode & 0o7777)) << 16)
-                zf.writestr(info, reader.read_file(target)
-                            if target is not None and target.is_file else b"")
+                if target is not None and target.is_file:
+                    _write_file(zf, info, reader, target)
+                else:
+                    zf.writestr(info, b"")
         emit(root)
     out.seek(0)
     return out

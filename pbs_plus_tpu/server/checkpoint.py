@@ -346,6 +346,12 @@ def load_latest(ds: Datastore, backup_type: str, backup_id: str,
             if missing:
                 raise ValueError(f"{missing} referenced chunk(s) missing "
                                  "from the store")
+            # the scan just proved every referenced chunk present; warm
+            # the read cache with the META stream (ResumePlan decodes it
+            # in full next) so the resume's entry scan starts on hits
+            from ..pxar import chunkcache
+            chunkcache.shared_cache().prefetch(
+                ds.chunks, (midx.digest(i) for i in range(len(midx))))
             return Checkpoint(path, state, midx, pidx)
         except (OSError, ValueError, KeyError) as e:
             L.warning("ignoring invalid checkpoint %s: %s", path, e)
@@ -442,7 +448,9 @@ def open_resume(store, *, backup_type: str, backup_id: str,
         if man.get("created_unix", 0) >= int(ck.state.get("created_unix",
                                                           0)):
             return None
-    reader = SplitReader(ck.midx, ck.pidx, ds.chunks)
+    from ..pxar import chunkcache
+    reader = SplitReader(ck.midx, ck.pidx, ds.chunks,
+                         cache=chunkcache.shared_cache())
     plan = ResumePlan(ck, reader)
     METRICS.inc("resumes")
     L.info("resuming %s/%s from checkpoint %s: %d skippable files "

@@ -265,6 +265,37 @@ class MetricsRegistry:
               [({"queue": q}, float(v))
                for q, v in snap["queues"].items()])
 
+        # -- read-path chunk cache (pxar/chunkcache.py) -----------------------
+        from ..pxar import chunkcache as _chunkcache
+        cc = _chunkcache.metrics_snapshot()
+        gauge("pbs_plus_chunk_cache_hits_total",
+              "Chunk reads served from the shared decompressed-chunk "
+              "cache", [({}, float(cc["hits"]))])
+        gauge("pbs_plus_chunk_cache_misses_total",
+              "Chunk reads that went to the chunk source",
+              [({}, float(cc["misses"]))])
+        gauge("pbs_plus_chunk_cache_evictions_total",
+              "Chunks evicted to stay inside the byte budget",
+              [({}, float(cc["evictions"]))])
+        gauge("pbs_plus_chunk_cache_prefetch_issued_total",
+              "Readahead chunk loads issued",
+              [({}, float(cc["prefetch_issued"]))])
+        gauge("pbs_plus_chunk_cache_prefetch_used_total",
+              "Prefetched chunks later served as hits",
+              [({}, float(cc["prefetch_used"]))])
+        gauge("pbs_plus_chunk_cache_load_errors_total",
+              "Chunk loads that failed verification or IO (never "
+              "admitted)", [({}, float(cc["load_errors"]))])
+        gauge("pbs_plus_chunk_cache_singleflight_shared_total",
+              "Concurrent reads coalesced onto another caller's load",
+              [({}, float(cc["singleflight_shared"]))])
+        gauge("pbs_plus_chunk_cache_resident_bytes",
+              "Decompressed bytes resident in the shared chunk cache",
+              [({}, float(cc["resident_bytes"]))])
+        gauge("pbs_plus_chunk_cache_budget_bytes",
+              "Configured shared chunk cache byte budget",
+              [({}, float(cc["budget_bytes"]))])
+
         # -- durable checkpoints / resume (server/checkpoint.py) -------------
         from . import checkpoint as _checkpoint
         cp = _checkpoint.metrics_snapshot()

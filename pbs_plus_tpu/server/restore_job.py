@@ -13,6 +13,7 @@ import asyncio
 import uuid
 
 from ..arpc import Router, Session
+from ..pxar import chunkcache
 from ..pxar.datastore import parse_snapshot_ref
 from ..pxar.remote import RemoteArchiveServer
 from ..pxar.transfer import SplitReader
@@ -37,7 +38,12 @@ async def run_restore_job(server, rid: str, *, target: str, snapshot: str,
     control_sess = Session(control.conn)
 
     ref = parse_snapshot_ref(snapshot)
-    reader = SplitReader.open_snapshot(server.datastore.datastore, ref)
+    # the process-shared chunk cache (single-flight + readahead): an
+    # agent pulling files front-to-back turns into a sequence of forward
+    # scans, and concurrent restores of sibling snapshots share every
+    # deduped chunk they touch (pxar/chunkcache.py)
+    reader = SplitReader.open_snapshot(server.datastore.datastore, ref,
+                                       cache=chunkcache.shared_cache())
     remote = RemoteArchiveServer(reader, subpath=subpath)
     job_router = Router()
     remote.register(job_router)
@@ -77,7 +83,9 @@ async def run_restore_job(server, rid: str, *, target: str, snapshot: str,
             raise RuntimeError(
                 f"agent restore session lost before completion ({client_id})")
         db.update_restore(rid, database.STATUS_SUCCESS)
-        log.info("restore served: done=%s", remote.done)
+        hits, misses = reader.cache_stats
+        log.info("restore served: done=%s chunk cache hits=%d misses=%d",
+                 remote.done, hits, misses)
         return {"done": remote.done}
     except BaseException as e:
         db.update_restore(rid, database.STATUS_ERROR, error=str(e))

@@ -95,6 +95,13 @@ class ServerConfig:
     # the previous process) are re-enqueued as resumable after this
     # settle delay (lets agents reconnect first); < 0 disables requeue
     resume_requeue_delay_s: float = 5.0
+    # read path (pxar/chunkcache.py): budget of the process-shared
+    # decompressed-chunk LRU in MiB (0 disables; < 0 falls back to
+    # PBS_PLUS_CHUNK_CACHE_MB from the environment) and the worker
+    # count of the verification job's parallel chunk-check pool
+    # (0 = auto: min(8, cores); 1 = sequential)
+    chunk_cache_mb: int = -1
+    verify_workers: int = 0
 
 
 class Server:
@@ -114,6 +121,10 @@ class Server:
         self.certs.ensure_server_identity(config.hostname)
         self.agents = AgentsManager(is_expected=self._is_expected_host)
         self.jobs = JobsManager(max_concurrent=config.max_concurrent)
+        if config.chunk_cache_mb >= 0:
+            from ..pxar import chunkcache
+            chunkcache.configure_shared(
+                max_bytes=config.chunk_cache_mb << 20)
         params = ChunkerParams(avg_size=config.chunk_avg)
         self.datastore = LocalStore(
             config.datastore_dir, params,

@@ -9,14 +9,26 @@ sha256 vs stored digests.  Here the re-hash is the batched VerifyPipeline
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 
 import numpy as np
 
 from ..models.verify import VerifyPipeline
+from ..pxar import chunkcache
 from ..pxar.transfer import SplitReader
 from ..utils.log import L
 from . import database
+
+
+def verify_worker_count(server) -> int:
+    """ServerConfig.verify_workers; 0 = auto (min(8, cores), the
+    reference's min(NumCPU,16) verify pool scaled for the chunk-level
+    loop), 1 = sequential."""
+    n = int(getattr(server.config, "verify_workers", 0) or 0)
+    if n <= 0:
+        n = min(8, os.cpu_count() or 1)
+    return max(1, n)
 
 
 def pick_snapshots(server, *, store_filter: str = "",
@@ -94,12 +106,26 @@ async def check_source_drift(server, ref, reader, *, rng,
 async def run_verification(server, v: dict) -> dict:
     vp = VerifyPipeline()
     rng = np.random.default_rng()
+    workers = verify_worker_count(server)
     report = {"checked": 0, "corrupt": [], "snapshots": [], "drift": []}
     for ref in pick_snapshots(server, store_filter=v.get("store", "")):
-        reader = SplitReader.open_snapshot(server.datastore.datastore, ref)
+        # a PRIVATE cold cache per job, not the shared one: a
+        # verification job exists to catch on-disk bitrot, so every
+        # sampled chunk must be read (and digest-checked) from disk THIS
+        # run — a shared-cache hit would vouch for bytes loaded before
+        # the rot.  The private cache still buys single-flight +
+        # readahead inside the job, and the full-snapshot scan cannot
+        # evict the shared cache's hot restore/mount working set.
+        shared = chunkcache.shared_cache()
+        reader = SplitReader.open_snapshot(
+            server.datastore.datastore, ref,
+            cache=chunkcache.ChunkCache(
+                shared.max_bytes,
+                readahead_chunks=shared.readahead_chunks))
         res = await asyncio.get_running_loop().run_in_executor(
             None, lambda r=reader: vp.verify_snapshot(
-                r, sample_rate=float(v.get("sample_rate", 0.1)), rng=rng))
+                r, sample_rate=float(v.get("sample_rate", 0.1)), rng=rng,
+                workers=workers))
         report["checked"] += res.checked
         report["snapshots"].append(str(ref))
         if not res.ok:

@@ -501,6 +501,7 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
     async def snapshot_zip(request):
         snap = request.query.get("snapshot", "")
         path = request.query.get("path", "")
+        from ..pxar import chunkcache
         from ..pxar.datastore import parse_snapshot_ref
         from ..pxar.transfer import SplitReader
         from ..pxar.zipdl import zip_subtree
@@ -508,7 +509,9 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
 
         def build():
             ref = parse_snapshot_ref(snap)   # rejects traversal components
-            reader = SplitReader.open_snapshot(server.datastore.datastore, ref)
+            reader = SplitReader.open_snapshot(server.datastore.datastore,
+                                               ref,
+                                               cache=chunkcache.shared_cache())
             sub = path.strip("/")
             total = sum(e.size for e in reader.entries()
                         if e.is_file and (not sub or e.path == sub
@@ -1064,6 +1067,7 @@ echo "  --bootstrap-token <token_id:secret>"
         """Browse a stored snapshot's tree one level at a time (the
         reference UI's snapshot file browser backing; live-agent browse
         is the separate /d2d/filetree)."""
+        from ..pxar import chunkcache
         from ..pxar.datastore import parse_snapshot_ref
         from ..pxar.transfer import SplitReader
         snap = request.query.get("snapshot", "")
@@ -1078,7 +1082,8 @@ echo "  --bootstrap-token <token_id:secret>"
                 hit = _tree_cache.get(snap)
                 if hit is not None and hit[0] == mtime:
                     return hit[1]
-            reader = SplitReader.open_snapshot(ds, ref)
+            reader = SplitReader.open_snapshot(
+                ds, ref, cache=chunkcache.shared_cache())
             bydir: dict[str, list] = {}
             for e in reader.entries():
                 if not e.path:

@@ -63,6 +63,12 @@ class Env:
     # persists in-flight session state every N committed payload chunks
     # and/or M seconds; "" (default) disables checkpointing
     checkpoint_interval: str = ""
+    # read-path chunk cache (pxar/chunkcache.py): byte budget of the
+    # process-shared LRU of decompressed, verified chunks (MiB; 0
+    # disables caching) and how many chunks ahead a detected forward
+    # scan prefetches (0 disables readahead)
+    chunk_cache_mb: int = 256
+    chunk_readahead: int = 4
     extra: dict = field(default_factory=dict)
 
 
@@ -71,6 +77,13 @@ def _float_env(e, name: str, default: str) -> float:
         return float(e.get(name, default))
     except ValueError:
         return float(default)
+
+
+def _int_env(e, name: str, default: str) -> int:
+    try:
+        return int(e.get(name, default))
+    except ValueError:
+        return int(default)
 
 
 @lru_cache(maxsize=1)
@@ -86,6 +99,8 @@ def env() -> Env:
         log_dedup_window_s=_float_env(e, "LOG_DEDUP_WINDOW", "5"),
         sidecar_timeout_s=_float_env(e, "PBS_PLUS_SIDECAR_TIMEOUT", "300"),
         checkpoint_interval=e.get("PBS_PLUS_CHECKPOINT_INTERVAL", ""),
+        chunk_cache_mb=_int_env(e, "PBS_PLUS_CHUNK_CACHE_MB", "256"),
+        chunk_readahead=_int_env(e, "PBS_PLUS_CHUNK_READAHEAD", "4"),
     )
 
 

@@ -16,6 +16,7 @@ release cache).
 from __future__ import annotations
 
 import asyncio
+import threading
 from typing import Any, Awaitable, Callable
 
 
@@ -56,3 +57,59 @@ class SingleFlight:
 
     def in_flight(self, key: Any) -> bool:
         return key in self._flights
+
+
+class _ThreadFlight:
+    __slots__ = ("event", "result", "exc")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.result: Any = None
+        self.exc: BaseException | None = None
+
+
+class ThreadSingleFlight:
+    """Thread-side twin of :class:`SingleFlight`: coalesce concurrent
+    *thread* callers for one key (the chunk-cache read path lives on
+    executor/FUSE/verify-pool threads, not the event loop).  The first
+    caller for a key runs the factory inline; every concurrent caller
+    blocks on the flight and shares its result (or exception).  The key
+    is released once the flight lands — stampede suppression, not a
+    cache."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._flights: dict[Any, _ThreadFlight] = {}
+        self.stats = {"calls": 0, "executions": 0, "shared": 0}
+
+    def do(self, key: Any, factory: Callable[[], Any]) -> Any:
+        with self._lock:
+            self.stats["calls"] += 1
+            fl = self._flights.get(key)
+            if fl is None:
+                fl = _ThreadFlight()
+                self._flights[key] = fl
+                self.stats["executions"] += 1
+                leader = True
+            else:
+                self.stats["shared"] += 1
+                leader = False
+        if not leader:
+            fl.event.wait()
+            if fl.exc is not None:
+                raise fl.exc
+            return fl.result
+        try:
+            fl.result = factory()
+            return fl.result
+        except BaseException as e:
+            fl.exc = e
+            raise
+        finally:
+            with self._lock:
+                self._flights.pop(key, None)
+            fl.event.set()
+
+    def in_flight(self, key: Any) -> bool:
+        with self._lock:
+            return key in self._flights

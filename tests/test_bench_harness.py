@@ -130,6 +130,28 @@ def test_bench_chunk_store_insert(tmp_path):
           f"dup-hit: {count / dt_dup:8.1f} MiB/s")
 
 
+def test_bench_read_path(tmp_path):
+    """Read-path benchmark (bench._read_bench): warm-cache windowed reads
+    must beat the cold single-chunk path and pin the re-decompression
+    ratio at ~1.0 (docs/data-plane.md "Read path")."""
+    import bench
+
+    res = bench._read_bench(mib=32 if FULL else 8)
+    print(f"\n  read cold windowed {res['cold_windowed_mib_s']:8.1f} MiB/s"
+          f" | warm windowed {res['warm_windowed_mib_s']:8.1f} MiB/s"
+          f" ({res['warm_vs_cold_windowed']}x)"
+          f" | redecomp cold {res['cold_redecompress_ratio']}"
+          f" -> cached {res['cached_redecompress_ratio']}")
+    # acceptance gates (ISSUE 5): >=3x warm-vs-cold on the windowed
+    # workload, windowed re-decompression ratio ~1.0 through the cache
+    assert res["warm_vs_cold_windowed"] >= 3.0
+    assert res["cached_redecompress_ratio"] <= 1.5
+    assert res["cold_redecompress_ratio"] > 2.0     # the problem is real
+    # machine context rides every bench JSON (round-5 comparability)
+    ctx = bench._machine_context()
+    assert ctx["cores"] and ctx["python"]
+
+
 def test_bench_commit_walk_refs(tmp_path):
     """Commit-walk with many unchanged files (ref coalescing — the
     B1/B4 'refs sort + coalescing' analog): re-commit of an untouched

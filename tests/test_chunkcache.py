@@ -1,0 +1,505 @@
+"""Read-path chunk cache battery (pxar/chunkcache.py, docs/data-plane.md
+"Read path"): single-flight under concurrent readers, byte-budgeted LRU
+eviction, readahead bounds, verify-once corruption semantics, the
+`pbsstore.chunk.read` failpoint, parallel-vs-sequential verification
+parity, and the ChunkStore dedup-hit fast path."""
+
+import hashlib
+import io
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pbs_plus_tpu.chunker import ChunkerParams
+from pbs_plus_tpu.pxar import chunkcache
+from pbs_plus_tpu.pxar.backupproxy import LocalStore
+from pbs_plus_tpu.pxar.datastore import ChunkStore
+from pbs_plus_tpu.pxar.format import Entry, KIND_DIR, KIND_FILE
+from pbs_plus_tpu.utils import failpoints
+from pbs_plus_tpu.utils.singleflight import ThreadSingleFlight
+
+try:
+    import zstandard
+except ImportError:
+    from pbs_plus_tpu.utils import zstdshim as zstandard
+
+P = ChunkerParams(avg_size=1 << 14)
+
+
+def _blob(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+def _snapshot(tmp_path, *, name="ds", files=1, size=600_000, **store_kw):
+    store = LocalStore(str(tmp_path / name), P, **store_kw)
+    s = store.start_session(backup_type="host", backup_id="c")
+    s.writer.write_entry(Entry(path="", kind=KIND_DIR))
+    blobs = {}
+    for i in range(files):
+        blobs[f"f{i}.bin"] = _blob(size, seed=i)
+        s.writer.write_entry_reader(
+            Entry(path=f"f{i}.bin", kind=KIND_FILE,
+                  size=len(blobs[f"f{i}.bin"])),
+            io.BytesIO(blobs[f"f{i}.bin"]))
+    s.finish()
+    return store, s.ref, blobs
+
+
+class CountingStore:
+    """ChunkStore proxy that counts (and optionally delays) loads."""
+
+    def __init__(self, inner, delay=0.0):
+        self.inner = inner
+        self.delay = delay
+        self.requested: list[bytes] = []
+        self._lock = threading.Lock()
+
+    @property
+    def loads(self):
+        return len(self.requested)
+
+    def get(self, digest):
+        with self._lock:
+            self.requested.append(digest)
+        if self.delay:
+            time.sleep(self.delay)
+        return self.inner.get(digest)
+
+
+# ------------------------------------------------- ThreadSingleFlight
+
+
+def test_thread_singleflight_one_execution():
+    sf = ThreadSingleFlight()
+    runs = []
+    gate = threading.Event()
+    results = []
+
+    def work():
+        runs.append(1)
+        gate.wait(5)
+        return "r"
+
+    ts = [threading.Thread(target=lambda: results.append(
+        sf.do("k", work))) for _ in range(16)]
+    for t in ts:
+        t.start()
+    time.sleep(0.1)            # everyone queued on the flight
+    gate.set()
+    for t in ts:
+        t.join()
+    assert results == ["r"] * 16
+    assert len(runs) == 1
+    assert sf.stats == {"calls": 16, "executions": 1, "shared": 15}
+    # key released: a later call re-executes
+    assert sf.do("k", work) == "r"
+    assert len(runs) == 2
+
+
+def test_thread_singleflight_errors_propagate_to_all_waiters():
+    sf = ThreadSingleFlight()
+    gate = threading.Event()
+    errors = []
+
+    def boom():
+        gate.wait(5)
+        raise ValueError("injected")
+
+    def call():
+        try:
+            sf.do("k", boom)
+        except ValueError as e:
+            errors.append(str(e))
+
+    ts = [threading.Thread(target=call) for _ in range(8)]
+    for t in ts:
+        t.start()
+    time.sleep(0.05)
+    gate.set()
+    for t in ts:
+        t.join()
+    assert errors == ["injected"] * 8
+    assert not sf.in_flight("k")
+
+
+# ------------------------------------------------------- cache basics
+
+
+def test_concurrent_readers_one_disk_read(tmp_path):
+    store, ref, _ = _snapshot(tmp_path)
+    cs = CountingStore(store.datastore.chunks, delay=0.05)
+    cache = chunkcache.ChunkCache(64 << 20)
+    digest = store.open_snapshot(ref, cache=cache).payload_index.digest(0)
+    results = []
+
+    def go():
+        results.append(cache.get(cs, digest))
+
+    ts = [threading.Thread(target=go) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert cs.loads == 1                       # ONE disk read observed
+    assert all(r == results[0] for r in results)
+    snap = cache.snapshot()
+    assert snap["singleflight_shared"] >= 1
+    # a later read is a pure hit — verify-once means no further loads
+    assert cache.get(cs, digest) == results[0]
+    assert cs.loads == 1
+
+
+def test_lru_eviction_respects_byte_budget(tmp_path):
+    cs = ChunkStore(str(tmp_path / "cs"))
+    chunks = {}
+    for i in range(8):
+        data = _blob(10_000, seed=i)
+        d = hashlib.sha256(data).digest()
+        cs.insert(d, data)
+        chunks[d] = data
+    budget = 35_000                            # fits 3 of the 10k chunks
+    cache = chunkcache.ChunkCache(budget)
+    order = list(chunks)
+    for d in order:
+        assert cache.get(cs, d) == chunks[d]
+        assert cache.resident_bytes <= budget
+    snap = cache.snapshot()
+    assert snap["evictions"] == 5
+    assert snap["resident_chunks"] == 3
+    # LRU order: the newest three are resident, the oldest five evicted
+    assert [cache.contains(d) for d in order] == [False] * 5 + [True] * 3
+    # oversized single value is served but never admitted
+    big = _blob(50_000, seed=99)
+    dbig = hashlib.sha256(big).digest()
+    cs.insert(dbig, big)
+    assert cache.get(cs, dbig) == big
+    assert not cache.contains(dbig)
+    assert cache.resident_bytes <= budget
+
+
+def test_budget_zero_disables_admission(tmp_path):
+    store, ref, blobs = _snapshot(tmp_path)
+    cache = chunkcache.ChunkCache(0)
+    r = store.open_snapshot(ref, cache=cache)
+    cs = CountingStore(store.datastore.chunks)
+    r.store = cs
+    e = r.lookup("f0.bin")
+    assert r.read_file(e) == blobs["f0.bin"]
+    assert r.read_file(e) == blobs["f0.bin"]
+    assert cache.resident_bytes == 0
+    # every read went to the source (pass-through)
+    assert cs.loads >= 2 * len(r.payload_index)
+
+
+# --------------------------------------------------------- readahead
+
+
+def test_readahead_prefetches_and_never_reads_past_index(tmp_path):
+    store, ref, blobs = _snapshot(tmp_path)
+    cache = chunkcache.ChunkCache(64 << 20, readahead_chunks=3)
+    r = store.open_snapshot(ref, cache=cache)
+    cs = CountingStore(store.datastore.chunks)
+    r.store = cs
+    e = r.lookup("f0.bin")
+    blob = blobs["f0.bin"]
+    got = b"".join(r.read_file(e, off, 4096)
+                   for off in range(0, len(blob), 4096))
+    assert got == blob
+    cache.drain()
+    snap = cache.snapshot()
+    assert snap["prefetch_issued"] > 0
+    assert snap["prefetch_used"] > 0
+    # every chunk loaded exactly once (prefetch + single-flight dedup IO)
+    assert cs.loads == len(set(cs.requested))
+    # the prefetcher never reached past the index: only digests the
+    # indexes name were ever requested
+    known = {r.payload_index.digest(i) for i in range(len(r.payload_index))}
+    known |= {r.meta_index.digest(i) for i in range(len(r.meta_index))}
+    assert set(cs.requested) <= known
+    # reading the LAST chunk directly schedules nothing out of range
+    last_start, _ = r.payload_index.chunk_bounds(len(r.payload_index) - 1)
+    r.read_payload(last_start, 10)
+    r.read_payload(last_start + 10, 10)        # sequential continuation
+    cache.drain()
+    assert set(cs.requested) <= known
+
+
+def test_random_access_does_not_trigger_readahead(tmp_path):
+    store, ref, _ = _snapshot(tmp_path)
+    cache = chunkcache.ChunkCache(64 << 20, readahead_chunks=4)
+    r = store.open_snapshot(ref, cache=cache)
+    e = r.lookup("f0.bin")
+    n = len(r.payload_index)
+    assert n >= 6
+    # backwards strided reads: never two consecutive windows in order
+    for ci in range(n - 1, -1, -2):
+        start, end = r.payload_index.chunk_bounds(ci)
+        r.read_payload(start, min(128, end - start))
+    cache.drain()
+    assert cache.snapshot()["prefetch_issued"] == 0
+
+
+# ------------------------------------------------------- verify-once
+
+
+def _corrupt_chunk_on_disk(store, digest):
+    """Replace the chunk file with a VALID zstd frame of different
+    content — decode succeeds, the digest check must fail."""
+    p = store.datastore.chunks._path(digest)
+    with open(p, "wb") as f:
+        f.write(zstandard.ZstdCompressor().compress(b"not the chunk"))
+
+
+def test_corrupt_chunk_raises_on_load_and_is_never_admitted(tmp_path):
+    store, ref, _ = _snapshot(tmp_path)
+    cache = chunkcache.ChunkCache(64 << 20)
+    r = store.open_snapshot(ref, cache=cache)
+    bad = r.payload_index.digest(0)
+    good = r.payload_index.digest(1)
+    _corrupt_chunk_on_disk(store, bad)
+    with pytest.raises(IOError):
+        r.fetch_chunk(bad)
+    assert not cache.contains(bad)             # never admitted
+    assert cache.snapshot()["load_errors"] == 1
+    # a second read re-reads the disk and re-detects (no stale state)
+    with pytest.raises(IOError):
+        r.fetch_chunk(bad)
+    # healthy digests are unaffected: miss then hit
+    data = r.fetch_chunk(good)
+    assert r.fetch_chunk(good) == data
+    assert cache.snapshot()["hits"] >= 1
+
+
+def test_chunk_read_failpoint_chaos(tmp_path):
+    """docs/fault-injection.md `pbsstore.chunk.read`: a corrupt-on-disk
+    chunk (injected bitflip in the raw frame) raises on load, is never
+    admitted, and a retried read of a healthy digest still hits."""
+    store, ref, _ = _snapshot(tmp_path)
+    cache = chunkcache.ChunkCache(64 << 20)
+    r = store.open_snapshot(ref, cache=cache)
+    d0, d1 = r.payload_index.digest(0), r.payload_index.digest(1)
+    warm = r.fetch_chunk(d1)                   # healthy digest, cached
+    with failpoints.armed("pbsstore.chunk.read", "corrupt"):
+        with pytest.raises(Exception):         # zstd error or digest IOError
+            r.fetch_chunk(d0)
+        assert not cache.contains(d0)
+        # the healthy digest still HITS — verified residents are trusted
+        assert r.fetch_chunk(d1) == warm
+    # disarm → the same digest loads cleanly and is admitted
+    data = r.fetch_chunk(d0)
+    assert hashlib.sha256(data).digest() == d0
+    assert cache.contains(d0)
+    with failpoints.armed("pbsstore.chunk.read", "raise"):
+        # transient EIO on a cold digest: fails, nothing admitted
+        d2 = r.payload_index.digest(2)
+        with pytest.raises(failpoints.FailpointError):
+            r.fetch_chunk(d2)
+        assert not cache.contains(d2)
+        # resident digests keep serving through the outage
+        assert r.fetch_chunk(d0) == data
+
+
+# ------------------------------------------- windowed read / pump
+
+
+def test_windowed_read_decompresses_each_chunk_once(tmp_path):
+    store, ref, blobs = _snapshot(tmp_path)
+    cache = chunkcache.ChunkCache(64 << 20, readahead_chunks=0)
+    r = store.open_snapshot(ref, cache=cache)
+    cs = CountingStore(store.datastore.chunks)
+    r.store = cs
+    e = r.lookup("f0.bin")
+    blob = blobs["f0.bin"]
+    got = b"".join(r.read_file(e, off, 2048)
+                   for off in range(0, len(blob), 2048))
+    assert got == blob
+    # re-decompression ratio == 1.0: one load per distinct chunk even
+    # though each chunk overlapped ~8 windows
+    assert cs.loads == len(set(cs.requested))
+
+
+def test_file_reader_pump_matches_read_file(tmp_path):
+    store, ref, blobs = _snapshot(tmp_path)
+    r = store.open_snapshot(ref, cache=chunkcache.ChunkCache(64 << 20))
+    e = r.lookup("f0.bin")
+    blob = blobs["f0.bin"]
+    rdr, size = r.file_reader(e)
+    assert size == len(blob)
+    out = bytearray()
+    while True:
+        block = rdr.read(7_000)
+        if not block:
+            break
+        out += block
+    assert bytes(out) == blob
+    # ranged + clamped
+    rdr, size = r.file_reader(e, len(blob) - 100, 1_000_000)
+    assert size == 100
+    assert rdr.read(-1) == blob[-100:]
+    # empty file: zero-size reader
+    s = store.start_session(backup_type="host", backup_id="e")
+    s.writer.write_entry(Entry(path="", kind=KIND_DIR))
+    s.writer.write_entry(Entry(path="z", kind=KIND_FILE))
+    s.finish()
+    r2 = store.open_snapshot(s.ref, cache=chunkcache.ChunkCache(1 << 20))
+    rdr, size = r2.file_reader(r2.lookup("z"))
+    assert size == 0 and rdr.read(-1) == b""
+
+
+def test_zip_streaming_matches_content(tmp_path):
+    import zipfile
+
+    from pbs_plus_tpu.pxar.zipdl import zip_subtree
+    store, ref, blobs = _snapshot(tmp_path, files=3, size=50_000)
+    r = store.open_snapshot(ref, cache=chunkcache.ChunkCache(64 << 20))
+    buf = zip_subtree(r)
+    zf = zipfile.ZipFile(buf)
+    for name, want in blobs.items():
+        assert zf.read(name) == want
+
+
+def test_remote_read_at_chunk_aligned_pump(tmp_path):
+    """RemoteArchiveServer.read_at streams the clamped range through the
+    cache-backed pump — correct bytes, correct `n`, windows hit the
+    cache instead of re-decompressing."""
+    import asyncio
+
+    from pbs_plus_tpu.pxar.remote import RemoteArchiveServer
+
+    store, ref, blobs = _snapshot(tmp_path)
+    blob = blobs["f0.bin"]
+    cache = chunkcache.ChunkCache(64 << 20)
+    reader = store.open_snapshot(ref, cache=cache)
+    srv = RemoteArchiveServer(reader)
+
+    class FakeStream:
+        def __init__(self):
+            self.parts = []
+
+        async def write(self, data):
+            self.parts.append(bytes(data))
+
+    class Req:
+        def __init__(self, payload):
+            self.payload = payload
+
+    async def read_at(off, n):
+        h = await srv._read_at(Req({"path": "f0.bin", "off": off,
+                                    "n": n}), None)
+        st = FakeStream()
+        await h.fn(st)
+        body = b"".join(st.parts)
+        # strip the binary-stream header frame (first write)
+        body = body[len(st.parts[0]):]
+        return h.data["n"], body
+
+    async def main():
+        n, body = await read_at(0, len(blob))
+        assert n == len(blob) and body == blob
+        # windowed pulls, clamped tail
+        n, body = await read_at(len(blob) - 1000, 4096)
+        assert n == 1000 and body == blob[-1000:]
+        n, body = await read_at(12_345, 4096)
+        assert n == 4096 and body == blob[12_345:12_345 + 4096]
+
+    asyncio.run(main())
+    hits, misses = reader.cache_stats
+    assert hits > 0                        # the windows shared chunks
+
+
+# --------------------------------------- parallel verification parity
+
+
+def test_parallel_verification_bit_identical_to_sequential(tmp_path):
+    from pbs_plus_tpu.models.verify import VerifyPipeline
+    store, ref, _ = _snapshot(tmp_path, name="dsv", files=4, size=200_000,
+                              pbs_format=True)   # pxar2 → chunk-level verify
+    r0 = store.open_snapshot(ref, cache=chunkcache.ChunkCache(64 << 20))
+    bad = r0.payload_index.digest(2)
+    from pbs_plus_tpu.pxar.pbsformat import blob_encode
+    p = store.datastore.chunks._path(bad)
+    with open(p, "wb") as f:
+        f.write(blob_encode(b"tampered"))      # valid DataBlob, wrong bytes
+    vp = VerifyPipeline()
+    # fresh private caches per run: both must detect on first load
+    rs = store.open_snapshot(ref, cache=chunkcache.ChunkCache(64 << 20))
+    seq = vp.verify_snapshot(rs, sample_rate=1.0)
+    rp = store.open_snapshot(ref, cache=chunkcache.ChunkCache(64 << 20))
+    par = vp.verify_snapshot(rp, sample_rate=1.0, workers=4)
+    assert not seq.ok
+    assert seq.checked == par.checked
+    assert seq.corrupt == par.corrupt                  # bit-identical
+    assert seq.corrupt_paths == par.corrupt_paths
+    assert f"chunk:{bad.hex()}" in seq.corrupt_paths
+
+
+def test_parallel_verification_healthy_snapshot(tmp_path):
+    from pbs_plus_tpu.models.verify import VerifyPipeline
+    store, ref, _ = _snapshot(tmp_path, files=3, size=100_000)
+    vp = VerifyPipeline()
+    rs = store.open_snapshot(ref, cache=chunkcache.ChunkCache(64 << 20))
+    seq = vp.verify_snapshot(rs, sample_rate=1.0)
+    rp = store.open_snapshot(ref, cache=chunkcache.ChunkCache(64 << 20))
+    par = vp.verify_snapshot(rp, sample_rate=1.0, workers=4)
+    assert seq.ok and par.ok
+    assert (seq.checked, seq.corrupt) == (par.checked, par.corrupt)
+
+
+# --------------------------------------------- ChunkStore fast paths
+
+
+def test_insert_dedup_hit_skips_datablob_reprobe(tmp_path, monkeypatch):
+    cs = ChunkStore(str(tmp_path / "cs"), blob_format="pbs")
+    data = _blob(20_000, seed=3)
+    d = hashlib.sha256(data).digest()
+    probes = []
+    orig = ChunkStore._upgrade_to_datablob
+
+    def counting(self, p):
+        probes.append(p)
+        return orig(self, p)
+
+    monkeypatch.setattr(ChunkStore, "_upgrade_to_datablob", counting)
+    assert cs.insert(d, data) is True
+    assert probes == []                    # new write: no probe at all
+    assert cs.insert(d, data) is False     # dedup hit
+    assert cs.insert(d, data) is False
+    # writer-confirmed DataBlob: the upgrade probe never ran
+    assert probes == []
+    # a FRESH store (new process) probes exactly once, then remembers
+    cs2 = ChunkStore(str(tmp_path / "cs"), blob_format="pbs")
+    assert cs2.insert(d, data) is False
+    assert len(probes) == 1
+    assert cs2.insert(d, data) is False
+    assert len(probes) == 1
+    assert cs2.get(d) == data
+
+
+def test_insert_dedup_hit_single_utime_touches_mtime(tmp_path):
+    cs = ChunkStore(str(tmp_path / "cs"))
+    data = _blob(10_000, seed=4)
+    d = hashlib.sha256(data).digest()
+    assert cs.insert(d, data) is True
+    p = cs._path(d)
+    os.utime(p, (1, 1))                    # age it far into the past
+    assert cs.insert(d, data) is False     # dedup hit
+    assert os.stat(p).st_mtime > 1         # the GC-mark touch happened
+
+
+# ----------------------------------------------------- shared cache
+
+
+def test_configure_shared_resizes_in_place():
+    cache = chunkcache.shared_cache()
+    old = cache.max_bytes
+    try:
+        assert chunkcache.configure_shared(max_bytes=1 << 20) is cache
+        assert cache.max_bytes == 1 << 20
+        snap = chunkcache.metrics_snapshot()
+        assert snap["budget_bytes"] == 1 << 20
+    finally:
+        chunkcache.configure_shared(max_bytes=old)

@@ -36,8 +36,47 @@ def test_registry_has_expected_rules():
         "locked-store-discipline", "jit-purity",
         "no-hostsync-in-hot-loop", "subprocess-timeout",
         "thread-hygiene", "resource-ctx", "mutable-default",
-        "failpoint-discipline",
+        "failpoint-discipline", "cache-discipline",
     }
+
+
+# ---------------------------------------------------- cache-discipline
+
+
+def test_cache_discipline_flags_direct_store_get_in_read_path():
+    v = run_lint("""
+        def serve(reader, digest):
+            return reader.store.get(digest)
+    """, path="pbs_plus_tpu/pxar/remote.py", rules=["cache-discipline"])
+    assert names(v) == ["cache-discipline"]
+    assert "chunk cache" in v[0].message
+
+
+def test_cache_discipline_flags_chunks_get():
+    v = run_lint("""
+        def scan(ds, digest):
+            return ds.chunks.get(digest)
+    """, path="pbs_plus_tpu/server/verification_job.py",
+        rules=["cache-discipline"])
+    assert names(v) == ["cache-discipline"]
+
+
+def test_cache_discipline_cache_path_and_dict_get_clean():
+    v = run_lint("""
+        def serve(reader, payload, digest):
+            path = payload.get("path")       # dict .get: not a store
+            return reader.fetch_chunk(digest), path
+    """, path="pbs_plus_tpu/pxar/zipdl.py", rules=["cache-discipline"])
+    assert v == []
+
+
+def test_cache_discipline_scoped_to_read_path_modules():
+    # the cache module itself (and writers) legitimately hit the source
+    v = run_lint("""
+        def load(store, digest):
+            return store.get(digest)
+    """, path="pbs_plus_tpu/pxar/chunkcache.py", rules=["cache-discipline"])
+    assert v == []
 
 
 # ------------------------------------------------- failpoint-discipline

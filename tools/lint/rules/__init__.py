@@ -18,6 +18,7 @@ from .threads import ThreadHygiene
 from .resources import ResourceCtx
 from .mutable_defaults import MutableDefault
 from .failpoint_discipline import FailpointDiscipline
+from .cache_discipline import CacheDiscipline
 
 RULE_CLASSES = [
     NoSilentSwallow,
@@ -30,6 +31,7 @@ RULE_CLASSES = [
     ResourceCtx,
     MutableDefault,
     FailpointDiscipline,
+    CacheDiscipline,
 ]
 
 

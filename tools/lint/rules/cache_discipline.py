@@ -1,0 +1,62 @@
+"""cache-discipline — read-path modules go through the chunk cache.
+
+Invariant (pxar/chunkcache.py, docs/data-plane.md "Read path"): the
+read-side consumers — remote archive serving, restore and verification
+jobs, zip download, the FUSE archive view — never call a chunk source's
+``.get`` directly (``ChunkStore.get`` / ``PBSReaderSource.get``, i.e.
+``<reader>.store.get(...)`` or ``<datastore>.chunks.get(...)``).  A
+direct call pays open+read+decompress+SHA-256 on every access, bypasses
+single-flight (concurrent readers of one digest each hit the disk) and
+readahead, and skips the cache's verify-once admission discipline.  Go
+through ``SplitReader.fetch_chunk`` / ``ChunkCache.get`` instead —
+``pxar/chunkcache.py`` is the only sanctioned caller on the read path.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+
+# the read-path consumers this invariant covers (repo-relative)
+READ_PATH_FILES = frozenset({
+    "pbs_plus_tpu/pxar/remote.py",
+    "pbs_plus_tpu/server/restore_job.py",
+    "pbs_plus_tpu/server/verification_job.py",
+    "pbs_plus_tpu/pxar/zipdl.py",
+    "pbs_plus_tpu/mount/pxarfs.py",
+})
+
+# receiver names that denote a chunk source: `store.get(...)`,
+# `chunks.get(...)`, `reader.store.get(...)`, `ds.chunks.get(...)`
+_SOURCE_NAMES = ("store", "chunks")
+
+
+class CacheDiscipline(Rule):
+    name = "cache-discipline"
+    invariant = ("read-path modules fetch chunks through the chunk cache "
+                 "(SplitReader.fetch_chunk / ChunkCache.get), never "
+                 "ChunkStore.get directly")
+
+    def begin_file(self, ctx):
+        return ctx.path in READ_PATH_FILES
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        func = node.func
+        if not isinstance(func, ast.Attribute) or func.attr != "get":
+            return
+        recv = func.value
+        if isinstance(recv, ast.Name):
+            name = recv.id
+        elif isinstance(recv, ast.Attribute):
+            name = recv.attr
+        else:
+            return
+        if name.lstrip("_") not in _SOURCE_NAMES:
+            return
+        ctx.report(self, node,
+                   f"direct chunk-source read `{name}.get(...)` on the "
+                   "read path bypasses the shared chunk cache (no "
+                   "single-flight, no readahead, re-decompress + re-hash "
+                   "per call) — go through SplitReader.fetch_chunk / "
+                   "ChunkCache.get (pxar/chunkcache.py)")
