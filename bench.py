@@ -107,6 +107,32 @@ def _cpu_baseline(mib: int = 256) -> dict:
         raise AssertionError("mt scan diverged from single-core scan")
     out["scan_st_mib_s"] = mib / dt_st
     out["scan_mt_mib_s"] = mib / dt_mt
+    # vectorized backend (chunker/vector.py, ISSUE 6): same corpus, same
+    # in-run parity discipline as the MT check — the ends array must be
+    # bit-identical to the scalar scan's before the number is reported
+    from pbs_plus_tpu.chunker import vector
+    t0 = time.perf_counter()
+    ends_vec = vector.candidates(data, params)
+    dt_vec = time.perf_counter() - t0
+    if not np.array_equal(ends, ends_vec):
+        raise AssertionError("vectorized scan diverged from scalar scan")
+    out["scan_vec_mib_s"] = mib / dt_vec
+    out["scan_vec_impl"] = vector.scan_impl_name()
+    out["scan_vec_vs_st"] = round(out["scan_vec_mib_s"]
+                                  / out["scan_st_mib_s"], 2)
+    # batched entry (vmap-across-sessions shape): 8 concurrent streams
+    # through one candidates_batch dispatch, row 0 parity-checked
+    rows = 8
+    rsz = (mib << 20) // rows
+    bufs = [data[i * rsz:(i + 1) * rsz] for i in range(rows)]
+    t0 = time.perf_counter()
+    batch_ends = vector.candidates_batch(bufs, params)
+    dt_b = time.perf_counter() - t0
+    if not np.array_equal(batch_ends[0],
+                          candidates(bufs[0], params, threads=1)):
+        raise AssertionError("batched vector scan diverged on row 0")
+    out["scan_vec_batch_mib_s"] = mib / dt_b
+    out["scan_vec_batch_rows"] = rows
     import os as _os
     out["cores"] = _os.cpu_count()
     return out

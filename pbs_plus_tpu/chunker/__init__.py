@@ -6,10 +6,13 @@ sub-package, consumed as ``buzhash.NewConfig(4<<20)`` (4 MiB target) at
 /root/reference/internal/tapeio/converter.go:248.
 
 The chunker is pluggable from day one (SURVEY §7 step 1): the ``Chunker``
-interface has a CPU backend (numpy-vectorized + optional C++ native) and a
-TPU backend (``pbs_plus_tpu.ops``), selected by ``conf.Env.chunker``.
-Cut-point bit-parity between backends is a correctness gate (BASELINE.md
-config #2).
+interface has a scalar CPU backend (numpy reference + optional C++
+native), a vectorized CPU backend (``chunker.vector`` — the SIMD-style
+doubling scan, selected via ``PBS_PLUS_CHUNKER_BACKEND=vector`` or
+``chunker="vector"``), and a TPU backend (``pbs_plus_tpu.ops``), selected
+by ``conf.Env.chunker``.  Cut-point bit-parity between backends is a
+correctness gate (BASELINE.md config #2; docs/data-plane.md "Chunking
+backends").
 """
 
 from .spec import (
@@ -20,8 +23,10 @@ from .spec import (
     select_cuts,
 )
 from .cpu import CpuChunker, chunk_bounds, candidates
+from .vector import ResilientVectorFactory, VectorChunker
 
 __all__ = [
     "ChunkerParams", "DEFAULT_PARAMS", "TEST_PARAMS", "buzhash_table",
     "select_cuts", "CpuChunker", "chunk_bounds", "candidates",
+    "VectorChunker", "ResilientVectorFactory",
 ]

@@ -14,6 +14,7 @@ from collections import deque
 
 import numpy as np
 
+from . import observe
 from .spec import WINDOW, ChunkerParams, select_cuts
 
 
@@ -75,6 +76,7 @@ def candidates(data: bytes | np.ndarray, params: ChunkerParams, *,
     if plen >= WINDOW:
         prefix = prefix[-(WINDOW - 1):]
         plen = WINDOW - 1
+    observe.add_scan_bytes("numpy", len(data))
     h = position_hashes(data, params, prefix)
     hit = (h & np.uint32(params.mask)) == np.uint32(params.magic)
     # window of position i (local, within data) spans [i - 63 .. i] in the
@@ -101,36 +103,85 @@ def chunk_bounds(data: bytes, params: ChunkerParams) -> list[tuple[int, int]]:
     return out
 
 
+# Coalescing floor for streaming feeds: sub-block feeds accumulate in a
+# pending buffer and scan as ONE batch once this many bytes are buffered
+# (clamped to params.max_size so small-parameter configs still cut with
+# their old cadence).  Without it, every tiny feed() paid a full scan
+# dispatch PLUS a W-1-byte prefix re-hash it then discarded — a 1-byte
+# feed pattern cost ~64x the one-shot scan (the satellite fix of ISSUE 6;
+# tests/test_bench_harness.py::test_bench_streaming_feed_matches_oneshot
+# pins both the scan-call count and the wall-clock ratio).
+_FEED_COALESCE = 1 << 18
+
+
 class CpuChunker:
     """Streaming chunker: ``feed()`` returns finalized absolute cut offsets,
     ``finalize()`` flushes the tail chunk.  Mirrors the reference's streaming
-    buzhash consumption inside RemoteDedupWriter (SURVEY §3.4)."""
+    buzhash consumption inside RemoteDedupWriter (SURVEY §3.4).
+
+    Also the streaming shell shared by the CPU scan backends: subclasses
+    (chunker/vector.py ``VectorChunker``) override ``_scan`` only, so the
+    W-1 tail carry, the feed coalescing, and the shared greedy pass
+    (``spec.select_cuts``) are structural — cut-point parity between
+    them reduces to candidate-set parity.  (The tpu/sidecar chunkers
+    carry their own streaming state and do not coalesce.)"""
+
+    backend_name = "cpu"
 
     def __init__(self, params: ChunkerParams):
         self.params = params
-        self._tail = b""            # last W-1 bytes of stream seen so far
-        self._seen = 0              # total bytes fed
+        self._tail = b""            # last W-1 bytes of the scanned stream
+        self._pending = bytearray()  # fed but not yet scanned
+        self._scanned = 0           # stream offset of the scan frontier
         self._chunk_start = 0
         self._cand: deque[int] = deque()
         self._finalized = False
+        self._scan_block = min(_FEED_COALESCE, params.max_size)
+
+    def _scan(self, data, prefix, global_offset: int) -> np.ndarray:
+        """Candidate ends for one frontier extension (backend hook)."""
+        return candidates(data, self.params, prefix=prefix,
+                          global_offset=global_offset)
+
+    def _ingest(self, data) -> None:
+        """Scan ``data`` as the next frontier extension and carry the
+        W-1 tail forward."""
+        ends = self._scan(data, self._tail, self._scanned)
+        self._cand.extend(ends.tolist())
+        self._scanned += len(data)
+        joined = self._tail + (bytes(data) if len(data) < WINDOW
+                               else bytes(data[-(WINDOW - 1):]))
+        self._tail = joined[-(WINDOW - 1):]
+
+    def _flush_pending(self) -> None:
+        if self._pending:
+            data = bytes(self._pending)
+            self._pending.clear()
+            self._ingest(data)
 
     def feed(self, data: bytes) -> list[int]:
         if self._finalized:
             raise RuntimeError("chunker already finalized")
         if not data:
             return []
-        ends = candidates(data, self.params, prefix=self._tail,
-                          global_offset=self._seen)
-        self._cand.extend(ends.tolist())
-        self._seen += len(data)
-        joined = self._tail + (data if len(data) < WINDOW else data[-(WINDOW - 1):])
-        self._tail = joined[-(WINDOW - 1):]
+        if len(data) >= self._scan_block:
+            # big feeds (the data plane's 4-8 MiB blocks) scan directly —
+            # zero-copy: any small pending remainder scans first as its
+            # own frontier extension (split points never move cuts)
+            self._flush_pending()
+            self._ingest(data)
+            return self._drain(final=False)
+        self._pending += data
+        if len(self._pending) < self._scan_block:
+            return []
+        self._flush_pending()
         return self._drain(final=False)
 
     def finalize(self) -> list[int]:
         if self._finalized:
             return []
         self._finalized = True
+        self._flush_pending()
         return self._drain(final=True)
 
     def _drain(self, final: bool) -> list[int]:
@@ -138,7 +189,7 @@ class CpuChunker:
         # streaming and batch paths cannot fork the chunk format
         cuts = select_cuts(
             np.fromiter(self._cand, dtype=np.int64, count=len(self._cand)),
-            self._seen, self.params, start=self._chunk_start, final=final,
+            self._scanned, self.params, start=self._chunk_start, final=final,
         )
         if cuts:
             self._chunk_start = cuts[-1]

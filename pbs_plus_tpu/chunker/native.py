@@ -14,6 +14,7 @@ import threading
 
 import numpy as np
 
+from . import observe
 from .spec import ChunkerParams
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -81,12 +82,40 @@ def _load() -> ctypes.CDLL | None:
         if mt is not None:
             mt.restype = ctypes.c_int64
             mt.argtypes = fn.argtypes + [ctypes.c_int]
+        try:                                   # stale pre-vec .so
+            vec = lib.pbs_buzhash_candidates_vec
+            impl = lib.pbs_buzhash_vec_impl
+        except AttributeError:
+            vec = impl = None
+        if vec is not None:
+            vec.restype = ctypes.c_int64
+            vec.argtypes = fn.argtypes
+            impl.restype = ctypes.c_int
+            impl.argtypes = []
         _lib = lib
         return _lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+def vec_available() -> bool:
+    """True when the SIMD-style vectorized scan entry is present (the
+    library was built from a source that ships it)."""
+    lib = _load()
+    return lib is not None and \
+        getattr(lib, "pbs_buzhash_candidates_vec", None) is not None
+
+
+def vec_impl() -> int:
+    """0 = unavailable, 1 = generic auto-vectorized blocks, 2 = AVX-512
+    (vpermd nibble lookup + vprold fused passes)."""
+    if not vec_available():
+        return 0
+    lib = _load()
+    assert lib is not None
+    return int(lib.pbs_buzhash_vec_impl())
 
 
 # buffers below this size aren't worth thread spawn overhead
@@ -111,20 +140,48 @@ def candidates(data: bytes | np.ndarray, params: ChunkerParams, *,
     mt = getattr(lib, "pbs_buzhash_candidates_mt", None)
     if threads is None:
         threads = 0 if (mt is not None and len(arr) >= _MT_THRESHOLD) else 1
+    observe.add_scan_bytes("native", len(arr))
+    if threads != 1 and mt is not None:
+        def call(*args):
+            return mt(*args, ctypes.c_int(threads))
+    else:
+        call = lib.pbs_buzhash_candidates
+    return _scan_retry(call, arr, pfx, table, params, global_offset)
+
+
+def _scan_retry(call, arr: np.ndarray, pfx: np.ndarray, table: np.ndarray,
+                params: ChunkerParams, global_offset: int) -> np.ndarray:
+    """Shared marshalling + grow-retry loop for every native scan entry
+    (they all use the pbs_buzhash_candidates signature and the same
+    -1-on-overflow contract)."""
     # expected candidate density ~ n/avg; size output with 8x headroom + slack
     cap = max(1024, 8 * (len(arr) // params.avg_size + 1) + 64)
     while True:
         out = np.empty(cap, dtype=np.int64)
-        args = [arr.ctypes.data, len(arr),
-                pfx.ctypes.data if len(pfx) else None, len(pfx),
-                table.ctypes.data,
-                ctypes.c_uint32(params.mask), ctypes.c_uint32(params.magic),
-                global_offset,
-                out.ctypes.data, cap]
-        if threads != 1 and mt is not None:
-            n = mt(*args, ctypes.c_int(threads))
-        else:
-            n = lib.pbs_buzhash_candidates(*args)
+        n = call(arr.ctypes.data, len(arr),
+                 pfx.ctypes.data if len(pfx) else None, len(pfx),
+                 table.ctypes.data,
+                 ctypes.c_uint32(params.mask), ctypes.c_uint32(params.magic),
+                 global_offset,
+                 out.ctypes.data, cap)
         if n >= 0:
             return out[:n].copy()
         cap *= 4
+
+
+def candidates_vec(data: bytes | np.ndarray, params: ChunkerParams, *,
+                   prefix: bytes = b"",
+                   global_offset: int = 0) -> np.ndarray:
+    """SIMD-style vectorized scan (the ops/rolling_hash.py doubling
+    formulation on CPU vectors) — bit-identical to ``candidates``.
+    Raises RuntimeError when the vec entry is unavailable (stale .so or
+    no toolchain); chunker/vector.py falls back to its numpy kernel."""
+    lib = _load()
+    if lib is None or getattr(lib, "pbs_buzhash_candidates_vec", None) is None:
+        raise RuntimeError("native vectorized chunker unavailable")
+    arr = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else np.ascontiguousarray(data, dtype=np.uint8)
+    pfx = np.frombuffer(prefix, dtype=np.uint8)
+    table = np.ascontiguousarray(params.table, dtype=np.uint32)
+    observe.add_scan_bytes("vector", len(arr))
+    return _scan_retry(lib.pbs_buzhash_candidates_vec, arr, pfx, table,
+                       params, global_offset)

@@ -1,0 +1,54 @@
+"""Chunker-backend observability: which scan implementation touched how
+many bytes (rendered as ``pbs_plus_chunker_scan_bytes_total{backend=...}``
+by server/metrics.py), plus backend-degradation counters.
+
+Backend labels (docs/data-plane.md "Chunking backends"):
+
+- ``numpy``        scalar backend, numpy reference scan (chunker/cpu.py)
+- ``native``       scalar backend, C++ rolling scan (chunker/native.py)
+- ``vector``       vector backend, SIMD native scan (chunker/vector.py)
+- ``vector-numpy`` vector backend, blocked-numpy fallback scan
+- ``tpu``          device candidate kernel (ops/rolling_hash.py)
+- ``sidecar``      bytes shipped to a dedup sidecar's chunker
+
+Counting happens at the scan dispatch points themselves (not in the
+streaming wrappers), so every data-plane path — streaming chunkers,
+one-shot scans, batched cross-stream dispatches — lands in the same
+counters.  Prefix/halo bytes are not counted: the figures are payload
+bytes scanned, comparable across backends.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_scan_bytes: dict[str, int] = {}
+_events: dict[str, int] = {}
+
+
+def add_scan_bytes(backend: str, n: int) -> None:
+    """Record ``n`` payload bytes scanned by ``backend``."""
+    if n <= 0:
+        return
+    with _lock:
+        _scan_bytes[backend] = _scan_bytes.get(backend, 0) + int(n)
+
+
+def add_event(name: str, n: int = 1) -> None:
+    """Bump a named counter (e.g. ``vector_fallbacks``)."""
+    with _lock:
+        _events[name] = _events.get(name, 0) + int(n)
+
+
+def snapshot() -> dict:
+    """{"scan_bytes": {backend: bytes}, "events": {name: count}}."""
+    with _lock:
+        return {"scan_bytes": dict(_scan_bytes), "events": dict(_events)}
+
+
+def reset() -> None:
+    """Test support: zero every counter."""
+    with _lock:
+        _scan_bytes.clear()
+        _events.clear()

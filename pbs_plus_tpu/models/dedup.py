@@ -175,6 +175,9 @@ class TpuChunker:
     # device-dispatch counter across all instances: integration tests
     # assert the TPU path actually ran when chunker="tpu" is configured
     device_dispatches = 0
+    # per-session bound-backend label (transfer._ChunkedStream picks it
+    # up at bind time; rendered in job stats and /metrics)
+    backend_name = "tpu"
 
     def __init__(self, params: ChunkerParams):
         self.params = params

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..chunker import observe
 from ..chunker.spec import WINDOW, ChunkerParams, buzhash_subtables
 from ..chunker.spec import select_cuts
 
@@ -122,6 +123,10 @@ def batched_candidate_hits(bufs: list, hists: list, tables: jax.Array,
     diverge (the bit-parity guarantee hangs on this one implementation).
     """
     B = len(bufs)
+    # backend observability: every batched device scan lands here (the
+    # feeder AND the whole-stream pipeline), so this is the one "tpu"
+    # scan-bytes accounting point (chunker/observe.py)
+    observe.add_scan_bytes("tpu", sum(len(b) for b in bufs))
     S_max = max(len(b) for b in bufs)
     S_pad = max(1 << 14, 1 << int(S_max - 1).bit_length()) if S_max \
         else 1 << 14

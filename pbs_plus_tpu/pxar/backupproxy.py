@@ -115,13 +115,20 @@ class BackupSession:
                 self.ref = dataclasses.replace(
                     self.ref, backup_time=format_backup_time(t))
                 self._final_dir = ds.snapshot_dir(self.ref)
+            # per-session bound-backend label (pinned at stream open by
+            # _ChunkedStream; the payload stream is the one every file
+            # byte flows through)
+            extra = dict(extra_manifest or {})
+            extra.setdefault("chunker_backend",
+                             getattr(self.writer.payload, "bound_backend",
+                                     ""))
             manifest = write_manifest(
                 os.path.join(self._tmp_dir, ds.MANIFEST),
                 ref=self.ref, midx=midx, pidx=pidx, stats=stats,
                 payload_params=self.store.params,
                 entry_count=self.writer.entry_count,
                 previous=str(self.previous_ref) if self.previous_ref else None,
-                extra=extra_manifest,
+                extra=extra,
             )
             if ds.pbs_format:
                 self._write_pbs_manifest(ds, midx, pidx)

@@ -628,6 +628,9 @@ class PBSBackupSession:
                 "bytes_reencoded": stats.bytes_reencoded,
             },
             "created_unix": int(time.time()),
+            # backend pinned at stream open (transfer._ChunkedStream)
+            "chunker_backend": getattr(self.writer.payload,
+                                       "bound_backend", ""),
         }
         if extra:
             manifest.update(extra)

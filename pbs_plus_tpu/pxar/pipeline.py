@@ -34,6 +34,12 @@ ever held for one insert/touch.
 
 Backpressure: at most ``max_inflight`` chunks (default 2*workers+2) are
 in flight, bounding peak extra memory by max_inflight * params.max_size.
+
+Chunker backends: the scan stage inherits ``_ChunkedStream``'s
+``bind_stream`` seam untouched, so a pipelined session picks up the
+vectorized scan (chunker/vector.py) — or the sidecar, or the scalar
+fallback — exactly like the sequential writer, pinned once at stream
+open; ``bound_backend`` rides along for job stats.
 """
 
 from __future__ import annotations

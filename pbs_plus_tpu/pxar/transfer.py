@@ -164,6 +164,11 @@ class _ChunkedStream:
             chunker_factory = bind(params)
         self._factory = chunker_factory
         self._chunker = chunker_factory(params)
+        # the backend pinned for this stream's life (observability: job
+        # stats + manifest carry it so an operator can see which scans
+        # ran vectorized vs scalar vs sidecar vs tpu)
+        self.bound_backend = getattr(self._chunker, "backend_name",
+                                     type(self._chunker).__name__.lower())
         self._buf = _ChunkBuffer()
         self._buf_base = 0          # stream offset of _buf[0]
         self._run_base = 0          # stream offset where current chunker run began

@@ -85,10 +85,12 @@ def match_exclusion(rel: str, patterns: list[str]) -> bool:
 
 def validate_chunker_kind(kind: str) -> None:
     """Cheap syntactic validation (no clients constructed — web CRUD path)."""
-    if kind in ("", "cpu", "tpu") or kind.startswith("sidecar:"):
+    if kind in ("", "cpu", "scalar", "vector", "tpu") \
+            or kind.startswith("sidecar:"):
         return
     raise ValueError(f"unknown chunker backend {kind!r} "
-                     "(want cpu | tpu | sidecar:<host:port>)")
+                     "(want cpu | scalar | vector | tpu | "
+                     "sidecar:<host:port>)")
 
 
 def validate_pipeline_workers(n) -> int:
@@ -120,9 +122,33 @@ def make_batch_hasher(kind: str):
     return None
 
 
-def make_chunker_factory(kind: str):
+def resolve_cpu_scan_backend(cpu_backend: str | None = None) -> str:
+    """CPU scan implementation for cpu-kind chunkers: explicit
+    ``cpu_backend`` (ServerConfig.chunker_backend) wins, empty falls
+    back to ``PBS_PLUS_CHUNKER_BACKEND`` (conf.Env.chunker_backend),
+    default scalar.  Unknown values degrade to scalar with a warning —
+    a typo'd env var must not take the fleet down."""
+    from ..utils import conf
+    backend = cpu_backend or conf.env().chunker_backend or "scalar"
+    if backend in ("scalar", "cpu"):
+        return "scalar"
+    if backend == "vector":
+        return "vector"
+    L.warning("unknown chunker backend %r (want scalar | vector); "
+              "using the scalar scan", backend)
+    return "scalar"
+
+
+def make_chunker_factory(kind: str, *, cpu_backend: str | None = None):
     """The one-line config change (BASELINE.json):
-    chunker = cpu | tpu | sidecar:<host:port>."""
+    chunker = cpu | scalar | vector | tpu | sidecar:<host:port>.
+
+    ``cpu_backend`` selects the scan implementation for the cpu kinds
+    (''/'cpu'): 'vector' routes through chunker/vector.py's
+    ``ResilientVectorFactory`` (self-test-gated, pinned per stream at
+    bind_stream time, degrades to scalar like sidecar degrades to CPU);
+    anything else keeps the scalar ``CpuChunker``.  Explicit kinds
+    'scalar'/'vector' pin the implementation regardless of conf."""
     if kind == "tpu":
         def factory(p):
             # invoked inside start_session, which job code runs off the
@@ -139,9 +165,17 @@ def make_chunker_factory(kind: str):
         # (sidecar/client.py ResilientSidecarFactory docstring)
         from ..sidecar.client import ResilientSidecarFactory
         return ResilientSidecarFactory(kind.split(":", 1)[1])
+    if kind == "scalar":
+        return lambda p: CpuChunker(p)
+    if kind == "vector" or (kind in ("", "cpu")
+                            and resolve_cpu_scan_backend(cpu_backend)
+                            == "vector"):
+        from ..chunker.vector import ResilientVectorFactory
+        return ResilientVectorFactory()
     if kind not in ("", "cpu"):
         raise ValueError(f"unknown chunker backend {kind!r} "
-                         "(want cpu | tpu | sidecar:<host:port>)")
+                         "(want cpu | scalar | vector | tpu | "
+                         "sidecar:<host:port>)")
     return lambda p: CpuChunker(p)
 
 

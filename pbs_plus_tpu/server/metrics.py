@@ -130,6 +130,11 @@ class MetricsRegistry:
         gauge("pbs_plus_backup_last_error_count",
               "Per-file errors in the last finished run",
               [({"job": j}, float(st["errors"])) for j, st in lr.items()])
+        gauge("pbs_plus_backup_last_chunker_backend",
+              "Chunker backend pinned at stream open for the last "
+              "finished run (cpu/vector/sidecar/tpu)",
+              [({"job": j, "backend": st["chunker_backend"]}, 1.0)
+               for j, st in lr.items() if st.get("chunker_backend")])
 
         # -- live speeds for running jobs (reference: live bytes/files
         #    speed gauges) ---------------------------------------------------
@@ -264,6 +269,19 @@ class MetricsRegistry:
               "In-flight items per pipeline queue",
               [({"queue": q}, float(v))
                for q, v in snap["queues"].items()])
+
+        # -- chunker backends (chunker/observe.py; docs/data-plane.md
+        #    "Chunking backends") -------------------------------------------
+        from ..chunker import observe as _chunkobs
+        co = _chunkobs.snapshot()
+        gauge("pbs_plus_chunker_scan_bytes_total",
+              "Payload bytes scanned per chunker backend implementation",
+              [({"backend": b}, float(v))
+               for b, v in sorted(co["scan_bytes"].items())])
+        gauge("pbs_plus_chunker_vector_fallbacks_total",
+              "Streams degraded vector -> scalar at bind time (failed "
+              "vector self-test)",
+              [({}, float(co["events"].get("vector_fallbacks", 0)))])
 
         # -- read-path chunk cache (pxar/chunkcache.py) -----------------------
         from ..pxar import chunkcache as _chunkcache

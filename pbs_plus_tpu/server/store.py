@@ -45,6 +45,11 @@ class ServerConfig:
     arpc_port: int = 0                      # 0 = ephemeral (tests)
     chunk_avg: int = 4 << 20
     chunker: str = "cpu"                    # default backend; per-job override
+    # CPU scan implementation for cpu-kind chunkers: "" (fall back to
+    # PBS_PLUS_CHUNKER_BACKEND from the environment, default scalar) |
+    # "scalar" | "vector" (chunker/vector.py — SIMD-style doubling scan,
+    # self-test-gated, degrades to scalar per stream at bind time)
+    chunker_backend: str = ""
     # default pipelined-writer hash workers (0 = sequential); per-job
     # override via BackupJobRow.pipeline_workers
     pipeline_workers: int = 0
@@ -128,7 +133,8 @@ class Server:
         params = ChunkerParams(avg_size=config.chunk_avg)
         self.datastore = LocalStore(
             config.datastore_dir, params,
-            chunker_factory=make_chunker_factory(config.chunker),
+            chunker_factory=make_chunker_factory(
+                config.chunker, cpu_backend=config.chunker_backend),
             batch_hasher=make_batch_hasher(config.chunker),
             pbs_format=config.datastore_format == "pbs",
             pipeline_workers=config.pipeline_workers)
@@ -495,14 +501,16 @@ class Server:
                           namespace=self.config.pbs_namespace,
                           fingerprint=self.config.pbs_fingerprint),
                 ChunkerParams(avg_size=self.config.chunk_avg),
-                chunker_factory=make_chunker_factory(kind),
+                chunker_factory=make_chunker_factory(
+                    kind, cpu_backend=self.config.chunker_backend),
                 batch_hasher=make_batch_hasher(kind),
                 pipeline_workers=self.config.pipeline_workers)
         elif row.chunker and row.chunker != self.config.chunker:
             store = LocalStore(
                 self.config.datastore_dir,
                 ChunkerParams(avg_size=self.config.chunk_avg),
-                chunker_factory=make_chunker_factory(row.chunker),
+                chunker_factory=make_chunker_factory(
+                    row.chunker, cpu_backend=self.config.chunker_backend),
                 batch_hasher=make_batch_hasher(row.chunker),
                 pbs_format=self.config.datastore_format == "pbs",
                 pipeline_workers=self.config.pipeline_workers)
@@ -566,7 +574,11 @@ class Server:
                     "duration": time.time() - result_box.get("t0",
                                                              time.time()),
                     "bytes": res.bytes_total, "files": res.files,
-                    "entries": res.entries, "errors": len(res.errors)}
+                    "entries": res.entries, "errors": len(res.errors),
+                    # backend pinned at stream open (manifest label):
+                    # which chunker actually scanned this run's bytes
+                    "chunker_backend":
+                        res.manifest.get("chunker_backend", "")}
             self.db.finish_task(upid, status)
             self.db.record_backup_result(
                 row.id, status, snapshot=res.snapshot if res else "")

@@ -16,13 +16,15 @@ Resilience wiring (docs/fault-injection.md, docs/data-plane.md):
   mid-stream swap after a partial carry would move every later cut
   point and silently destroy dedup ("A Thorough Investigation of
   Content-Defined Chunking Algorithms" — cut-point stability is the
-  whole game).
+  whole game).  ``chunker.vector.ResilientVectorFactory`` applies the
+  same bind-time-only discipline to vector -> scalar degradation.
 """
 
 from __future__ import annotations
 
 import grpc
 
+from ..chunker import observe
 from ..chunker.spec import ChunkerParams
 from ..utils import codec, conf, failpoints
 from ..utils.log import L
@@ -104,6 +106,8 @@ class SidecarChunker:
     plugs into transfer writers like Cpu/TpuChunker.  Stream ids are
     uuids: many processes share one sidecar without collisions."""
 
+    backend_name = "sidecar"
+
     def __init__(self, params: ChunkerParams, client: SidecarClient):
         import uuid
         self.client = client
@@ -130,6 +134,7 @@ class SidecarChunker:
     def feed(self, data: bytes) -> list[int]:
         if self._finalized:
             raise RuntimeError("chunker already finalized")
+        observe.add_scan_bytes("sidecar", len(data))
         return list(self.client.chunk(self.stream_id, bytes(data))["cuts"])
 
     def finalize(self) -> list[int]:

@@ -55,6 +55,11 @@ class Env:
     cert_dir: str = DEFAULT_CERT_DIR
     chunker: str = "cpu"            # "cpu" | "tpu"  — the one-line config
                                     # change from BASELINE.json's north star
+    # CPU scan implementation for cpu-kind chunkers: "" (scalar) |
+    # "scalar" | "vector" (chunker/vector.py — the SIMD-style doubling
+    # scan, self-test-gated with scalar fallback).  ServerConfig's
+    # chunker_backend overrides this fleet-wide default per server.
+    chunker_backend: str = ""
     log_dedup_window_s: float = 5.0
     # per-RPC deadline for the dedup sidecar's gRPC calls (the old
     # hard-coded 300 in sidecar/client.py, now an operator knob)
@@ -96,6 +101,7 @@ def env() -> Env:
         state_dir=e.get("PBS_PLUS_STATE_DIR", DEFAULT_STATE_DIR),
         cert_dir=e.get("PBS_PLUS_CERT_DIR", DEFAULT_CERT_DIR),
         chunker=e.get("PBS_PLUS_CHUNKER", "cpu"),
+        chunker_backend=e.get("PBS_PLUS_CHUNKER_BACKEND", ""),
         log_dedup_window_s=_float_env(e, "LOG_DEDUP_WINDOW", "5"),
         sidecar_timeout_s=_float_env(e, "PBS_PLUS_SIDECAR_TIMEOUT", "300"),
         checkpoint_interval=e.get("PBS_PLUS_CHECKPOINT_INTERVAL", ""),

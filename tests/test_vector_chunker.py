@@ -1,0 +1,332 @@
+"""Vectorized chunker backend battery (ISSUE 6).
+
+The chunk format must be unforkable across backends: scalar
+(``CpuChunker``), vectorized (``VectorChunker``), and one-shot
+(``chunk_bounds``) must produce identical absolute cut offsets under any
+feed split, and a backup through the bind_stream-selected vector backend
+must produce a snapshot bit-identical to the scalar-chunker snapshot.
+"""
+
+import numpy as np
+import pytest
+
+from pbs_plus_tpu.chunker import (
+    ChunkerParams, CpuChunker, ResilientVectorFactory, VectorChunker,
+    candidates, chunk_bounds,
+)
+from pbs_plus_tpu.chunker import native, observe, vector
+
+P = ChunkerParams(avg_size=4 << 10)   # test scale: 4 KiB avg, 16 KiB max
+
+
+def _data(n: int, seed: int = 7) -> bytes:
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+# -- one-shot scan parity ---------------------------------------------------
+
+def test_vector_oneshot_matches_scalar():
+    data = _data(1_000_000, seed=5)
+    ref = candidates(data, P, force_numpy=True)
+    assert np.array_equal(vector.candidates(data, P), ref)
+    assert np.array_equal(vector.candidates(data, P, force_numpy=True), ref)
+    # around the native-dispatch threshold and the numpy block seams
+    for n in (0, 1, 63, 64, 65, 4095, 4096, 4097, (1 << 12) - 1, 1 << 12,
+              (1 << 16) - 1, 1 << 16, (1 << 16) + 1, 200_001):
+        want = candidates(data[:n], P, force_numpy=True)
+        assert np.array_equal(vector.candidates(data[:n], P), want), n
+        assert np.array_equal(
+            vector.candidates(data[:n], P, force_numpy=True), want), n
+
+
+def test_vector_prefix_context_and_clamp():
+    data = _data(300_000, seed=4)
+    split = 150_017
+    whole = candidates(data, P, force_numpy=True)
+    for fn in (lambda d, **kw: vector.candidates(d, P, **kw),
+               lambda d, **kw: vector.candidates(d, P, force_numpy=True,
+                                                 **kw)):
+        right = fn(data[split:], prefix=data[:split],
+                   global_offset=split)
+        assert np.array_equal(right, whole[whole > split])
+    # oversized prefix clamps exactly like the scalar backend
+    pfx = b"Z" * 40 + data[:30]
+    want = candidates(data[30:], P, prefix=pfx, global_offset=30,
+                      force_numpy=True)
+    assert np.array_equal(
+        vector.candidates(data[30:], P, prefix=pfx, global_offset=30), want)
+    assert np.array_equal(
+        vector.candidates(data[30:], P, prefix=pfx, global_offset=30,
+                          force_numpy=True), want)
+
+
+@pytest.mark.skipif(not native.vec_available(),
+                    reason="native vectorized scan unavailable")
+def test_vector_native_matches_numpy():
+    data = _data(2_000_000, seed=11)
+    a = vector.candidates(data, P, force_numpy=True)
+    b = native.candidates_vec(data, P)
+    assert np.array_equal(a, b)
+    split = 777_773
+    a2 = vector.candidates(data[split:], P, prefix=data[:split][-63:],
+                           global_offset=split, force_numpy=True)
+    b2 = native.candidates_vec(data[split:], P,
+                               prefix=data[:split][-63:],
+                               global_offset=split)
+    assert np.array_equal(a2, b2)
+
+
+# -- streaming parity battery (adversarial fixed-seed feed splits) ----------
+
+def _feed_all(chunker_cls, data: bytes, sizes) -> list[int]:
+    ch = chunker_cls(P)
+    got: list[int] = []
+    off = 0
+    for s in sizes:
+        got.extend(ch.feed(data[off:off + s]))
+        off += s
+    assert off == len(data)
+    got.extend(ch.finalize())
+    return got
+
+
+def _splits(total: int):
+    """Adversarial feed-split generators (deterministic)."""
+    yield "one-byte", [1] * total
+    cyc = [63, 64, 65, 1, 2, 127, 128, 4095, 4096]   # W-1 straddlers
+    sizes, acc = [], 0
+    i = 0
+    while acc < total:
+        s = min(cyc[i % len(cyc)], total - acc)
+        sizes.append(s)
+        acc += s
+        i += 1
+    yield "straddle", sizes
+    rng = np.random.default_rng(1234)
+    sizes, acc = [], 0
+    while acc < total:
+        s = int(min(rng.integers(0, 10_000), total - acc))
+        sizes.append(s)            # includes empty feeds
+        acc += s
+    yield "random+empty", sizes
+
+
+def test_streaming_parity_battery():
+    data = _data(60_000, seed=3)       # ~15 chunks at test scale
+    want = [e for _, e in chunk_bounds(data, P)]
+    for name, sizes in _splits(len(data)):
+        for cls in (CpuChunker, VectorChunker):
+            got = _feed_all(cls, data, sizes)
+            assert got == want, f"{cls.__name__} diverged on {name}"
+
+
+def test_streaming_parity_large_random_feeds():
+    data = _data(500_000, seed=13)
+    want = [e for _, e in chunk_bounds(data, P)]
+    rng = np.random.default_rng(99)
+    sizes, acc = [], 0
+    while acc < len(data):
+        s = int(min(rng.integers(1, 120_000), len(data) - acc))
+        sizes.append(s)
+        acc += s
+    for cls in (CpuChunker, VectorChunker):
+        assert _feed_all(cls, data, sizes) == want, cls.__name__
+
+
+def test_feed_after_finalize_raises():
+    for cls in (CpuChunker, VectorChunker):
+        ch = cls(P)
+        ch.feed(b"x" * 1000)
+        ch.finalize()
+        with pytest.raises(RuntimeError):
+            ch.feed(b"more")
+        assert ch.finalize() == []     # idempotent
+
+
+# -- batched entry (vmap-across-sessions shape) -----------------------------
+
+def test_candidates_batch_matches_per_row():
+    data = _data(400_000, seed=21)
+    bufs = [data[:100_000], data[100_000:250_000], b"", data[250_000:]]
+    offs = [0, 100_000, 0, 250_000]
+    pfxs = [b"", data[:100_000][-63:], b"", data[:250_000][-63:]]
+    for kw in ({}, {"force_numpy": True}):
+        rows = vector.candidates_batch(bufs, P, prefixes=pfxs,
+                                       global_offsets=offs, **kw)
+        assert len(rows) == len(bufs)
+        for b, p, o, r in zip(bufs, pfxs, offs, rows):
+            want = candidates(b, P, prefix=p, global_offset=o,
+                              force_numpy=True)
+            assert np.array_equal(r, want), (len(b), o, kw)
+    assert vector.candidates_batch([], P) == []
+
+
+# -- resilient factory (bind_stream seam, PR 3 fallback discipline) ---------
+
+def test_resilient_factory_binds_vector():
+    f = ResilientVectorFactory()
+    assert f.bind_stream(P) is VectorChunker
+    assert isinstance(f(P), VectorChunker)
+
+
+def test_resilient_factory_degrades_to_scalar(monkeypatch):
+    before = observe.snapshot()["events"].get("vector_fallbacks", 0)
+    monkeypatch.setattr(vector, "_probe_ok", False)
+    f = ResilientVectorFactory()
+    assert f.bind_stream(P) is CpuChunker
+    assert isinstance(f(P), CpuChunker)
+    after = observe.snapshot()["events"].get("vector_fallbacks", 0)
+    assert after >= before + 2         # bind + plain-call fallback
+
+
+def test_self_test_failure_latches(monkeypatch):
+    monkeypatch.setattr(vector, "_probe_ok", None)
+    monkeypatch.setattr(vector, "_self_test",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    assert vector.available() is False     # fail closed
+    assert vector._probe_ok is False       # latched
+    assert ResilientVectorFactory().bind_stream(P) is CpuChunker
+
+
+def test_bound_backend_pinned_per_stream():
+    class _NullStore:
+        def insert(self, digest, data, *, verify=True):
+            return True
+
+        def touch(self, digest):
+            pass
+
+    from pbs_plus_tpu.pxar.transfer import _ChunkedStream
+    s = _ChunkedStream(_NullStore(), P,
+                       chunker_factory=ResilientVectorFactory())
+    assert s.bound_backend == "vector"
+    s.write(_data(100_000, seed=31))
+    # flush_chunker restarts the chunker through the PINNED factory —
+    # the backend never changes mid-stream
+    s.flush_chunker()
+    assert isinstance(s._chunker, VectorChunker)
+    s.finish()
+    s2 = _ChunkedStream(_NullStore(), P)
+    assert s2.bound_backend == "cpu"
+
+
+# -- backend selection plumbing ---------------------------------------------
+
+def test_make_chunker_factory_resolution(monkeypatch):
+    from pbs_plus_tpu.server import backup_job as bj
+    from pbs_plus_tpu.utils import conf
+
+    assert isinstance(bj.make_chunker_factory("vector"),
+                      ResilientVectorFactory)
+    f = bj.make_chunker_factory("scalar")
+    assert type(f(P)) is CpuChunker
+    f = bj.make_chunker_factory("cpu")
+    assert type(f(P)) is CpuChunker
+    assert isinstance(bj.make_chunker_factory("cpu", cpu_backend="vector"),
+                      ResilientVectorFactory)
+    # PBS_PLUS_CHUNKER_BACKEND -> Env -> factory for the default kind
+    monkeypatch.setenv("PBS_PLUS_CHUNKER_BACKEND", "vector")
+    conf.env.cache_clear()
+    try:
+        assert isinstance(bj.make_chunker_factory(""),
+                          ResilientVectorFactory)
+        # explicit scalar kind pins the implementation regardless of env
+        assert type(bj.make_chunker_factory("scalar")(P)) is CpuChunker
+    finally:
+        conf.env.cache_clear()
+    # unknown backend value degrades to scalar, never raises
+    assert type(bj.make_chunker_factory("cpu", cpu_backend="warp")(P)) \
+        is CpuChunker
+    bj.validate_chunker_kind("vector")
+    bj.validate_chunker_kind("scalar")
+    with pytest.raises(ValueError):
+        bj.validate_chunker_kind("warp")
+
+
+# -- observability ----------------------------------------------------------
+
+def test_scan_bytes_accounting():
+    n = 300_000
+    data = _data(n, seed=41)
+    before = observe.snapshot()["scan_bytes"]
+    vector.candidates(data, P)                     # native-vec or numpy
+    vector.candidates(data, P, force_numpy=True)   # always numpy kernel
+    candidates(data, P, force_numpy=True)          # scalar numpy
+    after = observe.snapshot()["scan_bytes"]
+
+    def delta(backend):
+        return after.get(backend, 0) - before.get(backend, 0)
+
+    assert delta("numpy") >= n
+    assert delta("vector-numpy") >= n
+    if native.vec_available():
+        assert delta("vector") >= n
+    else:
+        assert delta("vector-numpy") >= 2 * n
+
+
+# -- snapshot bit-identity through the real data plane ----------------------
+
+def test_backup_snapshot_bit_identical_vector_vs_scalar(tmp_path):
+    """A backup through the bind_stream-selected vector backend must
+    publish a snapshot bit-identical to the scalar-chunker snapshot:
+    same index records (cut offsets AND digests), both archives decode
+    to the same tree."""
+    import os
+
+    from pbs_plus_tpu.pxar.backupproxy import LocalStore
+    from pbs_plus_tpu.pxar.walker import backup_tree
+
+    src = tmp_path / "src"
+    src.mkdir()
+    rng = np.random.default_rng(17)
+    for i in range(24):
+        (src / f"f{i:02d}.bin").write_bytes(
+            rng.integers(0, 256, 24_000, dtype=np.uint8).tobytes())
+    (src / "sub").mkdir()
+    (src / "sub" / "nested.bin").write_bytes(
+        rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes())
+    (src / "empty.bin").write_bytes(b"")
+
+    params = ChunkerParams(avg_size=1 << 14)
+    results = {}
+    for name, factory in (("scalar", None),
+                          ("vector", ResilientVectorFactory())):
+        kw = {"chunker_factory": factory} if factory is not None else {}
+        store = LocalStore(str(tmp_path / f"ds-{name}"), params, **kw)
+        sess = store.start_session(backup_type="host", backup_id="b",
+                                   backup_time=1_700_000_000.0)
+        backup_tree(sess, str(src))
+        man = sess.finish()
+        reader = store.open_snapshot(sess.ref)
+        results[name] = {
+            "man": man,
+            "meta": [(int(reader.meta_index.ends[i]),
+                      bytes(reader.meta_index.digests[i]))
+                     for i in range(len(reader.meta_index))],
+            "payload": [(int(reader.payload_index.ends[i]),
+                         bytes(reader.payload_index.digests[i]))
+                        for i in range(len(reader.payload_index))],
+            "tree": [(e.path, e.kind, e.size, e.digest)
+                     for e in reader.entries()],
+        }
+        del reader
+    a, b = results["scalar"], results["vector"]
+    assert a["payload"] == b["payload"]     # bit-identical payload index
+    assert a["meta"] == b["meta"]           # bit-identical meta index
+    assert a["tree"] == b["tree"]
+    # the manifests differ ONLY in the bound-backend label (+ times)
+    assert a["man"]["chunker_backend"] == "cpu"
+    assert b["man"]["chunker_backend"] == "vector"
+    for k in ("entries", "meta_size", "payload_size", "meta_chunks",
+              "payload_chunks", "stats", "chunker"):
+        assert a["man"][k] == b["man"][k], k
+    # identical chunk sets on disk
+    def chunk_files(base):
+        out = set()
+        for dirpath, _dirs, files in os.walk(base):
+            out.update(f for f in files if not f.endswith(".tmp"))
+        return out
+    assert chunk_files(tmp_path / "ds-scalar" / ".chunks") == \
+        chunk_files(tmp_path / "ds-vector" / ".chunks")
