@@ -389,6 +389,32 @@ def _read_bench(mib: int = 64, *, window_kib: int = 128,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _fleet_bench(n_agents: int | None = None) -> dict:
+    """Loopback fleet soak (docs/fleet.md): N simulated agents speak real
+    aRPC through AgentsManager admission and the fair jobs plane, one
+    synthetic backup each.  Reports enqueue-to-publish p50/p99,
+    session-open admission latency, mux frame throughput, admission
+    verdict counts, and the maximum observed depth of every bounded
+    queue.  ``PBS_PLUS_BENCH_FLEET_N`` overrides the agent count."""
+    import shutil
+    import tempfile
+
+    from pbs_plus_tpu.server.fleetsim import FleetConfig, run_fleet
+
+    n = n_agents or int(os.environ.get("PBS_PLUS_BENCH_FLEET_N", "100"))
+    tmp = tempfile.mkdtemp(prefix="pbs-fleet-bench-")
+    try:
+        cfg = FleetConfig(n_agents=n, tenants=8, max_concurrent=8,
+                          max_queued=2 * n)
+        rep = run_fleet(os.path.join(tmp, "ds"), cfg)
+        out = rep.to_dict()
+        if rep.failures:
+            out["failures"] = dict(sorted(rep.failures.items())[:5])
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 from pbs_plus_tpu.utils.jaxdev import probe_relay  # shared tunnel probe
 
 
@@ -715,6 +741,13 @@ def main() -> None:
         read = None
     if read is not None:
         result["detail"]["read"] = read
+    try:
+        fleet = _fleet_bench()
+    except Exception as e:
+        sys.stderr.write(f"[bench] fleet bench unavailable: {e}\n")
+        fleet = None
+    if fleet is not None:
+        result["detail"]["fleet"] = fleet
     result["machine"] = _machine_context()
     print(json.dumps(result))
 

@@ -16,16 +16,42 @@ from __future__ import annotations
 
 import asyncio
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Optional
 
-from ..utils import conf
+from ..utils import conf, failpoints
 from ..utils.log import L
 from .mux import MuxConnection
 
 HDR_BACKUP_ID = "X-PBS-Plus-BackupID"
 HDR_RESTORE_ID = "X-PBS-Plus-RestoreID"
 HDR_VERIFY_ID = "X-PBS-Plus-VerifyID"
+
+# prune cadence / cap for the per-client token-bucket registry: a fleet
+# cycling through millions of distinct CNs must not pin one bucket each
+_BUCKET_PRUNE_INTERVAL_S = 60.0
+_BUCKET_CAP = 8192
+
+# admitted-but-unregistered ceiling reservations expire after this long
+# (the transport handshake times out at 15s, so a reservation older than
+# this belongs to a connection that died before register())
+_ADMIT_RESERVATION_TTL_S = 20.0
+
+
+class AdmissionRejected(ConnectionError):
+    """Typed fast-fail admission verdict (docs/fleet.md).
+
+    ``code`` is the handshake rejection code sent on the wire (429 rate,
+    503 capacity, 403 identity), ``reason`` the human string, ``kind``
+    the stable counter label exported as
+    ``pbs_plus_admission_rejected_total{reason=...}``."""
+
+    def __init__(self, code: int, reason: str, kind: str):
+        super().__init__(f"admission rejected ({code}): {reason}")
+        self.code = code
+        self.reason = reason
+        self.kind = kind
 
 
 def client_id_from(cn: str, headers: dict[str, str]) -> str:
@@ -72,37 +98,144 @@ class AgentsManager:
     """Connected-session registry with admission control."""
 
     def __init__(self, *, is_expected: ExpectFn | None = None,
-                 rate: float = conf.CLIENT_RATE_LIMIT_PER_SEC,
-                 burst: int = conf.CLIENT_RATE_LIMIT_BURST):
+                 rate: float | None = None,
+                 burst: int | None = None,
+                 max_sessions: int | None = None,
+                 open_rate: float | None = None):
+        e = conf.env()
         self._sessions: dict[str, ClientSession] = {}
         self._expected_ids: set[str] = set()         # Expect() one-shots
         self._waiters: dict[str, list[asyncio.Future]] = {}
         self._disc_watchers: dict[int, list[asyncio.Future]] = {}
         self._buckets: dict[str, _TokenBucket] = {}
-        self._rate, self._burst = rate, burst
+        self._last_bucket_prune = time.monotonic()
+        self._rate = e.agent_rate if rate is None else rate
+        self._burst = e.agent_burst if burst is None else burst
+        # hard ceiling on registered sessions (0 = unlimited) and a
+        # GLOBAL session-open rate bucket (0 = disabled) on top of the
+        # per-client bucket: bounded admission instead of unbounded accept
+        self.max_sessions = (e.agent_max_sessions if max_sessions is None
+                             else max_sessions)
+        open_rate = e.agent_open_rate if open_rate is None else open_rate
+        self._open_bucket = (_TokenBucket(open_rate,
+                                          max(1, int(2 * open_rate)))
+                             if open_rate > 0 else None)
         self._is_expected = is_expected
         self._lock = asyncio.Lock()
+        # admitted-but-not-yet-registered handshakes: the ok-frame write
+        # and register() happen awaits after admit(), so the session
+        # ceiling counts these reservations too or a connect storm would
+        # sail past it wholesale.  A reservation whose connection died
+        # before register() expires after the handshake deadline.
+        self._admit_reservations: deque[float] = deque()
+        # cumulative admission verdicts, keyed by AdmissionRejected.kind
+        # (plus "admitted") — rendered by server/metrics.py
+        self._admission_counts: dict[str, int] = {"admitted": 0}
+
+    def _count_reject(self, code: int, reason: str,
+                      kind: str) -> AdmissionRejected:
+        self._admission_counts[kind] = self._admission_counts.get(kind,
+                                                                  0) + 1
+        return AdmissionRejected(code, reason, kind)
+
+    def admission_stats(self) -> dict[str, int]:
+        """{"admitted": n, "<reject kind>": n, ...} — cumulative."""
+        return dict(self._admission_counts)
+
+    def _maybe_prune_buckets(self, now: float) -> None:
+        """Drop idle per-client buckets.  A bucket whose idle time would
+        refill it to burst carries no state (a fresh bucket is
+        equivalent), so evicting those never weakens the limit; past
+        _BUCKET_CAP a forced sweep evicts the COLDEST buckets too (those
+        CNs get a fresh burst — the bounded registry is worth that
+        slack) so a million distinct CNs can never pin a million
+        buckets, and the sweep brings the dict back under cap so the
+        over-cap path is not re-entered on every admit."""
+        over = len(self._buckets) > _BUCKET_CAP
+        if not over and \
+                now - self._last_bucket_prune < _BUCKET_PRUNE_INTERVAL_S:
+            return
+        self._last_bucket_prune = now
+        if self._rate > 0:
+            ttl = self._burst / self._rate  # time-to-full from empty
+            dead = [cn for cn, b in self._buckets.items()
+                    if now - b.last >= ttl]
+            for cn in dead:
+                del self._buckets[cn]
+        if len(self._buckets) > _BUCKET_CAP:
+            # sweep to 7/8 of cap, not to cap exactly: leaving headroom
+            # amortizes the O(n log n) sort across ~cap/8 admissions
+            # instead of re-sorting the whole registry on every new CN
+            target = _BUCKET_CAP - _BUCKET_CAP // 8
+            coldest = sorted((b.last, cn)
+                             for cn, b in self._buckets.items())
+            for _, cn in coldest[:len(self._buckets) - target]:
+                del self._buckets[cn]
 
     # -- admission (plugged into transport.serve's admit) ------------------
-    async def admit(self, peer_info: dict, headers: dict) -> tuple[int, str] | None:
+    async def admit(self, peer_info: dict, headers: dict) -> None:
+        """Raises typed ``AdmissionRejected`` on any reject; returns None
+        on accept (transport.serve converts the exception into the wire
+        rejection frame)."""
+        await failpoints.ahit("arpc.session.open")
         cn = peer_info.get("cn", "")
+        now = time.monotonic()
         if not cn:
-            return (403, "client certificate has no CN")
-        cid = client_id_from(cn, headers)
-        bucket = self._buckets.setdefault(
-            cn, _TokenBucket(self._rate, self._burst))
-        if not bucket.allow():
-            return (429, "rate limited")
-        # job sessions must have been announced via expect(); primary
-        # sessions go through the expected-host check (cert in DB)
-        if cid != cn:
-            if cid not in self._expected_ids:
-                return (403, f"unexpected job session {cid!r}")
-        elif self._is_expected is not None:
-            ok = await self._is_expected(cn, peer_info.get("cert_der", b""))
-            if not ok:
-                return (403, "host not expected")
+            raise self._count_reject(403, "client certificate has no CN",
+                                     "no_cn")
+        reserved = False
+        if self.max_sessions > 0:
+            # count registered sessions PLUS in-flight admitted
+            # handshakes: registration happens awaits after this check,
+            # so without the reservation a connect storm would overshoot
+            # the ceiling by exactly the storm size
+            if len(self._sessions) + self._reservations(now) >= \
+                    self.max_sessions:
+                raise self._count_reject(
+                    503, f"session limit reached ({self.max_sessions})",
+                    "session_limit")
+            self._admit_reservations.append(now)
+            reserved = True
+        try:
+            if self._open_bucket is not None and \
+                    not self._open_bucket.allow():
+                raise self._count_reject(429, "session open rate limited",
+                                         "open_rate")
+            cid = client_id_from(cn, headers)
+            if self._rate > 0:              # 0 disables the per-CN gate
+                self._maybe_prune_buckets(now)
+                bucket = self._buckets.setdefault(
+                    cn, _TokenBucket(self._rate, self._burst))
+                if not bucket.allow():
+                    raise self._count_reject(429, "rate limited",
+                                             "client_rate")
+            # job sessions must have been announced via expect(); primary
+            # sessions go through the expected-host check (cert in DB)
+            if cid != cn:
+                if cid not in self._expected_ids:
+                    raise self._count_reject(
+                        403, f"unexpected job session {cid!r}",
+                        "unexpected_job_session")
+            elif self._is_expected is not None:
+                ok = await self._is_expected(cn,
+                                             peer_info.get("cert_der", b""))
+                if not ok:
+                    raise self._count_reject(403, "host not expected",
+                                             "host_not_expected")
+        except BaseException:
+            if reserved and self._admit_reservations:
+                self._admit_reservations.pop()
+            raise
+        self._admission_counts["admitted"] += 1
         return None
+
+    def _reservations(self, now: float) -> int:
+        """Live admitted-but-unregistered count (expired ones belong to
+        connections that died between admit() and register())."""
+        q = self._admit_reservations
+        while q and now - q[0] > _ADMIT_RESERVATION_TTL_S:
+            q.popleft()
+        return len(q)
 
     def expect(self, client_id: str) -> None:
         """Announce an upcoming job session (reference: Expect(streamID),
@@ -118,6 +251,10 @@ class AgentsManager:
         cn = peer_info.get("cn", "")
         cid = client_id_from(cn, headers)
         sess = ClientSession(cid, cn, conn, dict(headers))
+        if self._admit_reservations:
+            # this registration consumes one admitted-handshake
+            # reservation (FIFO — reservations are fungible)
+            self._admit_reservations.popleft()
         async with self._lock:
             old = self._sessions.get(cid)
             self._sessions[cid] = sess
@@ -184,5 +321,10 @@ class AgentsManager:
             return await asyncio.wait_for(fut, timeout)
         finally:
             ws = self._waiters.get(client_id)
-            if ws and fut in ws:
-                ws.remove(fut)
+            if ws is not None:
+                if fut in ws:
+                    ws.remove(fut)
+                if not ws:
+                    # drop the empty key: a timed-out waiter must not pin
+                    # a _waiters entry per client_id ever waited for
+                    del self._waiters[client_id]

@@ -28,6 +28,14 @@ SYN, DATA, FIN, RST, PING, PONG, WINDOW = range(1, 8)
 MAX_DATA_FRAME = 256 << 10
 INITIAL_CREDIT = conf.STREAM_BUFFER_SIZE
 
+# accepted-but-unclaimed streams per connection: a SYN-flooding peer gets
+# RSTs past this point instead of allocating unbounded stream state
+MAX_SYN_BACKLOG = 256
+
+# slack on top of the advertised credit before a peer counts as violating
+# flow control (grants and data frames cross on the wire)
+_RX_CREDIT_SLACK = MAX_DATA_FRAME
+
 
 class MuxError(ConnectionError):
     pass
@@ -46,6 +54,10 @@ class MuxStream:
         self._tx_event.set()
         self._closed = False
         self._consumed_since_grant = 0
+        # bytes received and buffered but not yet granted back: a peer
+        # honoring flow control keeps this ≤ INITIAL_CREDIT, so it is
+        # the per-stream RX buffering bound (enforced in _dispatch)
+        self._rx_unacked = 0
 
     # -- read -------------------------------------------------------------
     async def read(self, n: int = -1) -> bytes:
@@ -80,6 +92,7 @@ class MuxStream:
         if self._consumed_since_grant >= INITIAL_CREDIT // 4:
             grant = self._consumed_since_grant
             self._consumed_since_grant = 0
+            self._rx_unacked = max(0, self._rx_unacked - grant)
             await self.conn._send_frame(WINDOW, self.sid,
                                         struct.pack("<I", grant))
 
@@ -157,6 +170,7 @@ class MuxStream:
     # -- conn callbacks ---------------------------------------------------
     def _on_data(self, payload: bytes) -> None:
         self._rx += payload
+        self._rx_unacked += len(payload)
         self._rx_event.set()
 
     def _on_fin(self) -> None:
@@ -182,19 +196,38 @@ class MuxConnection:
 
     def __init__(self, reader: asyncio.StreamReader,
                  writer: asyncio.StreamWriter, *, is_client: bool,
-                 keepalive_s: float = 30.0):
+                 keepalive_s: float = 30.0,
+                 write_deadline_s: float | None = None):
         self.reader = reader
         self.writer = writer
         self.is_client = is_client
         self._next_sid = 1 if is_client else 2
         self._streams: dict[int, MuxStream] = {}
-        self._accept_q: asyncio.Queue[MuxStream | None] = asyncio.Queue()
+        # bounded SYN backlog: _syn_backlog counts queued-not-yet-accepted
+        # streams and caps at MAX_SYN_BACKLOG; the +1 slot is reserved for
+        # the shutdown sentinel so put_nowait can never fail
+        self._accept_q: asyncio.Queue[MuxStream | None] = \
+            asyncio.Queue(maxsize=MAX_SYN_BACKLOG + 1)
+        self._syn_backlog = 0
         self._wlock = asyncio.Lock()
         self.closed = False
         self.close_reason = ""
         self._keepalive_s = keepalive_s
+        # slow-reader shed: a frame write blocked on a full transport for
+        # longer than this kills the CONNECTION (frames cannot be skipped
+        # without corrupting the mux) instead of buffering without bound;
+        # 0 disables, None takes the conf default (PBS_PLUS_MUX_WRITE_DEADLINE)
+        self._write_deadline_s = (conf.env().mux_write_deadline_s
+                                  if write_deadline_s is None
+                                  else write_deadline_s)
         self._last_rx = time.monotonic()
         self._tasks: list[asyncio.Task] = []
+        # cheap observability for fleet soaks (docs/fleet.md): cumulative
+        # frame/byte counters plus shed/reject/violation events
+        self.stats = {"frames_tx": 0, "frames_rx": 0,
+                      "bytes_tx": 0, "bytes_rx": 0,
+                      "write_deadline_sheds": 0, "syn_rejects": 0,
+                      "flow_violations": 0}
 
     def start(self) -> None:
         self._tasks.append(asyncio.create_task(self._read_loop()))
@@ -205,6 +238,7 @@ class MuxConnection:
     async def _send_frame(self, ftype: int, sid: int, payload: bytes) -> None:
         if self.closed:
             raise MuxError("connection closed")
+        shed = False
         async with self._wlock:
             try:
                 # drop/corrupt here injects a transport-death / bitflip at
@@ -215,10 +249,31 @@ class MuxConnection:
                 self.writer.write(_HDR.pack(ftype, sid, len(payload)))
                 if payload:
                     self.writer.write(payload)
-                await self.writer.drain()
+                self.stats["frames_tx"] += 1
+                self.stats["bytes_tx"] += _HDR.size + len(payload)
+                if self._write_deadline_s > 0:
+                    try:
+                        await asyncio.wait_for(self.writer.drain(),
+                                               self._write_deadline_s)
+                    except asyncio.TimeoutError:
+                        # slow reader: the peer has not drained its socket
+                        # for a full deadline — shed the connection (the
+                        # only safe unit; skipping frames would desync the
+                        # mux) rather than queue unbounded bytes
+                        shed = True
+                else:
+                    await self.writer.drain()
             except (ConnectionError, OSError) as e:
                 await self._shutdown(f"write failed: {e}")
                 raise MuxError(f"connection write failed: {e}") from e
+        if shed:
+            self.stats["write_deadline_sheds"] += 1
+            await self._shutdown(
+                f"write deadline ({self._write_deadline_s:g}s) exceeded: "
+                "slow reader shed")
+            raise MuxError(
+                "connection shed: write blocked past deadline "
+                f"({self._write_deadline_s:g}s)")
 
     async def _read_loop(self) -> None:
         try:
@@ -229,6 +284,8 @@ class MuxConnection:
                 payload = await failpoints.ahit("arpc.mux.read_frame",
                                                 payload)
                 self._last_rx = time.monotonic()
+                self.stats["frames_rx"] += 1
+                self.stats["bytes_rx"] += _HDR.size + len(payload)
                 await self._dispatch(ftype, sid, payload)
         except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
             await self._shutdown(f"read loop ended: {e}")
@@ -242,13 +299,30 @@ class MuxConnection:
         if ftype == SYN:
             if sid in self._streams:
                 return
+            if self._syn_backlog >= MAX_SYN_BACKLOG:
+                # accept backlog full: shed the stream, not the memory —
+                # the peer sees RST and may retry once we drain
+                self.stats["syn_rejects"] += 1
+                await self._send_frame(RST, sid, b"")
+                return
             st = MuxStream(self, sid)
             self._streams[sid] = st
-            await self._accept_q.put(st)
+            self._syn_backlog += 1
+            self._accept_q.put_nowait(st)   # can't fail: backlog < maxsize-1
         elif ftype == DATA:
             st = self._streams.get(sid)
             if st is not None:
                 st._on_data(payload)
+                if st._rx_unacked > INITIAL_CREDIT + _RX_CREDIT_SLACK:
+                    # peer is writing past its advertised credit: reset
+                    # the stream so per-stream RX buffering stays bounded
+                    # no matter how the other side misbehaves
+                    self.stats["flow_violations"] += 1
+                    L.warning("stream %d exceeded rx credit (%d buffered); "
+                              "resetting", sid, st._rx_unacked)
+                    self._streams.pop(sid, None)
+                    st._on_rst()
+                    await self._send_frame(RST, sid, b"")
             else:
                 await self._send_frame(RST, sid, b"")
         elif ftype == FIN:
@@ -299,6 +373,8 @@ class MuxConnection:
         if self.closed and self._accept_q.empty():
             return None
         st = await self._accept_q.get()
+        if st is not None:
+            self._syn_backlog -= 1
         return st
 
     def _drop_stream(self, sid: int) -> None:
@@ -313,7 +389,9 @@ class MuxConnection:
         for st in list(self._streams.values()):
             st._on_rst()
         self._streams.clear()
-        await self._accept_q.put(None)
+        # the +1 maxsize slot is reserved for exactly this sentinel (the
+        # backlog counter caps stream entries at MAX_SYN_BACKLOG)
+        self._accept_q.put_nowait(None)
         # stop companion loops promptly (a dead conn must not keep its
         # keepalive task alive for up to a full interval — leak discipline)
         for t in self._tasks:

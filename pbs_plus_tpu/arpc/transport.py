@@ -8,6 +8,13 @@ Connection open: TLS (mutual, CA-pinned) → client sends a headers frame
 frame (``{ok: bool, code, reason}``) → mux starts.  The headers carry the
 job-session routing keys (X-PBS-Plus-BackupID / RestoreID / VerifyID —
 same header names as the reference, agents_manager.py).
+
+Loopback plain mode: passing ``tls=None`` (both sides) skips TLS and
+takes the peer identity from the ``X-PBS-Plus-Loopback-CN`` handshake
+header instead of the certificate CN.  This exists ONLY for the
+in-process fleet simulator and tests (`server/fleetsim.py`,
+docs/fleet.md) — production servers always pass a ``TlsServerConfig``,
+and a plain listener trusts whatever CN the peer claims.
 """
 
 from __future__ import annotations
@@ -25,6 +32,9 @@ from .mux import MuxConnection
 HANDSHAKE_MAGIC = b"TPRC"
 _LEN = struct.Struct("<I")
 MAX_HANDSHAKE = 64 << 10
+
+# loopback plain mode (tls=None) only: the claimed peer identity header
+HDR_LOOPBACK_CN = "X-PBS-Plus-Loopback-CN"
 
 
 class HandshakeError(ConnectionError):
@@ -81,22 +91,29 @@ async def _read_frame(reader: asyncio.StreamReader) -> dict:
     return codec.decode_map(await reader.readexactly(n))
 
 
-async def connect_to_server(host: str, port: int, tls: TlsClientConfig, *,
+async def connect_to_server(host: str, port: int,
+                            tls: TlsClientConfig | None, *,
                             headers: dict[str, str] | None = None,
-                            timeout: float = 15.0) -> MuxConnection:
+                            timeout: float = 15.0,
+                            keepalive_s: float = 30.0,
+                            write_deadline_s: float | None = None
+                            ) -> MuxConnection:
     """Dial + handshake; returns a started MuxConnection (reference:
-    arpc.ConnectToServer with header X-PBS-Plus-BackupID etc.)."""
+    arpc.ConnectToServer with header X-PBS-Plus-BackupID etc.).
+    ``tls=None`` dials plain TCP (loopback simulator mode only)."""
     async def _dial() -> MuxConnection:
         await failpoints.ahit("arpc.transport.connect")
         reader, writer = await asyncio.open_connection(
-            host, port, ssl=tls.context())
+            host, port, ssl=tls.context() if tls is not None else None)
         try:
             await _write_frame(writer, {"headers": headers or {}})
             resp = await _read_frame(reader)
             if not resp.get("ok"):
                 raise HandshakeError(int(resp.get("code", 403)),
                                      str(resp.get("reason", "rejected")))
-            conn = MuxConnection(reader, writer, is_client=True)
+            conn = MuxConnection(reader, writer, is_client=True,
+                                 keepalive_s=keepalive_s,
+                                 write_deadline_s=write_deadline_s)
             conn.start()
             return conn
         except BaseException:
@@ -112,13 +129,21 @@ AcceptFn = Callable[[ssl.SSLObject | None, dict, asyncio.StreamWriter],
 ConnFn = Callable[[MuxConnection, dict, dict], Awaitable[None]]
 
 
-async def serve(host: str, port: int, tls: TlsServerConfig, *,
+async def serve(host: str, port: int, tls: TlsServerConfig | None, *,
                 on_connection: ConnFn,
                 admit: Callable[[dict, dict], Awaitable[tuple[int, str] | None]]
-                | None = None) -> asyncio.AbstractServer:
+                | None = None,
+                keepalive_s: float = 30.0,
+                write_deadline_s: float | None = None
+                ) -> asyncio.AbstractServer:
     """Start the aRPC listener.  ``admit(peer_info, headers)`` returns None
-    to accept or (code, reason) to reject; ``on_connection(conn, peer_info,
-    headers)`` owns the accepted connection (runs as its own task)."""
+    to accept, returns (code, reason) to reject, or raises the typed
+    ``AdmissionRejected`` (agents_manager.py) — both reject forms send the
+    same wire frame; ``on_connection(conn, peer_info, headers)`` owns the
+    accepted connection (runs as its own task).  ``tls=None`` listens on
+    plain TCP and takes the peer CN from the ``X-PBS-Plus-Loopback-CN``
+    header — loopback simulator mode only, never production."""
+    from .agents_manager import AdmissionRejected
 
     async def _client(reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
@@ -132,15 +157,22 @@ async def serve(host: str, port: int, tls: TlsServerConfig, *,
                     for k, v in rdn:
                         if k == "commonName":
                             cn = v
+            hello = await asyncio.wait_for(_read_frame(reader), 15.0)
+            headers = dict(hello.get("headers", {}))
+            if tls is None and not cn:
+                # plain loopback mode: identity is CLAIMED, not proven
+                cn = str(headers.get(HDR_LOOPBACK_CN, ""))
             peer_info = {
                 "cn": cn,
                 "cert_der": sslobj.getpeercert(binary_form=True) if sslobj else b"",
                 "addr": writer.get_extra_info("peername"),
+                "insecure": tls is None,
             }
-            hello = await asyncio.wait_for(_read_frame(reader), 15.0)
-            headers = dict(hello.get("headers", {}))
             if admit is not None:
-                verdict = await admit(peer_info, headers)
+                try:
+                    verdict = await admit(peer_info, headers)
+                except AdmissionRejected as e:
+                    verdict = (e.code, e.reason)
                 if verdict is not None:
                     code, reason = verdict
                     await _write_frame(writer, {"ok": False, "code": code,
@@ -148,7 +180,9 @@ async def serve(host: str, port: int, tls: TlsServerConfig, *,
                     writer.close()
                     return
             await _write_frame(writer, {"ok": True})
-            conn = MuxConnection(reader, writer, is_client=False)
+            conn = MuxConnection(reader, writer, is_client=False,
+                                 keepalive_s=keepalive_s,
+                                 write_deadline_s=write_deadline_s)
             conn.start()
             await on_connection(conn, peer_info, headers)
         except (ConnectionError, asyncio.IncompleteReadError,
@@ -166,6 +200,6 @@ async def serve(host: str, port: int, tls: TlsServerConfig, *,
             else:
                 writer.close()
 
-    server = await asyncio.start_server(_client, host, port,
-                                        ssl=tls.context())
+    server = await asyncio.start_server(
+        _client, host, port, ssl=tls.context() if tls is not None else None)
     return server

@@ -1,0 +1,704 @@
+"""Loopback agent-fleet simulator: hundreds of lightweight simulated
+agents speaking REAL aRPC (mux frames, admission, expect/wait-session,
+agentfs raw streams) against the real jobs/datastore plane, in one
+process (docs/fleet.md).
+
+The reference system is a fleet fabric — AgentsManager, scheduler, job
+queues serving many agents at once — and its overload behavior only
+shows up at scale.  This module makes N=500 a deterministic test: every
+simulated agent is an asyncio peer dialing the server over plain-TCP
+loopback (``transport.serve(tls=None)``; identity via the
+``X-PBS-Plus-Loopback-CN`` header — TLS handshakes are
+tests/test_arpc.py's job and would dominate a 1-core soak), serving a
+deterministic in-memory tree over the REAL agentfs protocol, so every
+layer from mux flow control up through ``RemoteTreeBackup`` and the
+datastore runs exactly its production code.
+
+The soak driver measures enqueue-to-publish latency percentiles,
+session-open admission latency, mux frame throughput, and the maximum
+observed depth of every bounded queue — and supports deterministic
+chaos: a seeded subset of agents hard-kills its transports after N
+agentfs reads (mid-backup), composing the failpoint/chaos discipline
+(PR 3) with checkpointed resume (PR 4) at fleet scale.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..agent.agentfs import AgentFSClient
+from ..arpc import Router, Session, connect_to_server, serve
+from ..arpc.agents_manager import AgentsManager
+from ..arpc.binary_stream import send_data_from_reader
+from ..arpc.call import RawStreamHandler
+from ..arpc.mux import MuxConnection
+from ..arpc.router import HandlerError
+from ..arpc.transport import HDR_LOOPBACK_CN, HandshakeError
+from ..chunker import ChunkerParams
+from ..pxar.backupproxy import LocalStore
+from ..utils.log import L
+from . import checkpoint
+from .backup_job import RemoteTreeBackup
+from .jobs import Job, JobsManager
+
+HDR_BACKUP_ID = "X-PBS-Plus-BackupID"
+
+# fixed timestamp for every synthetic entry: snapshots become
+# bit-reproducible across runs AND stat-identical across an agent
+# restart (checkpoint resume's fast-skip predicate)
+_FIXED_MTIME_NS = 1_700_000_000 * 1_000_000_000
+
+
+@dataclass
+class FleetConfig:
+    n_agents: int = 100
+    tenants: int = 4                     # agents round-robin into tenants
+    files_per_agent: int = 3
+    file_size: int = 8 << 10
+    chunk_avg: int = 4 << 10
+    # server knobs under test
+    max_concurrent: int = 8              # execution slots
+    max_queued: int = 2048               # jobs queue bound (asserted)
+    max_sessions: int = 0                # 0 → 2*n_agents + slack
+    open_rate: float = 0.0               # global session opens/s (0 = off)
+    client_rate: float = 200.0           # per-CN bucket (high: the sim's
+    client_burst: int = 400              # storm is the load, not the test)
+    mux_write_deadline_s: float = 60.0
+    checkpoint_interval: str = ""        # e.g. "1c" arms resumable chaos
+    breaker_threshold: int = 5
+    breaker_reset_s: float = 0.05
+    # chaos: seeded fraction of agents that hard-kill their transports
+    # after kill_after_reads agentfs reads (0.0 = no chaos)
+    kill_fraction: float = 0.0
+    kill_after_reads: int = 3
+    seed: int = 2026
+    connect_concurrency: int = 32        # simultaneous dials in the storm
+    connect_attempts: int = 25           # per-agent retries on 429/503
+    job_timeout_s: float = 300.0
+
+
+def has_checkpoint(store: LocalStore, cn: str) -> bool:
+    """True once a durable checkpoint exists for the agent's group —
+    the chaos driver's crash gate (a kill before any checkpoint would
+    test plain retry, not resume)."""
+    from ..pxar.datastore import SnapshotRef
+    d = checkpoint.group_ckpt_dir(store.datastore,
+                                  SnapshotRef("host", cn, "x", ""))
+    try:
+        return any(n.startswith("ck-") for n in os.listdir(d))
+    except OSError:
+        return False
+
+
+def synthetic_tree(seed: int, agent_idx: int, files: int,
+                   size: int) -> dict[str, bytes]:
+    """Deterministic per-agent tree: same (seed, idx) → same bytes, so
+    chaos-run snapshots can be compared bit-for-bit to a clean run."""
+    import numpy as np
+    rng = np.random.default_rng((seed, agent_idx))
+    return {f"data/f{i:02d}.bin":
+            rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+            for i in range(files)}
+
+
+class SyntheticFS:
+    """In-memory agentfs server over a {relpath: bytes} tree — the same
+    wire protocol as agent/agentfs.AgentFSServer (attr/read_dir/open/
+    read_at raw-stream/close), no disk."""
+
+    def __init__(self, tree: dict[str, bytes], *, on_read=None):
+        self.tree = dict(tree)
+        self._dirs: dict[str, list[str]] = {"": []}
+        for rel in self.tree:
+            parts = rel.split("/")
+            for i in range(len(parts)):
+                parent = "/".join(parts[:i])
+                name = parts[i]
+                self._dirs.setdefault(parent, [])
+                if i < len(parts) - 1:
+                    self._dirs.setdefault("/".join(parts[:i + 1]), [])
+                if name not in self._dirs[parent]:
+                    self._dirs[parent].append(name)
+        self._ino = {p: i + 2 for i, p in
+                     enumerate(sorted(set(self.tree) | set(self._dirs)))}
+        self._handles: dict[int, str] = {}
+        self._next_handle = 1
+        self._on_read = on_read
+        self.reads = 0
+
+    def _entry(self, rel: str) -> dict:
+        name = rel.rsplit("/", 1)[-1] if rel else ""
+        if rel in self.tree:
+            kind, mode, size = "f", 0o644, len(self.tree[rel])
+        elif rel in self._dirs:
+            kind, mode, size = "d", 0o755, 0
+        else:
+            raise HandlerError(f"no such path {rel!r}", status=404)
+        return {"name": name, "kind": kind, "mode": mode, "uid": 0,
+                "gid": 0, "size": size, "mtime_ns": _FIXED_MTIME_NS,
+                "nlink": 1, "ino": self._ino[rel], "dev": 1, "rdev": 0,
+                "target": ""}
+
+    def register(self, router: Router) -> None:
+        router.handle("agentfs.stat_fs", self._stat_fs)
+        router.handle("agentfs.attr", self._attr)
+        router.handle("agentfs.read_dir", self._read_dir)
+        router.handle("agentfs.read_link", self._read_link)
+        router.handle("agentfs.xattrs", self._xattrs)
+        router.handle("agentfs.open", self._open)
+        router.handle("agentfs.read_at", self._read_at)
+        router.handle("agentfs.close", self._close)
+
+    async def _stat_fs(self, req, ctx):
+        total = sum(len(b) for b in self.tree.values())
+        return {"total": total, "free": 0, "files": len(self.tree)}
+
+    async def _attr(self, req, ctx):
+        return self._entry(req.payload.get("path", "").strip("/"))
+
+    async def _read_dir(self, req, ctx):
+        rel = req.payload.get("path", "").strip("/")
+        names = self._dirs.get(rel)
+        if names is None:
+            raise HandlerError(f"not a directory: {rel!r}", status=404)
+        return {"entries": [
+            self._entry(f"{rel}/{n}" if rel else n) for n in sorted(names)]}
+
+    async def _read_link(self, req, ctx):
+        raise HandlerError("no symlinks in synthetic trees", status=404)
+
+    async def _xattrs(self, req, ctx):
+        return {"xattrs": {}}
+
+    async def _open(self, req, ctx):
+        rel = req.payload.get("path", "").strip("/")
+        if rel not in self.tree:
+            raise HandlerError(f"no such file {rel!r}", status=404)
+        h, self._next_handle = self._next_handle, self._next_handle + 1
+        self._handles[h] = rel
+        return {"handle": h}
+
+    async def _read_at(self, req, ctx):
+        rel = self._handles.get(int(req.payload["handle"]))
+        if rel is None:
+            raise HandlerError("bad handle", status=400)
+        self.reads += 1
+        if self._on_read is not None:
+            # chaos hook: a doomed agent hard-kills its transports here
+            # (raises ConnectionResetError after aborting the sockets)
+            await self._on_read(self)
+        off, n = int(req.payload["off"]), int(req.payload["n"])
+        data = self.tree[rel][off:off + n]
+
+        async def pump(stream):
+            await send_data_from_reader(stream, data, len(data))
+        return RawStreamHandler(pump, data={"n": len(data)})
+
+    async def _close(self, req, ctx):
+        self._handles.pop(int(req.payload.get("handle", 0)), None)
+        return {}
+
+
+class SimAgent:
+    """One simulated agent: a control session + on-demand backup job
+    sessions, all over plain-TCP loopback aRPC."""
+
+    def __init__(self, cn: str, host: str, port: int,
+                 tree: dict[str, bytes], *, die_after_reads: int = 0,
+                 crash_gate: Callable[[], bool] | None = None,
+                 connect_attempts: int = 25,
+                 write_deadline_s: float | None = None):
+        self.cn = cn
+        self.host, self.port = host, port
+        self.tree = tree
+        self.die_after_reads = die_after_reads   # 0 = never
+        # structural chaos sync: a doomed agent crashes on the first read
+        # ≥ die_after_reads for which this predicate holds (the driver
+        # gates on "a durable checkpoint exists for my group", so the
+        # kill is mid-backup AND resumable — no sleeps-as-sync)
+        self.crash_gate = crash_gate
+        self.connect_attempts = connect_attempts
+        self.write_deadline_s = write_deadline_s
+        self.conn: Optional[MuxConnection] = None
+        self.dead = False
+        self.connect_latency_s = 0.0     # FIRST successful dial only —
+        #                                  the control session opened
+        #                                  during the contended connect
+        #                                  storm, not later job dials
+        self.connect_rejects = 0         # 429/503 retries on the way in
+        self._jobs: dict[str, tuple[MuxConnection, asyncio.Task]] = {}
+        self._serve_task: Optional[asyncio.Task] = None
+        self._conns: list[MuxConnection] = []
+
+    async def _dial(self, headers: dict[str, str]) -> MuxConnection:
+        """Dial with deterministic backoff on admission rejects (429 rate
+        / 503 capacity) — the agent-side reconnect discipline."""
+        delay = 0.02
+        for attempt in range(self.connect_attempts):
+            try:
+                t0 = time.perf_counter()
+                conn = await connect_to_server(
+                    self.host, self.port, None, headers=headers,
+                    keepalive_s=0,
+                    write_deadline_s=self.write_deadline_s)
+                if not self.connect_latency_s:
+                    self.connect_latency_s = time.perf_counter() - t0
+                self._conns.append(conn)
+                return conn
+            except HandshakeError as e:
+                if e.code not in (429, 503) or \
+                        attempt == self.connect_attempts - 1:
+                    raise
+                self.connect_rejects += 1
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 0.5)
+        raise RuntimeError("unreachable")
+
+    async def start(self) -> None:
+        headers = {HDR_LOOPBACK_CN: self.cn}
+        self.conn = await self._dial(headers)
+        router = Router()
+
+        async def ping(req, ctx):
+            return {"pong": True, "hostname": self.cn}
+
+        async def target_status(req, ctx):
+            return {"ok": True, "path": req.payload.get("path", "/")}
+
+        async def backup(req, ctx):
+            job_id = req.payload["job_id"]
+            if job_id in self._jobs:
+                return {"ok": True, "already": True}
+            jconn = await self._dial({HDR_LOOPBACK_CN: self.cn,
+                                      HDR_BACKUP_ID: job_id})
+            fs = SyntheticFS(self.tree, on_read=self._maybe_crash)
+            job_router = Router()
+            fs.register(job_router)
+            task = asyncio.create_task(job_router.serve_connection(jconn),
+                                       name=f"simjob:{self.cn}:{job_id}")
+            self._jobs[job_id] = (jconn, task)
+            return {"ok": True, "snapshot_method": "sim"}
+
+        async def cleanup(req, ctx):
+            job = self._jobs.pop(req.payload.get("job_id", ""), None)
+            if job is not None:
+                jconn, task = job
+                await jconn.close()
+                task.cancel()
+            return {"ok": True}
+
+        router.handle("ping", ping)
+        router.handle("target_status", target_status)
+        router.handle("backup", backup)
+        router.handle("cleanup", cleanup)
+        self._serve_task = asyncio.create_task(
+            router.serve_connection(self.conn), name=f"simagent:{self.cn}")
+
+    async def _maybe_crash(self, fs: SyntheticFS) -> None:
+        if self.die_after_reads and fs.reads >= self.die_after_reads \
+                and not self.dead \
+                and (self.crash_gate is None or self.crash_gate()):
+            self.crash()
+            raise ConnectionResetError(
+                f"simulated agent {self.cn} crashed mid-backup")
+
+    def crash(self) -> None:
+        """Simulated process death: abort every transport (no FIN, no
+        cleanup RPC) — the server must notice via its disconnect watch."""
+        self.dead = True
+        for conn in self._conns:
+            try:
+                conn.writer.transport.abort()
+            except Exception as e:       # already-dead transport
+                L.debug("sim crash abort: %s", e)
+
+    async def stop(self) -> None:
+        for job_id in list(self._jobs):
+            jconn, task = self._jobs.pop(job_id)
+            await jconn.close()
+            task.cancel()
+        if self._serve_task is not None:
+            self._serve_task.cancel()
+        if self.conn is not None:
+            await self.conn.close()
+
+    def mux_stats(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for conn in self._conns:
+            for k, v in conn.stats.items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+
+class FleetServer:
+    """The server side of the simulation: real AgentsManager admission,
+    real JobsManager fairness, real datastore sessions — reached over
+    real mux connections (the production ``Server`` minus DB/TLS/web)."""
+
+    def __init__(self, datastore_dir: str, cfg: FleetConfig):
+        self.cfg = cfg
+        max_sessions = cfg.max_sessions or (2 * cfg.n_agents + 16)
+        self.agents = AgentsManager(
+            is_expected=None, rate=cfg.client_rate, burst=cfg.client_burst,
+            max_sessions=max_sessions, open_rate=cfg.open_rate)
+        self.jobs = JobsManager(max_concurrent=cfg.max_concurrent,
+                                max_queued=cfg.max_queued)
+        self.store = LocalStore(datastore_dir,
+                                ChunkerParams(avg_size=cfg.chunk_avg))
+        self.router = Router()
+
+        async def ping(req, ctx):
+            return {"pong": True}
+        self.router.handle("ping", ping)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.conns: list[MuxConnection] = []
+        self.port = 0
+
+    async def start(self) -> int:
+        async def on_connection(conn, peer, headers):
+            self.conns.append(conn)
+            sess = await self.agents.register(peer, headers, conn)
+            try:
+                await self.router.serve_connection(conn, context=sess)
+            finally:
+                await self.agents.unregister(sess)
+
+        self._server = await serve(
+            "127.0.0.1", 0, None, on_connection=on_connection,
+            admit=self.agents.admit, keepalive_s=0,
+            write_deadline_s=self.cfg.mux_write_deadline_s)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for sess in self.agents.sessions():
+            await sess.conn.close()
+
+    def mux_stats(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for conn in self.conns:
+            for k, v in conn.stats.items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    # -- the backup data plane (run_backup_job minus the DB rows) ----------
+    async def backup_once(self, cn: str, job_id: str) -> dict:
+        control = self.agents.get(cn)
+        if control is None:
+            raise ConnectionError(f"agent {cn!r} not connected")
+        control_sess = Session(control.conn)
+        st = await control_sess.call("target_status", {"path": "/"})
+        if not st.data.get("ok"):
+            raise RuntimeError(f"target path unavailable: {st.data}")
+        client_id = f"{cn}|{job_id}"
+        self.agents.expect(client_id)
+        try:
+            await control_sess.call(
+                "backup", {"job_id": job_id, "source": "/"}, timeout=120)
+            job_sess = await self.agents.wait_session(client_id, timeout=60)
+            fs = AgentFSClient(Session(job_sess.conn))
+            loop = asyncio.get_running_loop()
+            resume_ctx = None
+            if self.cfg.checkpoint_interval:
+                resume_ctx = await loop.run_in_executor(
+                    None, lambda: checkpoint.open_resume(
+                        self.store, backup_type="host", backup_id=cn))
+            session_kw = {"previous_reader": resume_ctx[0]} \
+                if resume_ctx else {}
+            session = await loop.run_in_executor(
+                None, lambda: self.store.start_session(
+                    backup_type="host", backup_id=cn, **session_kw))
+            try:
+                if resume_ctx is not None:
+                    session.resume_plan = resume_ctx[1]
+                if self.cfg.checkpoint_interval:
+                    await loop.run_in_executor(
+                        None, lambda: checkpoint.attach(
+                            session, self.cfg.checkpoint_interval))
+                pump = RemoteTreeBackup(fs, session)
+                disc = self.agents.watch_disconnect(job_sess)
+                pump_task = asyncio.ensure_future(pump.run())
+                try:
+                    await asyncio.wait({pump_task, disc},
+                                       return_when=asyncio.FIRST_COMPLETED)
+                    if not pump_task.done():
+                        pump_task.cancel()
+                        await asyncio.gather(pump_task,
+                                             return_exceptions=True)
+                        raise ConnectionError(
+                            f"agent job session lost mid-backup "
+                            f"({client_id})")
+                    result = await pump_task
+                finally:
+                    self.agents.unwatch_disconnect(job_sess, disc)
+                    if not disc.done():
+                        disc.cancel()
+                    if not pump_task.done():
+                        pump_task.cancel()
+                        await asyncio.gather(pump_task,
+                                             return_exceptions=True)
+                manifest = await loop.run_in_executor(
+                    None, session.finish, {"job": job_id})
+                if self.cfg.checkpoint_interval:
+                    await loop.run_in_executor(
+                        None, lambda: checkpoint.clear(
+                            self.store.datastore, "host", cn, ""))
+                return {"ref": session.ref, "manifest": manifest,
+                        "entries": result.entries,
+                        "bytes": result.bytes_total,
+                        "resumed": resume_ctx is not None}
+            except BaseException:
+                session.abort()
+                raise
+        finally:
+            self.agents.unexpect(client_id)
+            sess_info = self.agents.get(client_id)
+            if sess_info is not None:
+                try:
+                    await sess_info.conn.close()
+                except Exception as e:
+                    L.debug("sim job session close: %s", e)
+            if not control.conn.closed:
+                try:
+                    await control_sess.call("cleanup", {"job_id": job_id},
+                                            timeout=15)
+                except Exception as e:
+                    L.debug("sim cleanup rpc failed: %s", e)
+
+
+@dataclass
+class FleetReport:
+    cfg: FleetConfig
+    published: int = 0
+    failed: int = 0
+    resumed: int = 0
+    requeued: int = 0
+    wall_s: float = 0.0
+    enq_to_pub_s: list = field(default_factory=list)
+    session_open_s: list = field(default_factory=list)
+    admission: dict = field(default_factory=dict)
+    connect_rejects: int = 0
+    mux_server: dict = field(default_factory=dict)
+    mux_agents: dict = field(default_factory=dict)
+    queued_max: int = 0
+    running_max: int = 0
+    sessions_max: int = 0
+    queue_bound: int = 0
+    bound_violated: bool = False
+    refs: dict = field(default_factory=dict)      # cn → SnapshotRef
+    failures: dict = field(default_factory=dict)  # cn → error string
+    breaker_states: dict = field(default_factory=dict)
+    # per-target breaker states right after round 1 (before the resume
+    # round closes them again): the chaos test's "breakers open
+    # per-target only" witness
+    breaker_states_round1: dict = field(default_factory=dict)
+    killed: set = field(default_factory=set)       # cns that crashed
+
+    @staticmethod
+    def _pct(xs: list, q: float) -> float:
+        if not xs:
+            return 0.0
+        ys = sorted(xs)
+        return ys[min(len(ys) - 1, int(round(q * (len(ys) - 1))))]
+
+    def to_dict(self) -> dict:
+        frames = self.mux_server.get("frames_tx", 0) + \
+            self.mux_server.get("frames_rx", 0)
+        return {
+            "n_agents": self.cfg.n_agents,
+            "tenants": self.cfg.tenants,
+            "published": self.published,
+            "failed": self.failed,
+            "resumed": self.resumed,
+            "requeued": self.requeued,
+            "wall_s": round(self.wall_s, 3),
+            "enqueue_to_publish_p50_s": round(
+                self._pct(self.enq_to_pub_s, 0.50), 4),
+            "enqueue_to_publish_p99_s": round(
+                self._pct(self.enq_to_pub_s, 0.99), 4),
+            "session_open_p50_s": round(
+                self._pct(self.session_open_s, 0.50), 5),
+            "session_open_p99_s": round(
+                self._pct(self.session_open_s, 0.99), 5),
+            "admission": dict(self.admission),
+            "admission_rejected": sum(
+                v for k, v in self.admission.items() if k != "admitted"),
+            "connect_rejects_seen_by_agents": self.connect_rejects,
+            "mux_frames_total": frames,
+            "mux_frames_per_s": round(frames / self.wall_s, 1)
+            if self.wall_s else 0.0,
+            "mux_bytes_tx": self.mux_server.get("bytes_tx", 0),
+            "mux_bytes_rx": self.mux_server.get("bytes_rx", 0),
+            "write_deadline_sheds": self.mux_server.get(
+                "write_deadline_sheds", 0) + self.mux_agents.get(
+                "write_deadline_sheds", 0),
+            "flow_violations": self.mux_server.get("flow_violations", 0)
+            + self.mux_agents.get("flow_violations", 0),
+            "syn_rejects": self.mux_server.get("syn_rejects", 0)
+            + self.mux_agents.get("syn_rejects", 0),
+            "queue_bound": self.queue_bound,
+            "queued_max": self.queued_max,
+            "running_max": self.running_max,
+            "sessions_max": self.sessions_max,
+            "bound_violated": self.bound_violated,
+        }
+
+
+async def run_fleet_async(datastore_dir: str,
+                          cfg: FleetConfig) -> FleetReport:
+    """Connect cfg.n_agents simulated agents, run one synthetic backup
+    per agent through the real jobs plane (fair dequeue, breakers,
+    bounded queue), re-enqueue chaos-killed jobs once as resumable, and
+    report latency/throughput/bound observations."""
+    import random
+    rng = random.Random(cfg.seed)
+    report = FleetReport(cfg=cfg, queue_bound=cfg.max_queued)
+    server = FleetServer(datastore_dir, cfg)
+    port = await server.start()
+    doomed = set()
+    if cfg.kill_fraction > 0:
+        k = max(1, int(cfg.n_agents * cfg.kill_fraction))
+        doomed = set(rng.sample(range(cfg.n_agents), k))
+
+    trees = {i: synthetic_tree(cfg.seed, i, cfg.files_per_agent,
+                               cfg.file_size)
+             for i in range(cfg.n_agents)}
+    agents: dict[str, SimAgent] = {}
+
+    def make_agent(i: int, *, chaos: bool) -> SimAgent:
+        cn = f"sim-{i:04d}"
+        gate = None
+        if chaos and cfg.checkpoint_interval:
+            # crash only once a checkpoint exists: the kill then proves
+            # RESUME at scale, not just retry-from-zero
+            gate = lambda: has_checkpoint(server.store, cn)  # noqa: E731
+        return SimAgent(
+            cn, "127.0.0.1", port, trees[i],
+            die_after_reads=cfg.kill_after_reads if chaos else 0,
+            crash_gate=gate,
+            connect_attempts=cfg.connect_attempts,
+            write_deadline_s=cfg.mux_write_deadline_s)
+
+    t_start = time.perf_counter()
+
+    # -- connect storm, bounded concurrency --------------------------------
+    gate = asyncio.Semaphore(cfg.connect_concurrency)
+
+    async def connect_one(i: int) -> None:
+        async with gate:
+            a = make_agent(i, chaos=i in doomed)
+            await a.start()
+            agents[a.cn] = a
+
+    results = await asyncio.gather(
+        *(connect_one(i) for i in range(cfg.n_agents)),
+        return_exceptions=True)
+    connect_errors = [r for r in results if isinstance(r, BaseException)]
+    if connect_errors:
+        raise RuntimeError(
+            f"{len(connect_errors)} agents failed to connect; first: "
+            f"{connect_errors[0]!r}") from connect_errors[0]
+
+    # -- queue-depth sampler (the bound assertion's witness) ---------------
+    stop_sampling = asyncio.Event()
+
+    async def sampler() -> None:
+        while not stop_sampling.is_set():
+            report.queued_max = max(report.queued_max,
+                                    server.jobs.queued_count)
+            report.running_max = max(report.running_max,
+                                     server.jobs.running_count)
+            report.sessions_max = max(report.sessions_max,
+                                      len(server.agents.sessions()))
+            if cfg.max_queued > 0 and \
+                    server.jobs.queued_count > cfg.max_queued:
+                report.bound_violated = True
+            try:
+                await asyncio.wait_for(stop_sampling.wait(), 0.01)
+            except asyncio.TimeoutError:
+                pass
+    sampler_task = asyncio.create_task(sampler(), name="fleet-sampler")
+
+    # -- enqueue one backup per agent --------------------------------------
+    enqueue_ts: dict[str, float] = {}
+
+    def submit(cn: str, idx: int, job_id: str) -> None:
+        tenant = f"tenant-{idx % max(1, cfg.tenants)}"
+        breaker = server.jobs.breaker(
+            f"agent:{cn}", failure_threshold=cfg.breaker_threshold,
+            reset_timeout_s=cfg.breaker_reset_s)
+
+        async def execute():
+            res = await breaker.call(
+                lambda: server.backup_once(cn, job_id))
+            report.published += 1
+            report.refs[cn] = res["ref"]
+            if res["resumed"]:
+                report.resumed += 1
+            report.enq_to_pub_s.append(
+                time.perf_counter() - enqueue_ts[cn])
+            report.failures.pop(cn, None)
+
+        async def on_error(exc: BaseException):
+            report.failed += 1
+            report.failures[cn] = f"{type(exc).__name__}: {exc}"
+
+        enqueue_ts[cn] = time.perf_counter()
+        server.jobs.enqueue(Job(id=f"backup:{cn}", kind="backup",
+                                tenant=tenant, execute=execute,
+                                on_error=on_error))
+
+    for i in range(cfg.n_agents):
+        submit(f"sim-{i:04d}", i, f"job-{i:04d}-r1")
+    await server.jobs.drain(timeout=cfg.job_timeout_s)
+    report.breaker_states_round1 = {
+        k: cb.state for k, cb in server.jobs._breakers.items()}
+    report.killed = {a.cn for a in agents.values() if a.dead}
+
+    # -- chaos round 2: killed agents restart, jobs re-enqueue resumable ---
+    if report.failures:
+        # let per-target breakers reach half-open so the re-enqueued job
+        # is the single admitted probe (utils/resilience.py discipline)
+        await asyncio.sleep(cfg.breaker_reset_s * 1.5)
+        for cn in sorted(report.failures):
+            i = int(cn.split("-")[1])
+            old = agents.get(cn)
+            if old is not None and old.dead:
+                a = make_agent(i, chaos=False)     # restarted process
+                await a.start()
+                agents[cn] = a
+            report.requeued += 1
+            submit(cn, i, f"job-{i:04d}-r2")
+        await server.jobs.drain(timeout=cfg.job_timeout_s)
+
+    report.wall_s = time.perf_counter() - t_start
+    stop_sampling.set()
+    await sampler_task
+
+    report.session_open_s = [a.connect_latency_s for a in agents.values()]
+    report.connect_rejects = sum(a.connect_rejects
+                                 for a in agents.values())
+    report.admission = server.agents.admission_stats()
+    report.mux_server = server.mux_stats()
+    for a in agents.values():
+        for k, v in a.mux_stats().items():
+            report.mux_agents[k] = report.mux_agents.get(k, 0) + v
+    report.breaker_states = {k: cb.state
+                             for k, cb in server.jobs._breakers.items()}
+
+    for a in agents.values():
+        await a.stop()
+    await server.stop()
+    return report
+
+
+def run_fleet(datastore_dir: str, cfg: FleetConfig) -> FleetReport:
+    """Sync wrapper: one fresh event loop per soak."""
+    return asyncio.run(run_fleet_async(datastore_dir, cfg))

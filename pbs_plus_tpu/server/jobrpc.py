@@ -81,11 +81,15 @@ class JobRPCServer:
             started = s.enqueue_backup(req["job_id"])
             return {"ok": True, "started": started}
         if op == "restore_queue":
+            from .jobs import QueueFullError
             from .restore_job import enqueue_restore
-            rid = enqueue_restore(
-                s, target=req["target"], snapshot=req["snapshot"],
-                destination=req["destination"],
-                subpath=req.get("subpath", ""))
+            try:
+                rid = enqueue_restore(
+                    s, target=req["target"], snapshot=req["snapshot"],
+                    destination=req["destination"],
+                    subpath=req.get("subpath", ""))
+            except QueueFullError as e:
+                return {"ok": False, "error": str(e)}
             return {"ok": True, "restore_id": rid}
         if op == "status":
             row = s.db.get_backup_job(req["job_id"])

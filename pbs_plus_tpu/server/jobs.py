@@ -1,15 +1,27 @@
-"""Jobs manager: dedup by id, queue, concurrency gate, lifecycle hooks.
+"""Jobs manager: dedup by id, bounded queue, per-tenant fair dequeue.
 
 Reference: internal/server/jobs/manager.go:12-203 — Job = {ID, PreExec,
 Execute, OnSuccess, OnError, Cleanup}; dedup by ID; dynamic-capacity queue
 + executionSem concurrency gate (RAM-derived, conf.max_concurrent_clients);
 PreExec runs BEFORE acquiring the execution slot (mount while queued);
 StartupMu serializes client startups.
+
+Fleet-scale additions (docs/fleet.md "Fairness"): execution slots are
+granted round-robin ACROSS tenants (strict ``Job.priority`` classes
+first, RR within a class), so one noisy tenant enqueuing hundreds of
+jobs cannot starve another tenant's single job — with a plain FIFO
+semaphore the victim waits behind the entire noisy backlog; under RR it
+waits at most one slot-grant cycle.  The queue itself is bounded
+(``max_queued``, conf ``PBS_PLUS_MAX_QUEUED_JOBS``): enqueues past the
+bound fast-fail with the typed ``QueueFullError`` instead of accepting
+unbounded work the server cannot start.
 """
 
 from __future__ import annotations
 
 import asyncio
+import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Optional
 
@@ -19,11 +31,25 @@ from ..utils.resilience import CircuitBreaker
 
 AsyncFn = Callable[[], Awaitable[None]]
 
+# breaker-registry hygiene: prune cadence, default cap, and how long a
+# CLOSED breaker may sit unused before it is evictable (an open/half-open
+# breaker is live protective state and is never evicted)
+_BREAKER_PRUNE_INTERVAL_S = 60.0
+DEFAULT_MAX_BREAKERS = 1024
+DEFAULT_BREAKER_IDLE_EVICT_S = 3600.0
+
+
+class QueueFullError(RuntimeError):
+    """Typed fast-fail: the jobs queue is at its configured bound."""
+
 
 @dataclass
 class Job:
     id: str
     kind: str = "backup"
+    tenant: str = ""                          # fairness lane (target CN);
+                                              # "" = shared default lane
+    priority: int = 0                         # strict class: lower first
     pre_exec: Optional[AsyncFn] = None        # runs before the exec slot
     execute: Optional[AsyncFn] = None
     on_success: Optional[AsyncFn] = None
@@ -32,17 +58,32 @@ class Job:
 
 
 class JobsManager:
-    def __init__(self, *, max_concurrent: int | None = None):
+    def __init__(self, *, max_concurrent: int | None = None,
+                 max_queued: int | None = None,
+                 max_breakers: int = DEFAULT_MAX_BREAKERS,
+                 breaker_idle_evict_s: float = DEFAULT_BREAKER_IDLE_EVICT_S):
         self.max_concurrent = max_concurrent or conf.max_concurrent_clients()
-        self._sem = asyncio.Semaphore(self.max_concurrent)
+        self.max_queued = (conf.env().max_queued_jobs if max_queued is None
+                           else max_queued)
+        self._slots_free = self.max_concurrent
+        # fair gate state: per-tenant FIFO of (future, job) waiters plus
+        # the tenant round-robin ring (invariant: a tenant is in _rr iff
+        # it has an entry in _waiting)
+        self._waiting: dict[str, deque] = {}
+        self._rr: deque[str] = deque()
+        self._queued = 0                      # enqueued, no exec slot yet
+        self._tenant_running: dict[str, int] = {}
         self._active: dict[str, asyncio.Task] = {}
         self._startup_mu = asyncio.Lock()      # reference: StartupMu
         # per-key circuit breakers (keyed "agent:<target>" by the backup
         # path): a dead agent fails fast instead of burning the
         # scheduler's retry budget on every tick
         self._breakers: dict[str, CircuitBreaker] = {}
+        self.max_breakers = max_breakers
+        self.breaker_idle_evict_s = breaker_idle_evict_s
+        self._last_breaker_prune = time.monotonic()
         self.stats = {"enqueued": 0, "completed": 0, "failed": 0,
-                      "deduped": 0, "resumed": 0}
+                      "deduped": 0, "resumed": 0, "rejected_full": 0}
 
     def note_resumed(self) -> None:
         """A backup completed from a durable checkpoint instead of byte
@@ -51,32 +92,94 @@ class JobsManager:
 
     def enqueue(self, job: Job) -> bool:
         """Returns False if a job with the same id is already active
-        (reference dedup-by-ID, manager.go:61)."""
+        (reference dedup-by-ID, manager.go:61); raises the typed
+        ``QueueFullError`` when ``max_queued`` jobs are already waiting
+        for an execution slot — admission control over accepting work
+        the server cannot start."""
         if job.id in self._active:
             self.stats["deduped"] += 1
             return False
+        if self.max_queued > 0 and self._queued >= self.max_queued:
+            self.stats["rejected_full"] += 1
+            raise QueueFullError(
+                f"jobs queue full ({self._queued}/{self.max_queued} "
+                f"queued); rejecting {job.id!r}")
         task = asyncio.create_task(self._run(job), name=f"job:{job.id}")
         self._active[job.id] = task
+        self._queued += 1
         self.stats["enqueued"] += 1
         return True
 
+    # -- circuit breakers --------------------------------------------------
     def breaker(self, key: str, *, failure_threshold: int = 5,
                 reset_timeout_s: float = 30.0) -> CircuitBreaker:
-        """Per-key CircuitBreaker, created on first use (thresholds only
-        apply at creation; later callers share the existing circuit)."""
+        """Per-key CircuitBreaker, created on first use.  Thresholds only
+        apply at creation; a later caller requesting DIFFERENT thresholds
+        for an existing key gets the existing circuit and a warning (the
+        silent-ignore was easy to misread as reconfiguration)."""
         cb = self._breakers.get(key)
-        if cb is None:
-            cb = self._breakers[key] = CircuitBreaker(
-                failure_threshold=failure_threshold,
-                reset_timeout_s=reset_timeout_s, name=key)
+        if cb is not None:
+            if (cb.failure_threshold != failure_threshold
+                    or cb.reset_timeout_s != reset_timeout_s):
+                L.warning(
+                    "breaker %r already exists with thresholds "
+                    "(%d, %.1fs); requested (%d, %.1fs) ignored",
+                    key, cb.failure_threshold, cb.reset_timeout_s,
+                    failure_threshold, reset_timeout_s)
+            return cb
+        self._maybe_prune_breakers(time.monotonic())
+        cb = self._breakers[key] = CircuitBreaker(
+            failure_threshold=failure_threshold,
+            reset_timeout_s=reset_timeout_s, name=key)
         return cb
 
+    @property
+    def breaker_count(self) -> int:
+        return len(self._breakers)
+
+    def _maybe_prune_breakers(self, now: float) -> None:
+        """Evict closed, long-idle breakers so the registry cannot grow
+        one entry per target EVER seen.  Open/half-open breakers are
+        live protective state — never evicted, whatever their age."""
+        if (len(self._breakers) < self.max_breakers
+                and now - self._last_breaker_prune
+                < _BREAKER_PRUNE_INTERVAL_S):
+            return
+        self._last_breaker_prune = now
+        dead = [k for k, cb in self._breakers.items()
+                if cb.state == "closed"
+                and now - cb.last_used >= self.breaker_idle_evict_s]
+        for k in dead:
+            del self._breakers[k]
+        if len(self._breakers) >= self.max_breakers:
+            # still over cap: evict the coldest CLOSED breakers
+            closed = sorted((cb.last_used, k)
+                            for k, cb in self._breakers.items()
+                            if cb.state == "closed")
+            excess = len(self._breakers) - self.max_breakers + 1
+            for _, k in closed[:excess]:
+                del self._breakers[k]
+
+    # -- introspection -----------------------------------------------------
     def is_active(self, job_id: str) -> bool:
         return job_id in self._active
 
     @property
     def active_count(self) -> int:
         return len(self._active)
+
+    @property
+    def queued_count(self) -> int:
+        """Jobs admitted but not yet holding an execution slot."""
+        return self._queued
+
+    @property
+    def running_count(self) -> int:
+        return self.max_concurrent - self._slots_free
+
+    def tenant_active(self) -> dict[str, int]:
+        """tenant → jobs currently holding an execution slot."""
+        return {t: n for t, n in self._tenant_running.items() if n > 0}
 
     async def wait(self, job_id: str, timeout: float | None = None) -> None:
         t = self._active.get(job_id)
@@ -97,17 +200,92 @@ class JobsManager:
                 "job raised while being cancelled: %s", e)
         return True
 
+    # -- fair slot gate ----------------------------------------------------
+    def _pump(self) -> None:
+        while self._slots_free > 0 and self._grant_next():
+            self._slots_free -= 1
+
+    def _grant_next(self) -> bool:
+        """Grant one slot: strict priority across the waiting tenants'
+        HEAD jobs, round-robin within the winning class.  Returns False
+        when no live waiter exists."""
+        best: tuple[int, str] | None = None
+        for t in list(self._rr):
+            dq = self._waiting.get(t)
+            while dq and dq[0][0].done():       # cancelled leftovers
+                dq.popleft()
+            if not dq:
+                del self._waiting[t]
+                self._rr.remove(t)
+                continue
+            p = dq[0][1].priority
+            if best is None or p < best[0]:
+                best = (p, t)
+        if best is None:
+            return False
+        t = best[1]
+        dq = self._waiting[t]
+        fut, _job = dq.popleft()
+        self._rr.remove(t)
+        if dq:
+            self._rr.append(t)                  # rotate: back of the ring
+        else:
+            del self._waiting[t]
+        fut.set_result(None)
+        return True
+
+    async def _acquire_slot(self, job: Job) -> None:
+        if self._slots_free > 0 and not self._waiting:
+            self._slots_free -= 1
+            return
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        if job.tenant not in self._waiting:
+            self._waiting[job.tenant] = deque()
+            self._rr.append(job.tenant)
+        self._waiting[job.tenant].append((fut, job))
+        self._pump()
+        try:
+            await fut
+        except asyncio.CancelledError:
+            if fut.done() and not fut.cancelled():
+                # granted concurrently with the cancel: return the slot
+                self._release_slot(job, counted=False)
+            raise
+
+    def _release_slot(self, job: Job, *, counted: bool = True) -> None:
+        if counted:
+            n = self._tenant_running.get(job.tenant, 0) - 1
+            if n > 0:
+                self._tenant_running[job.tenant] = n
+            else:
+                self._tenant_running.pop(job.tenant, None)
+        self._slots_free += 1
+        self._pump()
+
+    # -- lifecycle ---------------------------------------------------------
     async def _run(self, job: Job) -> None:
         log = L.with_scope(job_id=job.id, kind=job.kind)
         failed: BaseException | None = None
+        dequeued = got_slot = False
+
+        def _dequeue() -> None:
+            nonlocal dequeued
+            if not dequeued:
+                dequeued = True
+                self._queued -= 1
+
         try:
             if job.pre_exec is not None:
                 # before the execution slot: target mounts while queued
                 await job.pre_exec()
-            async with self._sem:
-                await failpoints.ahit("server.job.execute")
-                if job.execute is not None:
-                    await job.execute()
+            await self._acquire_slot(job)
+            got_slot = True
+            _dequeue()
+            self._tenant_running[job.tenant] = \
+                self._tenant_running.get(job.tenant, 0) + 1
+            await failpoints.ahit("server.job.execute")
+            if job.execute is not None:
+                await job.execute()
         except asyncio.CancelledError as e:
             failed = e
             log.warning("job cancelled")
@@ -115,6 +293,9 @@ class JobsManager:
             failed = e
             log.exception("job failed")
         finally:
+            if got_slot:
+                self._release_slot(job)
+            _dequeue()
             try:
                 if failed is None:
                     self.stats["completed"] += 1

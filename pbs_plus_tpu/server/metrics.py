@@ -85,8 +85,33 @@ class MetricsRegistry:
               [({}, float(s.jobs.active_count))])
         gauge("pbs_plus_jobs_total", "Job counters",
               [({"result": k}, float(v)) for k, v in s.jobs.stats.items()])
+        n_sessions = float(len(s.agents.sessions()))
         gauge("pbs_plus_agents_connected", "Connected agent sessions",
-              [({}, float(len(s.agents.sessions())))])
+              [({}, n_sessions)])
+
+        # -- fleet admission / queueing (docs/fleet.md) ----------------------
+        gauge("pbs_plus_jobs_queued",
+              "Jobs admitted but not yet holding an execution slot",
+              [({}, float(s.jobs.queued_count))])
+        gauge("pbs_plus_jobs_running",
+              "Jobs currently holding an execution slot",
+              [({}, float(s.jobs.running_count))])
+        gauge("pbs_plus_jobs_active_by_tenant",
+              "Executing jobs per fairness tenant",
+              [({"tenant": t}, float(n))
+               for t, n in sorted(s.jobs.tenant_active().items())])
+        gauge("pbs_plus_sessions_active", "Registered agent sessions "
+              "(alias of pbs_plus_agents_connected, named for the "
+              "admission ceiling agent_max_sessions it is gauged against)",
+              [({}, n_sessions)])
+        adm = s.agents.admission_stats()
+        gauge("pbs_plus_admission_rejected_total",
+              "Session admissions rejected, by reason",
+              [({"reason": k}, float(v))
+               for k, v in sorted(adm.items()) if k != "admitted"])
+        gauge("pbs_plus_admission_admitted_total",
+              "Session admissions accepted",
+              [({}, float(adm.get("admitted", 0)))])
 
         snaps = s.datastore.datastore.list_snapshots(all_namespaces=True)
         gauge("pbs_plus_snapshots_total", "Snapshots in the datastore",

@@ -124,6 +124,13 @@ def enqueue_restore(server, *, target: str, snapshot: str,
         server.db.append_task_log(upid, f"error: {exc}")
         server.db.finish_task(upid, database.STATUS_ERROR)
 
-    server.jobs.enqueue(Job(id=rid, kind="restore", execute=execute,
-                            on_success=on_success, on_error=on_error))
+    from .jobs import QueueFullError
+    try:
+        server.jobs.enqueue(Job(id=rid, kind="restore", tenant=target,
+                                execute=execute, on_success=on_success,
+                                on_error=on_error))
+    except QueueFullError as e:
+        server.db.append_task_log(upid, f"error: {e}")
+        server.db.finish_task(upid, database.STATUS_ERROR)
+        raise
     return rid

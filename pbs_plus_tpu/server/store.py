@@ -25,7 +25,7 @@ from ..utils.mtls import CertManager
 from . import database
 from .backup_job import (make_batch_hasher, make_chunker_factory,
                          run_target_backup)
-from .jobs import Job, JobsManager
+from .jobs import Job, JobsManager, QueueFullError
 from .scheduler import Scheduler
 
 
@@ -107,6 +107,18 @@ class ServerConfig:
     # (0 = auto: min(8, cores); 1 = sequential)
     chunk_cache_mb: int = -1
     verify_workers: int = 0
+    # fleet admission + queueing (docs/fleet.md): per-client session-open
+    # token bucket, global opens/s bucket, concurrent-session ceiling
+    # (AgentsManager), and the jobs waiting-queue bound (JobsManager,
+    # QueueFullError past it).  Negative values fall back to the
+    # corresponding PBS_PLUS_AGENT_RATE / PBS_PLUS_AGENT_BURST /
+    # PBS_PLUS_AGENT_OPEN_RATE / PBS_PLUS_AGENT_MAX_SESSIONS /
+    # PBS_PLUS_MAX_QUEUED_JOBS environment knobs (utils/conf.py)
+    agent_rate: float = -1.0
+    agent_burst: int = -1
+    agent_open_rate: float = -1.0
+    agent_max_sessions: int = -1
+    max_queued_jobs: int = -1
 
 
 class Server:
@@ -124,8 +136,18 @@ class Server:
         self.certs.load_or_create_ca()
         self.certs.validate()
         self.certs.ensure_server_identity(config.hostname)
-        self.agents = AgentsManager(is_expected=self._is_expected_host)
-        self.jobs = JobsManager(max_concurrent=config.max_concurrent)
+        self.agents = AgentsManager(
+            is_expected=self._is_expected_host,
+            rate=None if config.agent_rate < 0 else config.agent_rate,
+            burst=None if config.agent_burst < 0 else config.agent_burst,
+            open_rate=(None if config.agent_open_rate < 0
+                       else config.agent_open_rate),
+            max_sessions=(None if config.agent_max_sessions < 0
+                          else config.agent_max_sessions))
+        self.jobs = JobsManager(
+            max_concurrent=config.max_concurrent,
+            max_queued=(None if config.max_queued_jobs < 0
+                        else config.max_queued_jobs))
         if config.chunk_cache_mb >= 0:
             from ..pxar import chunkcache
             chunkcache.configure_shared(
@@ -600,9 +622,36 @@ class Server:
             await self._post_hook(result_box.get("row", row),
                                   database.STATUS_ERROR, error=str(exc))
 
-        return self.jobs.enqueue(Job(
-            id=f"backup:{row.id}", kind="backup",
-            execute=execute, on_success=on_success, on_error=on_error))
+        try:
+            # tenant = target CN: the fair dequeue's lane, so one noisy
+            # tenant's backlog cannot starve another's single job
+            return self.jobs.enqueue(Job(
+                id=f"backup:{row.id}", kind="backup", tenant=row.target,
+                execute=execute, on_success=on_success, on_error=on_error))
+        except QueueFullError as e:
+            # typed fast-fail admission: record it as this run's failure
+            # instead of letting the exception abort the scheduler tick —
+            # with full on_error parity (notification + post-script), so
+            # shed backups are as loud as failed ones
+            self.log.warning("backup %s rejected: %s", row.id, e)
+            self.db.append_task_log(upid, f"error: {e}")
+            self.db.finish_task(upid, database.STATUS_ERROR)
+            self.db.record_backup_result(row.id, database.STATUS_ERROR,
+                                         error=str(e))
+            if self.notifications is not None:
+                self.notifications.record(row.id, database.STATUS_ERROR,
+                                          detail=str(e))
+            try:
+                # enqueue_backup is sync; fire the async post-script the
+                # way on_error would have (callers all hold a loop)
+                asyncio.get_running_loop().create_task(
+                    self._post_hook(row, database.STATUS_ERROR,
+                                    error=str(e)))
+            except RuntimeError:
+                self.log.warning(
+                    "no running loop; post-hook skipped for rejected "
+                    "backup %s", row.id)
+            return False
 
     async def _enqueue_verification(self, v: dict) -> None:
         from .verification_job import enqueue_verification

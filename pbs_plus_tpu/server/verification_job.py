@@ -168,6 +168,16 @@ def enqueue_verification(server, v: dict) -> bool:
     async def on_error(exc):
         server.db.finish_task(upid, database.STATUS_ERROR)
 
-    return server.jobs.enqueue(
-        Job(id=f"verify:{vid}", kind="verify", execute=execute,
-            on_error=on_error))
+    from .jobs import QueueFullError
+    try:
+        # one SHARED fairness lane for all verification jobs: a verify
+        # config has no single target CN, and giving each config its own
+        # lane would let 50 scheduled verifications crowd a backup
+        # tenant out of 50/51 slot grants (docs/fleet.md "Fairness")
+        return server.jobs.enqueue(
+            Job(id=f"verify:{vid}", kind="verify", tenant="verify",
+                execute=execute, on_error=on_error))
+    except QueueFullError as e:
+        server.db.append_task_log(upid, f"error: {e}")
+        server.db.finish_task(upid, database.STATUS_ERROR)
+        return False

@@ -424,10 +424,15 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
             parse_snapshot_ref(b["snapshot"])   # reject traversal/bad type
         except (KeyError, ValueError) as e:
             return web.json_response({"error": str(e)}, status=400)
-        rid = enqueue_restore(server, target=b["target"],
-                              snapshot=b["snapshot"],
-                              destination=b["destination"],
-                              subpath=b.get("subpath", ""))
+        from .jobs import QueueFullError
+        try:
+            rid = enqueue_restore(server, target=b["target"],
+                                  snapshot=b["snapshot"],
+                                  destination=b["destination"],
+                                  subpath=b.get("subpath", ""))
+        except QueueFullError as e:
+            # backpressure, not a server fault: tell the client to retry
+            return web.json_response({"error": str(e)}, status=503)
         return web.json_response({"restore_id": rid})
 
     async def restore_status(request):

@@ -74,6 +74,21 @@ class Env:
     # scan prefetches (0 disables readahead)
     chunk_cache_mb: int = 256
     chunk_readahead: int = 4
+    # fleet admission control (arpc/agents_manager.py, docs/fleet.md):
+    # per-client token bucket (the old hardcoded 10/s burst 20), a
+    # global session-open rate bucket, and a hard ceiling on concurrent
+    # registered sessions.  0 disables the respective gate.
+    agent_rate: float = CLIENT_RATE_LIMIT_PER_SEC
+    agent_burst: int = CLIENT_RATE_LIMIT_BURST
+    agent_open_rate: float = 0.0
+    agent_max_sessions: int = 4096
+    # mux slow-reader shed (arpc/mux.py): a frame write blocked on a
+    # full transport for longer than this sheds the CONNECTION instead
+    # of buffering without bound; 0 disables the deadline
+    mux_write_deadline_s: float = 60.0
+    # jobs queue bound (server/jobs.py): enqueues past this many
+    # waiting jobs fast-fail with QueueFullError; 0 = unbounded
+    max_queued_jobs: int = 1024
     extra: dict = field(default_factory=dict)
 
 
@@ -107,6 +122,16 @@ def env() -> Env:
         checkpoint_interval=e.get("PBS_PLUS_CHECKPOINT_INTERVAL", ""),
         chunk_cache_mb=_int_env(e, "PBS_PLUS_CHUNK_CACHE_MB", "256"),
         chunk_readahead=_int_env(e, "PBS_PLUS_CHUNK_READAHEAD", "4"),
+        agent_rate=_float_env(e, "PBS_PLUS_AGENT_RATE",
+                              str(CLIENT_RATE_LIMIT_PER_SEC)),
+        agent_burst=_int_env(e, "PBS_PLUS_AGENT_BURST",
+                             str(CLIENT_RATE_LIMIT_BURST)),
+        agent_open_rate=_float_env(e, "PBS_PLUS_AGENT_OPEN_RATE", "0"),
+        agent_max_sessions=_int_env(e, "PBS_PLUS_AGENT_MAX_SESSIONS",
+                                    "4096"),
+        mux_write_deadline_s=_float_env(e, "PBS_PLUS_MUX_WRITE_DEADLINE",
+                                        "60"),
+        max_queued_jobs=_int_env(e, "PBS_PLUS_MAX_QUEUED_JOBS", "1024"),
     )
 
 

@@ -50,6 +50,9 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._probing = False          # half-open probe in flight
         self._lock = threading.Lock()
+        # monotonic timestamp of the last admission/verdict — registry
+        # owners (server/jobs.py) evict closed breakers idle past a TTL
+        self.last_used = time.monotonic()
 
     @property
     def state(self) -> str:
@@ -68,6 +71,7 @@ class CircuitBreaker:
     def _admit(self) -> None:
         """Gate one call; raises ``CircuitOpenError`` when not admitted."""
         with self._lock:
+            self.last_used = time.monotonic()
             st = self._state_locked()
             if st == OPEN:
                 raise CircuitOpenError(
@@ -82,12 +86,14 @@ class CircuitBreaker:
 
     def _record_success(self) -> None:
         with self._lock:
+            self.last_used = time.monotonic()
             self._failures = 0
             self._state = CLOSED
             self._probing = False
 
     def _record_failure(self) -> None:
         with self._lock:
+            self.last_used = time.monotonic()
             self._failures += 1
             failed_probe = self._probing
             self._probing = False

@@ -27,3 +27,10 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: fleet-scale soak profiles (N=500; runs in the default "
+        "loop, deselect with -m 'not slow' for a quick pass)")

@@ -1,0 +1,249 @@
+"""Admission-control unit battery (docs/fleet.md "Admission"): the
+previously-untested AgentsManager failure paths — duplicate-session
+eviction under RACING reconnects (newest wins), WaitStreamPipe
+(``wait_session``) timing out cleanly when the agent child session never
+appears — plus the registry-hygiene invariants this PR added: idle
+per-client token buckets are pruned, typed ``AdmissionRejected``
+verdicts are counted by kind.
+
+Everything runs over plain-TCP loopback (``tls=None`` + the
+``X-PBS-Plus-Loopback-CN`` identity header) so the battery needs no
+cryptography wheel — TLS admission itself is tests/test_arpc.py's job.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from pbs_plus_tpu.arpc import AdmissionRejected, connect_to_server, serve
+from pbs_plus_tpu.arpc.agents_manager import (_BUCKET_CAP, AgentsManager,
+                                              _TokenBucket)
+from pbs_plus_tpu.arpc.transport import HDR_LOOPBACK_CN, HandshakeError
+
+
+async def _start(am: AgentsManager):
+    """Plain loopback listener that registers every accepted conn."""
+    async def on_connection(conn, peer, headers):
+        sess = await am.register(peer, headers, conn)
+        try:
+            while not conn.closed:          # hold the session open
+                st = await conn.accept_stream()
+                if st is None:
+                    break
+        finally:
+            await am.unregister(sess)
+
+    srv = await serve("127.0.0.1", 0, None, on_connection=on_connection,
+                      admit=am.admit, keepalive_s=0)
+    return srv, srv.sockets[0].getsockname()[1]
+
+
+def test_racing_reconnects_newest_wins():
+    """Eight SIMULTANEOUS connects with the same CN: exactly one session
+    survives in the registry, every other connection is evicted
+    (closed), and the survivor is live — the newest-wins discipline
+    under a reconnect race, not just sequential reconnects."""
+    async def main():
+        am = AgentsManager(is_expected=None, rate=1000, burst=1000)
+        srv, port = await _start(am)
+        conns = await asyncio.gather(*(
+            connect_to_server("127.0.0.1", port, None,
+                              headers={HDR_LOOPBACK_CN: "dup-host"},
+                              keepalive_s=0)
+            for _ in range(8)))
+        # let eviction cascades settle (each register closes the prior)
+        for _ in range(50):
+            live = [c for c in conns if not c.closed]
+            if len(live) == 1:
+                break
+            await asyncio.sleep(0.02)
+        live = [c for c in conns if not c.closed]
+        assert len(live) == 1, f"{len(live)} connections still live"
+        sess = am.get("dup-host")
+        assert sess is not None and not sess.conn.closed
+        assert len(am.sessions()) == 1       # exactly one winner registered
+        for c in conns:
+            await c.close()
+        srv.close()
+        await srv.wait_closed()
+
+    asyncio.run(main())
+
+
+def test_wait_session_times_out_cleanly():
+    """WaitStreamPipe semantics when the agent child session NEVER
+    appears: wait_session raises TimeoutError within the deadline, the
+    waiter registry is left empty (no per-client_id leak), and a session
+    registering AFTER the timeout still works for the next waiter."""
+    async def main():
+        am = AgentsManager(is_expected=None)
+        am.expect("host-1|job-x")
+        t0 = time.monotonic()
+        with pytest.raises(asyncio.TimeoutError):
+            await am.wait_session("host-1|job-x", timeout=0.05)
+        assert time.monotonic() - t0 < 1.0
+        # clean timeout: no leaked waiter entry for the client_id
+        assert "host-1|job-x" not in am._waiters
+        # a later register is not poisoned by the dead waiter: a fresh
+        # wait resolves instantly once the session exists
+        class _Conn:
+            closed = False
+        sess = await am.register({"cn": "host-1"},
+                                 {"X-PBS-Plus-BackupID": "job-x"}, _Conn())
+        got = await am.wait_session("host-1|job-x", timeout=1)
+        assert got is sess
+
+    asyncio.run(main())
+
+
+def test_admission_rejects_are_typed_and_counted():
+    """Every reject path raises AdmissionRejected with a stable ``kind``
+    and increments the matching counter exported via /metrics."""
+    async def main():
+        am = AgentsManager(is_expected=None, rate=1000, burst=1000,
+                           max_sessions=1)
+
+        async def admit(cn, headers=None):
+            return await am.admit({"cn": cn}, headers or {})
+
+        await admit("a-1")
+        with pytest.raises(AdmissionRejected) as ei:
+            await admit("")
+        assert (ei.value.code, ei.value.kind) == (403, "no_cn")
+        # fill the ceiling, then overflow
+        class _Conn:
+            closed = False
+        await am.register({"cn": "a-1"}, {}, _Conn())
+        with pytest.raises(AdmissionRejected) as ei:
+            await admit("a-2")
+        assert (ei.value.code, ei.value.kind) == (503, "session_limit")
+        with pytest.raises(AdmissionRejected) as ei:
+            await admit("a-1", {"X-PBS-Plus-BackupID": "never-expected"})
+        # session ceiling is checked before job-session routing
+        assert ei.value.kind == "session_limit"
+        stats = am.admission_stats()
+        assert stats["admitted"] == 1
+        assert stats["no_cn"] == 1
+        assert stats["session_limit"] == 2
+
+    asyncio.run(main())
+
+
+def test_session_ceiling_counts_inflight_admissions():
+    """The ceiling must hold DURING a connect storm: registration
+    happens awaits after admit(), so admitted-but-unregistered
+    handshakes count against max_sessions too — N concurrent admits
+    with no register yet cannot all pass."""
+    async def main():
+        am = AgentsManager(is_expected=None, rate=0, max_sessions=3)
+        ok = rejected = 0
+        for i in range(8):                   # no register in between
+            try:
+                await am.admit({"cn": f"storm-{i}"}, {})
+                ok += 1
+            except AdmissionRejected as e:
+                assert e.kind == "session_limit"
+                rejected += 1
+        assert ok == 3 and rejected == 5
+        # registration consumes the reservation, not a second slot
+        class _Conn:
+            closed = False
+        for i in range(3):
+            await am.register({"cn": f"storm-{i}"}, {}, _Conn())
+        assert len(am._admit_reservations) == 0
+        with pytest.raises(AdmissionRejected):
+            await am.admit({"cn": "storm-late"}, {})
+        # a rejected admit must not leak its reservation
+        assert len(am._admit_reservations) == 0
+
+    asyncio.run(main())
+
+
+def test_client_rate_zero_disables_gate():
+    """PBS_PLUS_AGENT_RATE=0 means DISABLED (conf.py contract), not
+    'bucket that never refills': unlimited opens from one CN, and no
+    bucket state accumulates."""
+    async def main():
+        am = AgentsManager(is_expected=None, rate=0, burst=0)
+        for _ in range(100):
+            await am.admit({"cn": "chatty"}, {})
+        assert am.admission_stats()["admitted"] == 100
+        assert not am._buckets                # gate off → no state
+
+    asyncio.run(main())
+
+
+def test_open_rate_bucket_rejects_429():
+    async def main():
+        am = AgentsManager(is_expected=None, rate=1000, burst=1000,
+                           open_rate=1.0)   # burst 2
+        ok = rejected = 0
+        for i in range(6):
+            try:
+                await am.admit({"cn": f"h-{i}"}, {})
+                ok += 1
+            except AdmissionRejected as e:
+                assert (e.code, e.kind) == (429, "open_rate")
+                rejected += 1
+        assert ok == 2 and rejected == 4     # burst admits, the rest shed
+        assert am.admission_stats()["open_rate"] == 4
+
+    asyncio.run(main())
+
+
+def test_idle_client_buckets_are_pruned():
+    """The per-client token-bucket dict is bounded: a bucket idle long
+    enough to have refilled to burst carries no state and is evicted on
+    the next prune pass; a busy bucket survives."""
+    async def main():
+        am = AgentsManager(is_expected=None, rate=100.0, burst=10)
+        now = time.monotonic()
+        ttl = am._burst / am._rate           # 0.1s to refill from empty
+        for i in range(50):
+            b = _TokenBucket(am._rate, am._burst)
+            b.last = now - 10 * ttl          # long idle → prunable
+            am._buckets[f"cold-{i}"] = b
+        hot = _TokenBucket(am._rate, am._burst)
+        hot.last = now                       # just used → kept
+        am._buckets["hot"] = hot
+        am._last_bucket_prune = now - 3600   # force the interval gate
+        am._maybe_prune_buckets(now)
+        assert set(am._buckets) == {"hot"}
+
+        # cap overflow forces a sweep even inside the prune interval
+        am._last_bucket_prune = now
+        for i in range(_BUCKET_CAP + 5):
+            b = _TokenBucket(am._rate, am._burst)
+            b.last = now - 10 * ttl
+            am._buckets[f"bulk-{i}"] = b
+        await am.admit({"cn": "trigger"}, {})
+        assert len(am._buckets) <= _BUCKET_CAP
+
+    asyncio.run(main())
+
+
+def test_plain_listener_rejects_send_wire_codes():
+    """Over the wire, AdmissionRejected becomes the handshake rejection
+    frame: a fleet past max_sessions sees HandshakeError(503)."""
+    async def main():
+        am = AgentsManager(is_expected=None, rate=1000, burst=1000,
+                           max_sessions=2)
+        srv, port = await _start(am)
+        conns = []
+        for i in range(2):
+            conns.append(await connect_to_server(
+                "127.0.0.1", port, None,
+                headers={HDR_LOOPBACK_CN: f"h-{i}"}, keepalive_s=0))
+        await asyncio.sleep(0.1)             # let both register
+        with pytest.raises(HandshakeError) as ei:
+            await connect_to_server("127.0.0.1", port, None,
+                                    headers={HDR_LOOPBACK_CN: "h-over"},
+                                    keepalive_s=0)
+        assert ei.value.code == 503
+        for c in conns:
+            await c.close()
+        srv.close()
+        await srv.wait_closed()
+
+    asyncio.run(main())

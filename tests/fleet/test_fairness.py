@@ -1,0 +1,159 @@
+"""JobsManager fairness + bounded-queue + breaker-hygiene battery
+(docs/fleet.md "Fairness"): strict priority classes over round-robin
+tenants, typed QueueFullError past the configured bound, and the
+breaker-registry eviction rules this PR added.  (The noisy-tenant
+starvation bound lives in test_fleet_chaos.py.)
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from pbs_plus_tpu.server.jobs import Job, JobsManager, QueueFullError
+from pbs_plus_tpu.utils.resilience import CircuitBreaker
+
+
+def _job(jm, name, tenant, done, *, priority=0, hold=None):
+    async def run():
+        if hold is not None:
+            await hold.wait()
+        done.append(name)
+    return Job(id=name, tenant=tenant, priority=priority, execute=run)
+
+
+def test_round_robin_across_tenants():
+    """With one execution slot and three tenants' backlogs interleaved,
+    slot grants rotate tenants instead of draining the first FIFO."""
+    async def main():
+        jm = JobsManager(max_concurrent=1, max_queued=0)
+        done: list[str] = []
+        gate = asyncio.Event()
+        # a running job holds the slot so everything below queues
+        jm.enqueue(_job(jm, "warm", "t0", done, hold=gate))
+        await asyncio.sleep(0)
+        for i in range(3):
+            for t in ("t0", "t1", "t2"):
+                jm.enqueue(_job(jm, f"{t}-{i}", t, done))
+        gate.set()
+        await jm.drain(timeout=30)
+        order = [n for n in done if n != "warm"]
+        # each tenant's first job completes before any tenant's second
+        first_round = order[:3]
+        assert {n.split("-")[0] for n in first_round} == {"t0", "t1", "t2"}
+
+    asyncio.run(main())
+
+
+def test_strict_priority_class_preempts_rr():
+    """A lower-numbered priority class is granted ahead of the RR ring,
+    even when its job arrived last."""
+    async def main():
+        jm = JobsManager(max_concurrent=1, max_queued=0)
+        done: list[str] = []
+        gate = asyncio.Event()
+        jm.enqueue(_job(jm, "warm", "bulk", done, hold=gate))
+        await asyncio.sleep(0)
+        for i in range(4):
+            jm.enqueue(_job(jm, f"bulk-{i}", "bulk", done, priority=1))
+        jm.enqueue(_job(jm, "urgent", "ops", done, priority=0))
+        gate.set()
+        await jm.drain(timeout=30)
+        assert done[1] == "urgent", done      # first grant after warm
+
+    asyncio.run(main())
+
+
+def test_queue_bound_fast_fails_typed():
+    async def main():
+        jm = JobsManager(max_concurrent=1, max_queued=3)
+        gate = asyncio.Event()
+        done: list[str] = []
+        jm.enqueue(_job(jm, "run", "t", done, hold=gate))
+        await asyncio.sleep(0)                # let it take the slot
+        for i in range(3):
+            jm.enqueue(_job(jm, f"q{i}", "t", done))
+        assert jm.queued_count == 3
+        with pytest.raises(QueueFullError):
+            jm.enqueue(_job(jm, "overflow", "t", done))
+        assert jm.stats["rejected_full"] == 1
+        # dedup beats the bound check: a duplicate id is not an enqueue
+        assert jm.enqueue(_job(jm, "q0", "t", done)) is False
+        gate.set()
+        await jm.drain(timeout=30)
+        assert "overflow" not in done and len(done) == 4
+        assert jm.queued_count == 0
+
+    asyncio.run(main())
+
+
+def test_tenant_running_gauge_tracks_slots():
+    async def main():
+        jm = JobsManager(max_concurrent=4, max_queued=0)
+        gate = asyncio.Event()
+        done: list[str] = []
+        for i in range(2):
+            jm.enqueue(_job(jm, f"a{i}", "tenant-a", done, hold=gate))
+        jm.enqueue(_job(jm, "b0", "tenant-b", done, hold=gate))
+        await asyncio.sleep(0.01)
+        assert jm.tenant_active() == {"tenant-a": 2, "tenant-b": 1}
+        assert jm.running_count == 3
+        gate.set()
+        await jm.drain(timeout=30)
+        assert jm.tenant_active() == {} and jm.running_count == 0
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------- breaker registry
+
+
+def test_breaker_differing_thresholds_warn_not_silent(caplog):
+    jm = JobsManager(max_concurrent=1)
+    b1 = jm.breaker("agent:x", failure_threshold=5, reset_timeout_s=30)
+    with caplog.at_level("WARNING"):
+        b2 = jm.breaker("agent:x", failure_threshold=2, reset_timeout_s=1)
+    assert b2 is b1                           # existing circuit shared
+    assert b1.failure_threshold == 5          # NOT reconfigured
+    assert any("already exists" in r.message for r in caplog.records)
+
+
+def test_breaker_registry_evicts_closed_idle_only():
+    """Closed breakers idle past the TTL are evicted; an OPEN breaker is
+    live protective state and survives any idleness."""
+    jm = JobsManager(max_concurrent=1, max_breakers=1024,
+                     breaker_idle_evict_s=10.0)
+    stale = time.monotonic() - 3600
+    for i in range(5):
+        jm.breaker(f"agent:cold-{i}").last_used = stale
+    tripped = jm.breaker("agent:tripped", failure_threshold=1)
+    with pytest.raises(RuntimeError):
+        tripped.call_sync(lambda: (_ for _ in ()).throw(
+            RuntimeError("boom")))
+    assert tripped.state == "open"
+    tripped.last_used = stale                 # idle AND open
+    jm._last_breaker_prune = 0.0              # force the cadence gate
+    jm.breaker("agent:fresh")                 # creation triggers the prune
+    assert jm.breaker_count == 2              # cold-* gone
+    assert "agent:tripped" in jm._breakers    # open → never evicted
+    assert "agent:fresh" in jm._breakers
+
+
+def test_breaker_registry_cap_forces_coldest_out():
+    jm = JobsManager(max_concurrent=1, max_breakers=4,
+                     breaker_idle_evict_s=1e9)   # TTL never fires
+    now = time.monotonic()
+    for i in range(4):
+        jm.breaker(f"agent:b{i}").last_used = now - (100 - i)
+    jm.breaker("agent:new")                   # 5th: cap sweep evicts coldest
+    assert jm.breaker_count <= 4
+    assert "agent:b0" not in jm._breakers     # the coldest went first
+    assert "agent:new" in jm._breakers
+
+
+def test_breaker_last_used_advances_on_use():
+    cb = CircuitBreaker(failure_threshold=3, name="t")
+    t0 = cb.last_used
+    time.sleep(0.01)
+    cb.call_sync(lambda: 1)
+    assert cb.last_used > t0

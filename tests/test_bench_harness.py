@@ -239,6 +239,28 @@ def test_bench_read_path(tmp_path):
     assert ctx["cores"] and ctx["python"]
 
 
+def test_bench_fleet_soak(tmp_path):
+    """Fleet soak benchmark (bench._fleet_bench → detail.fleet in the
+    bench JSON): every admitted job publishes, latency percentiles are
+    reported, and no bounded queue exceeded its bound (docs/fleet.md)."""
+    import bench
+
+    n = 100 if FULL else 32
+    res = bench._fleet_bench(n_agents=n)
+    print(f"\n  fleet n={n}: publish p50 "
+          f"{res['enqueue_to_publish_p50_s'] * 1e3:7.1f} ms | p99 "
+          f"{res['enqueue_to_publish_p99_s'] * 1e3:7.1f} ms | "
+          f"{res['mux_frames_per_s']:8.0f} frames/s | "
+          f"rejected {res['admission_rejected']}")
+    assert res["published"] == n
+    assert 0 < res["enqueue_to_publish_p50_s"] <= \
+        res["enqueue_to_publish_p99_s"]
+    assert res["mux_frames_per_s"] > 0
+    # the bench JSON carries the admission verdicts the soak consumed
+    assert "admission_rejected" in res and "admission" in res
+    assert not res["bound_violated"]
+
+
 def test_bench_commit_walk_refs(tmp_path):
     """Commit-walk with many unchanged files (ref coalescing — the
     B1/B4 'refs sort + coalescing' analog): re-commit of an untouched

@@ -37,6 +37,7 @@ def test_registry_has_expected_rules():
         "no-hostsync-in-hot-loop", "subprocess-timeout",
         "thread-hygiene", "resource-ctx", "mutable-default",
         "failpoint-discipline", "cache-discipline",
+        "bounded-queue-discipline",
     }
 
 
@@ -76,6 +77,70 @@ def test_cache_discipline_scoped_to_read_path_modules():
         def load(store, digest):
             return store.get(digest)
     """, path="pbs_plus_tpu/pxar/chunkcache.py", rules=["cache-discipline"])
+    assert v == []
+
+
+# --------------------------------------------- bounded-queue-discipline
+
+
+def test_bounded_queue_flags_unbounded_in_arpc():
+    v = run_lint("""
+        import asyncio
+        q = asyncio.Queue()
+    """, path="pbs_plus_tpu/arpc/mux.py",
+        rules=["bounded-queue-discipline"])
+    assert names(v) == ["bounded-queue-discipline"]
+    assert "maxsize" in v[0].message
+
+
+def test_bounded_queue_flags_bare_queue_import_in_server():
+    v = run_lint("""
+        from queue import Queue
+        def pump():
+            return Queue()
+    """, path="pbs_plus_tpu/server/jobs.py",
+        rules=["bounded-queue-discipline"])
+    assert names(v) == ["bounded-queue-discipline"]
+
+
+def test_bounded_queue_simplequeue_unboundable_by_type():
+    v = run_lint("""
+        import queue
+        q = queue.SimpleQueue()
+    """, path="pbs_plus_tpu/server/backup_job.py",
+        rules=["bounded-queue-discipline"])
+    assert names(v) == ["bounded-queue-discipline"]
+    assert "cannot be bounded" in v[0].message
+
+
+def test_bounded_queue_explicit_maxsize_clean():
+    v = run_lint("""
+        import asyncio, queue
+        a = asyncio.Queue(maxsize=64)
+        b = queue.Queue(16)
+    """, path="pbs_plus_tpu/arpc/mux.py",
+        rules=["bounded-queue-discipline"])
+    assert v == []
+
+
+def test_bounded_queue_scoped_to_fleet_facing_layers():
+    # outside arpc/ and server/, unbounded queues are not this rule's
+    # business (pipeline-internal queues are bounded by construction)
+    v = run_lint("""
+        import queue
+        q = queue.Queue()
+    """, path="pbs_plus_tpu/pxar/pipeline.py",
+        rules=["bounded-queue-discipline"])
+    assert v == []
+
+
+def test_bounded_queue_inline_disable_with_rationale():
+    v = run_lint("""
+        import asyncio
+        # deliberate: drained synchronously before every await point
+        q = asyncio.Queue()  # pbslint: disable=bounded-queue-discipline
+    """, path="pbs_plus_tpu/arpc/mux.py",
+        rules=["bounded-queue-discipline"])
     assert v == []
 
 

@@ -19,6 +19,7 @@ from .resources import ResourceCtx
 from .mutable_defaults import MutableDefault
 from .failpoint_discipline import FailpointDiscipline
 from .cache_discipline import CacheDiscipline
+from .bounded_queue import BoundedQueueDiscipline
 
 RULE_CLASSES = [
     NoSilentSwallow,
@@ -32,6 +33,7 @@ RULE_CLASSES = [
     MutableDefault,
     FailpointDiscipline,
     CacheDiscipline,
+    BoundedQueueDiscipline,
 ]
 
 

@@ -1,0 +1,68 @@
+"""bounded-queue-discipline — queues in the fleet-facing layers declare
+their bound.
+
+Invariant (docs/fleet.md "Backpressure"): every ``asyncio.Queue`` /
+``queue.Queue`` constructed under ``pbs_plus_tpu/arpc/`` or
+``pbs_plus_tpu/server/`` passes an explicit ``maxsize``.  These layers
+face the fleet — an unbounded queue there is an invitation for one slow
+consumer (or 500 enthusiastic producers) to grow memory without bound;
+the admission/backpressure work of PR 7 exists precisely because the
+accept queue was unbounded.  Where unbounded is genuinely deliberate,
+say so: ``# pbslint: disable=bounded-queue-discipline`` with a
+rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+
+_SCOPES = ("pbs_plus_tpu/arpc/", "pbs_plus_tpu/server/")
+
+# receivers that denote a queue class: asyncio.Queue(...), queue.Queue(...),
+# and bare Queue(...) / LifoQueue / PriorityQueue from-imports
+_QUEUE_NAMES = frozenset({"Queue", "LifoQueue", "PriorityQueue",
+                          "SimpleQueue"})
+_QUEUE_MODULES = frozenset({"asyncio", "queue"})
+
+
+class BoundedQueueDiscipline(Rule):
+    name = "bounded-queue-discipline"
+    invariant = ("queues in arpc/ and server/ are constructed with an "
+                 "explicit maxsize (unbounded queues face the fleet)")
+
+    def begin_file(self, ctx):
+        return any(ctx.path.startswith(s) for s in _SCOPES)
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr not in _QUEUE_NAMES:
+                return
+            recv = func.value
+            if not (isinstance(recv, ast.Name)
+                    and recv.id in _QUEUE_MODULES):
+                return
+            qname = f"{recv.id}.{func.attr}"
+        elif isinstance(func, ast.Name):
+            if func.id not in _QUEUE_NAMES:
+                return
+            qname = func.id
+        else:
+            return
+        if qname.endswith("SimpleQueue"):
+            # SimpleQueue has no maxsize parameter at all — it is
+            # unbounded BY TYPE, which is exactly the hazard
+            ctx.report(self, node,
+                       f"`{qname}()` cannot be bounded — use Queue with "
+                       "an explicit maxsize in fleet-facing layers")
+            return
+        has_bound = bool(node.args) or any(
+            kw.arg == "maxsize" for kw in node.keywords)
+        if not has_bound:
+            ctx.report(self, node,
+                       f"`{qname}()` without an explicit maxsize in a "
+                       "fleet-facing layer: one slow consumer grows this "
+                       "without bound — pass maxsize (or inline-disable "
+                       "with a rationale if unbounded is deliberate)")
