@@ -20,12 +20,12 @@ inside the compiled step.
 
 from .rolling_hash import candidate_mask, candidate_ends_host
 from .sha256 import sha256_chunks, sha256_stream_chunks
-from .cuckoo import CuckooIndex
+from .cuckoo import CuckooIndex, buckets_for_bytes, lookup_host
 from .similarity import simhash_sketch, minhash_signature, pairwise_hamming
 
 __all__ = [
     "candidate_mask", "candidate_ends_host",
     "sha256_chunks", "sha256_stream_chunks",
-    "CuckooIndex",
+    "CuckooIndex", "buckets_for_bytes", "lookup_host",
     "simhash_sketch", "minhash_signature", "pairwise_hamming",
 ]

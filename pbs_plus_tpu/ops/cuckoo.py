@@ -28,6 +28,16 @@ import numpy as np
 SLOTS = 4
 _MIX = np.uint32(0x9E3779B1)
 _MAX_KICKS = 500
+BUCKET_BYTES = SLOTS * 2 * 4        # uint32[SLOTS, 2] per bucket
+
+
+def buckets_for_bytes(budget_bytes: int, *, minimum: int = 1 << 10) -> int:
+    """Largest power-of-two bucket count whose table fits the budget
+    (the PBS_PLUS_DEDUP_INDEX_MB sizing rule in pxar/chunkindex.py)."""
+    nb = minimum
+    while nb * 2 * BUCKET_BYTES <= budget_bytes:
+        nb *= 2
+    return nb
 
 
 def _digest_words(digests: np.ndarray | jax.Array):
@@ -40,6 +50,37 @@ def _digest_words(digests: np.ndarray | jax.Array):
     word = (w[..., 0] << np.uint32(24)) | (w[..., 1] << np.uint32(16)) \
         | (w[..., 2] << np.uint32(8)) | w[..., 3]
     return word[:, 0], word[:, 1], word[:, 2]
+
+
+def lookup_host(table: np.ndarray, digests: np.ndarray) -> np.ndarray:
+    """numpy twin of ``_lookup`` over the host mirror: table
+    uint32[NB, SLOTS, 2]; digests uint8[N, 32] → bool[N].  CPU-only
+    hosts probe this path directly — no device round-trip, no jit — and
+    the device/numpy parity gate in tests/test_dedupindex.py pins the
+    two implementations bit-identical.
+
+    Hot-path formulation: digest words come from a big-endian u32 view
+    (one vectorized byteswap of 3 words/digest instead of 4 shifts + 3
+    ors over all 8), and the (fp0, fp1) pair compares as ONE u64 per
+    slot via a view of the table — half the gathers and compares of the
+    naive twin."""
+    nb = table.shape[0]
+    if not digests.flags.c_contiguous:
+        digests = np.ascontiguousarray(digests)
+    w = digests.view(">u4")             # [N, 8] big-endian words
+    fp0 = w[:, 0].astype(np.uint32)
+    fp1 = w[:, 1].astype(np.uint32)
+    bidx = w[:, 2].astype(np.uint32)
+    fp0 = np.where((fp0 == 0) & (fp1 == 0), np.uint32(0x5A5A5A5A), fp0)
+    mask = np.uint32(nb - 1)
+    b1 = bidx & mask
+    b2 = b1 ^ ((fp0 * _MIX) & mask)
+    # little-endian slot memory [fp0, fp1] == u64 fp0 | fp1<<32
+    t64 = table.view(np.uint64).reshape(nb, SLOTS)
+    fpc = fp0.astype(np.uint64) | (fp1.astype(np.uint64) << np.uint64(32))
+    hit = (t64[b1] == fpc[:, None]).any(axis=1)
+    hit |= (t64[b2] == fpc[:, None]).any(axis=1)
+    return hit
 
 
 @functools.partial(jax.jit, static_argnames=())
@@ -98,6 +139,45 @@ class CuckooIndex:
         self._insert_fp(fp0, fp1, b1, b2)
         self._dirty = True
         return True
+
+    def discard(self, digest: bytes) -> bool:
+        """Remove a digest (GC sweep coherence: a swept chunk must leave
+        the filter).  Returns False if it was never present.  The table
+        slot is zeroed when the fingerprint is found in either bucket; a
+        fingerprint shared with ANOTHER digest (same fp+bucket pair,
+        ~2⁻⁶⁴) keeps its own slot, and at worst a removal turns into a
+        false NEGATIVE for that twin — which is safe: a false negative
+        re-stores a chunk that exists, never skips one that doesn't."""
+        if digest not in self._known:
+            return False
+        self._known.discard(digest)
+        fp0, fp1, b1, b2 = self._fp_bucket(digest)
+        for b in (b1, b2):
+            row = self._table[b]
+            for s in range(SLOTS):
+                if row[s, 0] == fp0 and row[s, 1] == fp1:
+                    row[s] = (0, 0)
+                    self._dirty = True
+                    return True
+        # fingerprint not in the mirror (dropped during an eviction
+        # overflow before a growth rebuild): the authoritative set is
+        # already updated, so membership answers stay correct
+        self._dirty = True
+        return True
+
+    def discard_many(self, digests) -> int:
+        n = 0
+        for d in digests:
+            if self.discard(d):
+                n += 1
+        return n
+
+    def probe_host(self, digests: np.ndarray) -> np.ndarray:
+        """Batched maybe-present over the host mirror (numpy, no device):
+        digests uint8[N,32] → bool[N].  The CPU-only probe path of
+        ``probe``; confirm hits via ``contains_exact`` before skipping
+        an upload."""
+        return lookup_host(self._table, digests)
 
     def _insert_fp(self, fp0: int, fp1: int, b1: int, b2: int) -> None:
         for b in (b1, b2):

@@ -182,8 +182,12 @@ class LocalStore:
     def __init__(self, base_dir: str, params: ChunkerParams, *,
                  chunker_factory: ChunkerFactory = _default_chunker_factory,
                  batch_hasher=None, pbs_format: bool = False,
-                 pipeline_workers: int = 0):
-        self.datastore = Datastore(base_dir, pbs_format=pbs_format)
+                 pipeline_workers: int = 0,
+                 store_shards: "int | None" = None,
+                 dedup_index_mb: "int | None" = None):
+        self.datastore = Datastore(base_dir, pbs_format=pbs_format,
+                                   store_shards=store_shards,
+                                   dedup_index_mb=dedup_index_mb)
         self.params = params
         self._chunker_factory = chunker_factory
         self.batch_hasher = batch_hasher

@@ -1,0 +1,338 @@
+"""Dedup index: the authoritative membership front for chunk-store probes.
+
+ROADMAP item 1 / ISSUE 8 — the BASELINE north star is "only
+globally-novel chunks ever hit the datastore" via vmap'd chunk-index
+probing, but until this subsystem the only memory-resident dedup
+knowledge was ``ChunkStore._datablob_seen`` (a capped set that cleared
+itself) and every negative probe fell through to a disk ``stat``.
+
+``DedupIndex`` promotes the ``ops/cuckoo.py`` kernel into a
+process-resident, growable membership oracle in front of the (sharded)
+chunk store:
+
+- **Negative probes never touch disk.**  ``ChunkStore.insert`` asks the
+  index first; an absent digest goes straight to the tmp+rename write —
+  zero existence ``stat`` calls (structurally asserted in
+  tests/test_dedupindex.py).
+- **Positive probes are confirmed by at most one store access**: the
+  GC-mark ``utime`` on the dedup-hit path doubles as the confirmation —
+  a ``FileNotFoundError`` there (index stale against an external
+  delete) falls back to the write path.
+- **Batched probe** (``probe_batch``): one vectorized filter pass per
+  batch — numpy over the host mirror on CPU-only hosts
+  (``ops.cuckoo.lookup_host``), the vmap'd device gather
+  (``CuckooIndex.probe``) when an accelerator backend is up.  Filter
+  positives are confirmed against the exact host set before a chunk
+  upload is skipped, so a fingerprint collision (≤ 2·SLOTS·2⁻⁶⁴ ≈ 2⁻⁶¹
+  per probe) can never cause a false dedup skip — it is only counted
+  in ``false_positives_total``.
+- **Single-writer insert** (one process-wide lock, matching the
+  reference's async single-writer index-update queue, SURVEY §2.10).
+- **Coherence with GC**: the sweep discards a digest from the index
+  BEFORE unlinking its file, so the failure direction is always a safe
+  false negative (re-store an existing chunk), never a false dedup
+  skip of a missing one.
+- **Boot**: the index rebuilds from a shard scan, or loads a journaled
+  snapshot (``save_snapshot``/``load_snapshot``).  Snapshots are
+  consume-once — the store unlinks the file as it loads it — so a
+  crash can never resurrect a snapshot that is stale against later
+  sweeps; anything inserted after the last save is simply re-learned
+  as a safe false negative.
+
+The pbs-format "already a DataBlob" knowledge (the expensive
+read+decompress upgrade probe in ``ChunkStore``) also lives here,
+unbounded and exact — the old capped set forgot EVERYTHING at 1M
+digests and re-ran the probe for all hot digests.
+
+Conf: ``PBS_PLUS_DEDUP_INDEX_MB`` (utils/conf.py; 0 disables the
+index) sizes the initial filter table; the filter still grows under
+load-factor pressure, and the resident-bytes gauge reports actuals.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import threading
+import weakref
+from typing import Iterable, Iterator, Sequence
+
+import numpy as np
+
+SNAP_MAGIC = b"TPXI"
+SNAP_VERSION = 1
+_SNAP_HDR = struct.Struct("<4sHHQQ")
+
+# per-entry resident estimate beyond the filter table: a 32-byte bytes
+# object + set-slot overhead in the exact host set (CPython ≈ 89 B for
+# the object, ~32 B amortized slot) — the gauge is an estimate, the
+# bench measures actuals
+_SET_ENTRY_BYTES = 121
+
+
+class IndexMetrics:
+    """Process-global dedup-index observability (rendered by
+    server/metrics.py as pbs_plus_dedup_index_*): cumulative counters
+    plus resident bytes/entries summed over live indexes."""
+
+    _COUNTERS = ("probes", "hits", "false_positives", "inserts",
+                 "discards", "rebuilds", "snapshot_loads",
+                 "snapshot_saves")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._c = dict.fromkeys(self._COUNTERS, 0)
+        self._indexes: "weakref.WeakSet[DedupIndex]" = weakref.WeakSet()
+
+    def add(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            self._c[counter] += n
+
+    def register(self, index: "DedupIndex") -> None:
+        with self._lock:
+            self._indexes.add(index)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._c)
+            live = list(self._indexes)
+        out["entries"] = sum(len(i) for i in live)
+        out["resident_bytes"] = sum(i.resident_bytes for i in live)
+        out["indexes"] = len(live)
+        return out
+
+
+METRICS = IndexMetrics()
+
+
+def metrics_snapshot() -> dict:
+    return METRICS.snapshot()
+
+
+class DedupIndex:
+    """Thread-safe membership oracle over a growable cuckoo filter.
+
+    All mutation goes through one lock (single-writer discipline); the
+    batched probe holds it only for the vectorized pass + exact
+    confirm.  The underlying ``CuckooIndex`` keeps the host set
+    authoritative, so answers are EXACT — the filter's job is making
+    the batched no-answer cheap and device-dispatchable."""
+
+    def __init__(self, *, budget_mb: int = 64, seed: int = 0):
+        from ..ops.cuckoo import CuckooIndex, buckets_for_bytes
+        self._lock = threading.RLock()
+        self._cuckoo = CuckooIndex(
+            n_buckets=buckets_for_bytes(max(1, int(budget_mb)) << 20),
+            seed=seed)
+        self._datablob: set[bytes] = set()
+        # boot state lives ON the index (not the owning store) so
+        # stores SHARING one index — the server's per-job
+        # chunker-override store — share one boot: whoever probes
+        # first loads, the other sees `booted` and skips the scan
+        self._booted = False
+        self._boot_lock = threading.Lock()
+        METRICS.register(self)
+
+    # -- boot gate (driven by ChunkStore's lazy `index` property) ----------
+    @property
+    def booted(self) -> bool:
+        return self._booted
+
+    def mark_booted(self) -> None:
+        """Declare the current contents authoritative (caller
+        pre-populated the index; no loader should ever run)."""
+        self._booted = True
+
+    def ensure_booted(self, loader) -> None:
+        """Run ``loader()`` exactly once across every sharer before the
+        first membership answer; concurrent callers serialize here."""
+        if self._booted:
+            return
+        with self._boot_lock:
+            if not self._booted:
+                loader()
+                self._booted = True
+
+    # -- introspection -----------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._cuckoo)
+
+    @property
+    def n_buckets(self) -> int:
+        return self._cuckoo.n_buckets
+
+    @property
+    def table_bytes(self) -> int:
+        return self._cuckoo._table.nbytes
+
+    @property
+    def resident_bytes(self) -> int:
+        return self.table_bytes + _SET_ENTRY_BYTES * (
+            len(self._cuckoo) + len(self._datablob))
+
+    def digests(self) -> Iterator[bytes]:
+        """Stable snapshot of the known digests (tests, persistence)."""
+        with self._lock:
+            return iter(list(self._cuckoo._known))
+
+    # -- membership --------------------------------------------------------
+    def contains(self, digest: bytes) -> bool:
+        """Exact single-digest membership (the per-insert fast path —
+        a set lookup beats a scalar filter probe on the host)."""
+        with self._lock:
+            hit = self._cuckoo.contains_exact(digest)
+        METRICS.add("probes")
+        if hit:
+            METRICS.add("hits")
+        return hit
+
+    def probe_batch(self, digests: Sequence[bytes]) -> "list[bool]":
+        """One vectorized filter pass over the whole batch, exact-
+        confirmed: digests (32-byte each) → [present?].  Filter
+        positives that fail the exact confirm are counted as false
+        positives and answered False — never a false dedup skip."""
+        if not digests:
+            return []
+        arr = np.frombuffer(b"".join(digests),
+                            dtype=np.uint8).reshape(-1, 32)
+        with self._lock:
+            # .tolist() up front: iterating a numpy bool array yields
+            # np.bool_ objects and is ~10x slower than plain bools on
+            # this hot loop
+            maybe = self._probe_arr(arr).tolist()
+            known = self._cuckoo._known
+            out = [m and d in known for m, d in zip(maybe, digests)]
+        hits = out.count(True)
+        fps = maybe.count(True) - hits
+        METRICS.add("probes", len(digests))
+        if hits:
+            METRICS.add("hits", hits)
+        if fps:
+            METRICS.add("false_positives", fps)
+        return out
+
+    def _probe_arr(self, arr: np.ndarray) -> np.ndarray:
+        """Maybe-present bool[N] for uint8[N,32] — numpy host mirror on
+        CPU, the vmap'd device lookup when an accelerator is the
+        default jax backend (the table uploads once per insert batch
+        and is reused across probes)."""
+        if _device_probe_enabled():
+            return np.asarray(self._cuckoo.probe(arr))
+        return self._cuckoo.probe_host(arr)
+
+    # -- mutation ----------------------------------------------------------
+    def insert(self, digest: bytes) -> bool:
+        with self._lock:
+            new = self._cuckoo.insert(digest)
+        if new:
+            METRICS.add("inserts")
+        return new
+
+    def insert_many(self, digests: Iterable[bytes]) -> int:
+        with self._lock:
+            n = self._cuckoo.insert_many(list(digests))
+        if n:
+            METRICS.add("inserts", n)
+        return n
+
+    def discard(self, digest: bytes) -> bool:
+        with self._lock:
+            gone = self._cuckoo.discard(digest)
+            self._datablob.discard(digest)
+        if gone:
+            METRICS.add("discards")
+        return gone
+
+    def discard_many(self, digests: Iterable[bytes]) -> int:
+        return sum(1 for d in digests if self.discard(d))
+
+    def rebuild(self, digests: Iterable[bytes]) -> int:
+        """Reset to exactly ``digests`` (the boot-time shard scan)."""
+        from ..ops.cuckoo import CuckooIndex
+        with self._lock:
+            fresh = CuckooIndex(n_buckets=self._cuckoo.n_buckets)
+            fresh.insert_many(list(digests))
+            self._cuckoo = fresh
+            self._datablob.clear()
+            n = len(fresh)
+        METRICS.add("rebuilds")
+        return n
+
+    # -- pbs DataBlob knowledge (the old capped _datablob_seen) ------------
+    def is_datablob(self, digest: bytes) -> bool:
+        with self._lock:
+            return digest in self._datablob
+
+    def mark_datablob(self, digest: bytes) -> None:
+        with self._lock:
+            self._datablob.add(digest)
+
+    # -- persistence -------------------------------------------------------
+    def save_snapshot(self, path: str) -> None:
+        """Atomic journaled snapshot: header + known digests + DataBlob
+        subset + sha256 trailer over the payload."""
+        with self._lock:
+            known = sorted(self._cuckoo._known)
+            blob = sorted(self._datablob)
+        payload = b"".join(known) + b"".join(blob)
+        hdr = _SNAP_HDR.pack(SNAP_MAGIC, SNAP_VERSION, 0,
+                             len(known), len(blob))
+        digest = hashlib.sha256(hdr + payload).digest()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(hdr)
+            f.write(payload)
+            f.write(digest)
+        os.replace(tmp, path)
+        METRICS.add("snapshot_saves")
+
+    def load_snapshot(self, path: str) -> bool:
+        """Replace contents from a snapshot; False (and unchanged) on a
+        missing/corrupt/truncated file — the caller then rebuilds from
+        a shard scan."""
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return False
+        if len(raw) < _SNAP_HDR.size + 32:
+            return False
+        magic, ver, _, n_known, n_blob = _SNAP_HDR.unpack_from(raw)
+        if magic != SNAP_MAGIC or ver != SNAP_VERSION:
+            return False
+        body_end = _SNAP_HDR.size + 32 * (n_known + n_blob)
+        if len(raw) != body_end + 32 or \
+                hashlib.sha256(raw[:body_end]).digest() != raw[body_end:]:
+            return False
+        off = _SNAP_HDR.size
+        known = [raw[off + 32 * i:off + 32 * (i + 1)]
+                 for i in range(n_known)]
+        off += 32 * n_known
+        blob = [raw[off + 32 * i:off + 32 * (i + 1)] for i in range(n_blob)]
+        from ..ops.cuckoo import CuckooIndex
+        with self._lock:
+            fresh = CuckooIndex(n_buckets=self._cuckoo.n_buckets)
+            fresh.insert_many(known)
+            self._cuckoo = fresh
+            self._datablob = set(blob)
+        METRICS.add("snapshot_loads")
+        return True
+
+
+def _device_probe_enabled() -> bool:
+    """True when jax's default backend is a real accelerator — probing
+    through the device table then beats the numpy mirror.  Decided once
+    (backends don't change mid-process); CPU-only hosts never pay a jit
+    dispatch per probe batch."""
+    global _DEVICE_PROBE
+    if _DEVICE_PROBE is None:
+        try:
+            import jax
+            _DEVICE_PROBE = jax.default_backend() != "cpu"
+        except Exception:
+            _DEVICE_PROBE = False
+    return _DEVICE_PROBE
+
+
+_DEVICE_PROBE: "bool | None" = None

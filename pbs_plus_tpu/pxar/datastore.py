@@ -93,30 +93,129 @@ class ChunkStore:
     Reference: datastore.NewChunkStore(path).  GC is mark-and-sweep via
     atime touch (PBS model): ``touch`` on reuse, ``sweep(before)`` removes
     chunks untouched since a mark time.
+
+    Sharded + index-fronted (ISSUE 8): the namespace is split into
+    ``n_shards`` logical shards by digest prefix (the on-disk
+    ``.chunks/<hex[:4]>/`` layout is unchanged — shard = first digest
+    byte mod N), each with its own lock and zstd compressor, so
+    concurrent sessions stop contending on one lock and GC mark/sweep
+    runs shard-parallel.  When a ``chunkindex.DedupIndex`` is attached
+    (default: sized by PBS_PLUS_DEDUP_INDEX_MB, 0 disables) it is the
+    ONLY membership oracle: negative probes never touch disk, positive
+    probes are confirmed by at most one store access (the GC-mark
+    utime), and the sweep keeps it coherent by discarding a digest
+    BEFORE unlinking its file.
     """
 
+    # per-shard locks serialize every mutating path, and reads use
+    # thread-local decompressors — callers (pipeline.locked_store) may
+    # skip the process-wide _LockedStore wrap
+    thread_safe = True
+
     def __init__(self, base: str, *, compression_level: int = 3,
-                 blob_format: str = "zstd"):
+                 blob_format: str = "zstd",
+                 n_shards: "int | None" = None,
+                 index_budget_mb: "int | None" = None,
+                 index=None):
         """blob_format="zstd" (native raw zstd frame) | "pbs" (stock-PBS
         DataBlob envelope: magic + crc32 + zstd payload).  Reads sniff
-        the on-disk magic, so a datastore may hold both formats."""
+        the on-disk magic, so a datastore may hold both formats.
+
+        ``n_shards``: logical shard count (None → PBS_PLUS_STORE_SHARDS).
+        ``index``: an explicit DedupIndex (tests); else one is built
+        from ``index_budget_mb`` (None → PBS_PLUS_DEDUP_INDEX_MB,
+        0 → index disabled, legacy utime-probe path)."""
+        from ..utils import conf as _conf
         self.base = os.path.join(base, ".chunks")
         os.makedirs(self.base, exist_ok=True)
         self.blob_format = blob_format
         self._level = compression_level
-        self._cctx = zstandard.ZstdCompressor(level=compression_level)
+        if n_shards is None:
+            n_shards = _conf.env().store_shards
+        self.n_shards = max(1, int(n_shards))
+        self._shard_locks = [threading.Lock()
+                             for _ in range(self.n_shards)]
+        # one compressor per shard: a zstd context is not thread-safe,
+        # and per-shard ownership (used only under the shard lock) is
+        # what lets two sessions compress concurrently at all
+        self._shard_cctx = [zstandard.ZstdCompressor(level=compression_level)
+                            for _ in range(self.n_shards)]
         # reads happen concurrently (chunk-cache prefetch pool, parallel
         # verification workers) and a zstd decompressor is NOT
         # thread-safe — one per reading thread
         self._dctx_local = threading.local()
-        # digests whose on-disk file this process has already confirmed
-        # (or made) a DataBlob — the pbs-format dedup-hit path skips the
-        # full read+decompress upgrade probe after the first sighting.
-        # Bounded: past the cap the set resets and probes re-run (the
-        # probe is an optimization; open-ended growth on a store with
-        # tens of millions of chunks is not)
+        # prefix dirs this process already created — skips the makedirs
+        # stat storm on the novel-insert hot path
+        self._made_dirs: set[str] = set()
+        # legacy DataBlob memory for INDEX-LESS stores only: bounded,
+        # evicts an arbitrary half at the cap (the old clear-everything
+        # reset forgot every hot digest at once and re-ran the full
+        # read+decompress upgrade probe for all of them).  With an index
+        # attached this knowledge lives there, unbounded and exact.
         self._datablob_seen: set[bytes] = set()
         self._datablob_seen_cap = 1 << 20
+        # its own lock: inserts on DIFFERENT shards share this one set,
+        # and the cap eviction iterates it — a per-shard lock alone
+        # would let another shard's add() race the iteration
+        self._datablob_lock = threading.Lock()
+        index_explicit = index is not None
+        if index is None:
+            mb = (_conf.env().dedup_index_mb
+                  if index_budget_mb is None else index_budget_mb)
+            if mb and mb > 0:
+                from .chunkindex import DedupIndex
+                index = DedupIndex(budget_mb=mb)
+        self._index = index
+        if index is not None and index_explicit:
+            # a caller-supplied index is taken as-is (tests pre-seed it)
+            index.mark_booted()
+        self._index_snap = os.path.join(base, ".chunkindex", "snapshot")
+
+    # -- index lifecycle ---------------------------------------------------
+    @property
+    def index(self):
+        """The attached DedupIndex (None = disabled), boot-scanned
+        LAZILY on first access — consume-once snapshot if present, else
+        a full shard scan — so read-only opens (restore, verify, CLI
+        listings) never pay it.  Boot state rides the DedupIndex
+        object: stores sharing one index share one boot."""
+        idx = self._index
+        if idx is not None:
+            idx.ensure_booted(self._boot_index)
+        return idx
+
+    @index.setter
+    def index(self, idx) -> None:
+        """Attach another store's index (the server's per-job
+        chunker-override store shares the primary's RAW ``_index``) —
+        boot state travels with the object, so whichever sharer probes
+        first loads it, on its own (writer) thread."""
+        self._index = idx
+
+    def _boot_index(self) -> None:
+        """Populate the index at first use: consume-once snapshot if
+        present (unlinked even on a failed load, so a crash later can
+        never resurrect it stale), else a full shard scan."""
+        loaded = False
+        try:
+            loaded = self._index.load_snapshot(self._index_snap)
+        finally:
+            try:
+                os.unlink(self._index_snap)
+            except OSError:
+                pass
+        if not loaded:
+            self._index.rebuild(self.iter_digests())
+
+    def save_index_snapshot(self) -> bool:
+        """Persist the index so the next open skips the shard scan
+        (called after every sweep; safe to call any time — anything
+        inserted after the save is re-learned as a false negative)."""
+        if self.index is None:
+            return False
+        os.makedirs(os.path.dirname(self._index_snap), exist_ok=True)
+        self.index.save_snapshot(self._index_snap)
+        return True
 
     @property
     def _dctx(self):
@@ -129,8 +228,28 @@ class ChunkStore:
         h = digest.hex()
         return os.path.join(self.base, h[:4], h)
 
+    def shard_of(self, digest: bytes) -> int:
+        return digest[0] % self.n_shards
+
     def has(self, digest: bytes) -> bool:
+        if self.index is not None:
+            return self.index.contains(digest)
         return os.path.exists(self._path(digest))
+
+    def on_disk(self, digest: bytes) -> bool:
+        """Disk-TRUE existence, deliberately bypassing the index.  For
+        integrity paths that suspect index/disk divergence (checkpoint
+        validation rejecting a resume that would splice a hole) — never
+        for dedup probes, where the index is the oracle."""
+        return os.path.exists(self._path(digest))
+
+    def probe_batch(self, digests: "list[bytes]") -> "list[bool] | None":
+        """Batched membership for a whole digest batch in one call (the
+        DedupWriter/PipelinedStream entry point).  None when no index
+        is attached — callers fall back to per-digest ``insert``."""
+        if self.index is None:
+            return None
+        return self.index.probe_batch(digests)
 
     def insert(self, digest: bytes, data: bytes, *, verify: bool = True) -> bool:
         """Store a chunk; returns True if it was new.  ``verify`` re-hashes
@@ -142,60 +261,135 @@ class ChunkStore:
         # what "no orphaned partial chunks" rests on either way
         failpoints.hit("pbsstore.chunk.insert")
         p = self._path(digest)
-        # dedup-hit probe + GC-mark touch in ONE syscall (the old
-        # os.path.exists + touch pair double-statted every hit)
-        exists = True
+        shard = self.shard_of(digest)
+        with self._shard_locks[shard]:
+            if self.index is not None:
+                if self.index.contains(digest):
+                    # dedup hit: the GC-mark touch is the one sanctioned
+                    # store access, doubling as the stale-index guard —
+                    # a vanished file (external delete) falls through to
+                    # the write path below
+                    if self._touch_hit(digest, p, shard):
+                        return False
+                # filter-negative: ZERO pre-write existence probes — the
+                # write lands via tmp+rename, which is idempotent even
+                # if the index missed a chunk that is already on disk
+            else:
+                # legacy probe: dedup-hit check + GC-mark touch in ONE
+                # syscall (the old os.path.exists + touch pair
+                # double-statted every hit)
+                exists = True
+                try:
+                    os.utime(p)
+                except FileNotFoundError:
+                    exists = False
+                except OSError:
+                    # utime denied (read-only store surface) but the
+                    # chunk may exist — explicit stat before rewriting
+                    exists = os.path.exists(p)
+                if exists:
+                    self._note_datablob_hit(digest, p, shard)
+                    return False
+            if verify and hashlib.sha256(data).digest() != digest:
+                raise ValueError("chunk digest mismatch on insert")
+            self._write_chunk(p, data, shard)
+            if self.index is not None:
+                self.index.insert(digest)
+                if self.blob_format == "pbs":
+                    self.index.mark_datablob(digest)
+            elif self.blob_format == "pbs":
+                self._remember_datablob(digest)
+            return True
+
+    def note_dedup_hit(self, digest: bytes) -> bool:
+        """Record a dedup hit discovered via ``probe_batch``: GC-mark
+        touch + the pbs-format upgrade probe, without re-probing
+        membership.  False when the file is GONE (index stale against
+        an external delete) — the caller must fall back to ``insert``
+        with the chunk bytes in hand."""
+        p = self._path(digest)
+        shard = self.shard_of(digest)
+        with self._shard_locks[shard]:
+            return self._touch_hit(digest, p, shard)
+
+    def _touch_hit(self, digest: bytes, p: str, shard: int) -> bool:
+        """Shared dedup-hit tail (caller holds the shard lock)."""
         try:
             os.utime(p)
         except FileNotFoundError:
-            exists = False
-        except OSError:
-            # utime denied (read-only store surface) but the chunk may
-            # exist — fall back to the explicit stat before rewriting
-            exists = os.path.exists(p)
-        if exists:
-            if self.blob_format == "pbs" \
-                    and digest not in self._datablob_seen:
-                # a dedup hit against a NATIVE raw-zstd chunk would leave
-                # this pbs-format snapshot referencing a file a stock PBS
-                # cannot decode — upgrade it to a DataBlob in place (this
-                # build reads both, so nothing else notices).  Confirmed
-                # once per digest per process: chunks are immutable, so
-                # the probe never needs repeating on later dedup hits.
-                self._upgrade_to_datablob(p)
-                self._remember_datablob(digest)
             return False
-        if verify and hashlib.sha256(data).digest() != digest:
-            raise ValueError("chunk digest mismatch on insert")
-        os.makedirs(os.path.dirname(p), exist_ok=True)
+        except OSError:
+            # utime denied (read-only surface) — but some mounts raise
+            # EACCES/EROFS for MISSING paths too, and declaring a hit
+            # on a memory view alone is the false-skip the design
+            # forbids: confirm on disk before trusting the index
+            if not os.path.exists(p):
+                return False
+        self._note_datablob_hit(digest, p, shard)
+        return True
+
+    def _write_chunk(self, p: str, data: bytes, shard: int) -> None:
+        d = os.path.dirname(p)
+        if d not in self._made_dirs:
+            os.makedirs(d, exist_ok=True)
+            self._made_dirs.add(d)
         tmp = f"{p}.tmp.{os.getpid()}.{threading.get_ident()}"
         if self.blob_format == "pbs":
             from .pbsformat import blob_encode
-            payload = blob_encode(data, cctx=self._cctx)
+            payload = blob_encode(data, cctx=self._shard_cctx[shard])
         else:
-            payload = self._cctx.compress(data)
+            payload = self._shard_cctx[shard].compress(data)
         with open(tmp, "wb") as f:
             f.write(payload)
         os.replace(tmp, p)
-        if self.blob_format == "pbs":
+
+    def _note_datablob_hit(self, digest: bytes, p: str, shard: int) -> None:
+        """pbs-format dedup hit: a hit against a NATIVE raw-zstd chunk
+        would leave this pbs-format snapshot referencing a file a stock
+        PBS cannot decode — upgrade it to a DataBlob in place (this
+        build reads both, so nothing else notices).  Confirmed once per
+        digest: chunks are immutable, so the probe never needs
+        repeating — the knowledge rides the dedup index (exact,
+        unbounded) or, index-less, the bounded legacy set."""
+        if self.blob_format != "pbs":
+            return
+        if self.index is not None:
+            if self.index.is_datablob(digest):
+                return
+            self._upgrade_to_datablob(p, shard)
+            self.index.mark_datablob(digest)
+            return
+        with self._datablob_lock:
+            seen = digest in self._datablob_seen
+        if not seen:
+            self._upgrade_to_datablob(p, shard)
             self._remember_datablob(digest)
-        return True
 
     def _remember_datablob(self, digest: bytes) -> None:
-        if len(self._datablob_seen) >= self._datablob_seen_cap:
-            self._datablob_seen.clear()
-        self._datablob_seen.add(digest)
+        with self._datablob_lock:
+            if len(self._datablob_seen) >= self._datablob_seen_cap:
+                # evict an arbitrary half, never everything: the hot
+                # half re-learns in O(cap/2) probes instead of O(store)
+                drop = len(self._datablob_seen) // 2
+                it = iter(self._datablob_seen)
+                victims = [next(it) for _ in range(drop)]
+                self._datablob_seen.difference_update(victims)
+            self._datablob_seen.add(digest)
 
-    def _upgrade_to_datablob(self, p: str) -> None:
+    def _upgrade_to_datablob(self, p: str, shard: int = 0) -> None:
         from .pbsformat import blob_encode, is_datablob
-        with open(p, "rb") as f:
-            raw = f.read()
+        try:
+            with open(p, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return          # vanished under us (external delete): the
+                            # membership answer already handled it
         if is_datablob(raw):
             return
         data = self._dctx.decompress(raw, max_output_size=1 << 30)
         tmp = f"{p}.tmp.{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "wb") as f:
-            f.write(blob_encode(data, cctx=self._cctx))
+            f.write(blob_encode(data, cctx=self._shard_cctx[shard]))
         os.replace(tmp, p)
 
     def get(self, digest: bytes) -> bytes:
@@ -221,6 +415,31 @@ class ChunkStore:
         except OSError:
             pass
 
+    def touch_many(self, digests) -> None:
+        """GC phase-1 mark over many digests, shard-parallel: digests
+        group by shard and each shard's utime loop runs on its own
+        worker (utime releases the GIL, so even a 1-core host overlaps
+        the syscall waits)."""
+        by_shard: dict[int, list[bytes]] = {}
+        for d in digests:
+            by_shard.setdefault(self.shard_of(d), []).append(d)
+        if not by_shard:
+            return
+        if len(by_shard) == 1:
+            for d in next(iter(by_shard.values())):
+                self.touch(d)
+            return
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(
+                max_workers=min(8, len(by_shard)),
+                thread_name_prefix="gc-mark") as ex:
+            for group in by_shard.values():
+                ex.submit(self._touch_all, group)
+
+    def _touch_all(self, digests: "list[bytes]") -> None:
+        for d in digests:
+            self.touch(d)
+
     def chunk_size(self, digest: bytes) -> int:
         return os.path.getsize(self._path(digest))
 
@@ -236,17 +455,67 @@ class ChunkStore:
     def sweep(self, before: float) -> tuple[int, int]:
         """Remove chunks with atime/mtime older than ``before``; returns
         (count_removed, bytes_removed).  Caller is responsible for having
-        touched all live chunks after the mark (GC phase 1)."""
+        touched all live chunks after the mark (GC phase 1).
+
+        Runs shard-parallel: prefix dirs group by shard (first digest
+        byte) and each shard sweeps on its own worker.  Index coherence:
+        a digest leaves the filter BEFORE its file is unlinked, so the
+        only reachable inconsistency is a safe false negative (a chunk
+        on disk the index forgot re-stores idempotently) — a swept
+        digest can never yield a false dedup skip.  The index snapshot
+        is re-saved after the sweep so the next boot loads a
+        post-sweep-coherent view."""
         # fires BEFORE any unlink: an injected fault proves the mark→sweep
-        # ordering (a sweep that dies here has removed nothing, so marked
-        # chunks — including checkpoint-referenced ones — are untouched)
+        # ordering (a sweep that dies here has removed nothing — and has
+        # discarded nothing from the index — so marked chunks, including
+        # checkpoint-referenced ones, are untouched)
         failpoints.hit("pbsstore.chunk.sweep")
+        # force the lazy index boot NOW, before any worker unlinks: a
+        # boot scan racing the unlinks could re-learn a digest whose
+        # discard already happened — exactly the false-skip the
+        # discard-before-unlink ordering forbids
+        _ = self.index
+        by_shard: dict[int, list[str]] = {}
+        for sub in os.listdir(self.base):
+            if not os.path.isdir(os.path.join(self.base, sub)):
+                continue
+            try:
+                shard = int(sub[:2], 16) % self.n_shards
+            except ValueError:
+                shard = 0
+            by_shard.setdefault(shard, []).append(sub)
+        if not by_shard:
+            return 0, 0
+        if len(by_shard) == 1:
+            results = [self._sweep_subdirs(next(iter(by_shard.values())),
+                                           before)]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(by_shard)),
+                    thread_name_prefix="gc-sweep") as ex:
+                results = list(ex.map(
+                    lambda subs: self._sweep_subdirs(subs, before),
+                    by_shard.values()))
+        removed = sum(r for r, _ in results)
+        freed = sum(f for _, f in results)
+        if self.index is not None:
+            # unconditional: boot consumed any previous snapshot, so a
+            # zero-removal sweep must still leave one behind or every
+            # restart in steady state re-pays the full shard scan
+            try:
+                self.save_index_snapshot()
+            except OSError:
+                pass        # snapshot is an optimization; the next boot
+                            # falls back to the shard scan
+        return removed, freed
+
+    def _sweep_subdirs(self, subs: "list[str]",
+                       before: float) -> tuple[int, int]:
         removed = 0
         freed = 0
-        for sub in os.listdir(self.base):
+        for sub in subs:
             d = os.path.join(self.base, sub)
-            if not os.path.isdir(d):
-                continue
             for name in os.listdir(d):
                 p = os.path.join(d, name)
                 if len(name) != 64:
@@ -261,15 +530,33 @@ class ChunkStore:
                         pass
                     continue
                 try:
-                    st = os.stat(p)
-                    if max(st.st_atime, st.st_mtime) < before:
-                        os.unlink(p)
-                        # counted only after a successful unlink — an
-                        # EPERM failure must not inflate bytes_freed
-                        freed += st.st_size
-                        removed += 1
-                except OSError:
-                    continue
+                    digest = bytes.fromhex(name)
+                except ValueError:
+                    continue         # 64-char non-hex stranger: leave it
+                # the stat/discard/unlink triple runs under the shard
+                # lock so a concurrent dedup hit cannot slip its utime
+                # in after our stat: the server serializes GC against
+                # jobs, but the store's own thread_safe contract must
+                # not depend on that (a hit landing mid-triple would
+                # publish a reference to a chunk this unlink deletes)
+                with self._shard_locks[self.shard_of(digest)]:
+                    try:
+                        st = os.stat(p)
+                        if max(st.st_atime, st.st_mtime) < before:
+                            if self.index is not None:
+                                # discard BEFORE unlink: if the unlink
+                                # then fails the chunk survives
+                                # index-less (safe false negative),
+                                # never the reverse
+                                self.index.discard(digest)
+                            os.unlink(p)
+                            # counted only after a successful unlink —
+                            # an EPERM failure must not inflate
+                            # bytes_freed
+                            freed += st.st_size
+                            removed += 1
+                    except OSError:
+                        continue
         return removed, freed
 
 
@@ -446,16 +733,23 @@ class Datastore:
     MANIFEST = "manifest.json"
     MANIFEST_PBS = "index.json.blob"
 
-    def __init__(self, base: str, *, pbs_format: bool = False):
+    def __init__(self, base: str, *, pbs_format: bool = False,
+                 store_shards: "int | None" = None,
+                 dedup_index_mb: "int | None" = None):
         """pbs_format=True publishes snapshots in the stock-PBS on-disk
         layout (DataBlob chunks, PBS dynamic indexes under .didx names,
         index.json.blob manifest) so a PBS can serve what this build
-        writes.  Reads sniff per-file, so both layouts coexist."""
+        writes.  Reads sniff per-file, so both layouts coexist.
+        ``store_shards``/``dedup_index_mb`` size the chunk store's shard
+        count and dedup-index budget (None → the PBS_PLUS_STORE_SHARDS /
+        PBS_PLUS_DEDUP_INDEX_MB environment knobs)."""
         self.base = base
         self.pbs_format = pbs_format
         os.makedirs(base, exist_ok=True)
         self.chunks = ChunkStore(base,
-                                 blob_format="pbs" if pbs_format else "zstd")
+                                 blob_format="pbs" if pbs_format else "zstd",
+                                 n_shards=store_shards,
+                                 index_budget_mb=dedup_index_mb)
 
     @property
     def meta_idx_name(self) -> str:

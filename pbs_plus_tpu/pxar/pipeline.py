@@ -95,6 +95,12 @@ def locked_store(store) -> _LockedStore:
     same non-thread-safe zstd context from a different lock."""
     if isinstance(store, _LockedStore):
         return store
+    if getattr(store, "thread_safe", False):
+        # sharded ChunkStore (pxar/datastore.py): per-shard locks +
+        # per-shard compressors make every mutating path safe already —
+        # wrapping would re-serialize all shards behind ONE lock and
+        # undo exactly the contention win the sharding bought
+        return store
     with _wrap_lock:
         proxy = getattr(store, "_locked_proxy", None)
         if proxy is None:
@@ -358,8 +364,16 @@ class PipelinedStream(_ChunkedStream):
                     _, batch, fut = slot
                     try:
                         digests = fut.result()
-                        for (idx, chunk), digest in zip(batch, digests):
-                            self._commit(idx, digest, chunk)
+                        # one dedup-index probe per hash batch — the
+                        # same batched entry point the sequential
+                        # writer's _flush_hashes uses, so new/known
+                        # accounting stays bit-identical
+                        known = self._probe_known(digests)
+                        for i, ((idx, chunk), digest) in enumerate(
+                                zip(batch, digests)):
+                            self._commit(idx, digest, chunk,
+                                         known[i] if known is not None
+                                         else None)
                     finally:
                         self._batch_slots.release()
         except BaseException as e:
@@ -378,9 +392,13 @@ class PipelinedStream(_ChunkedStream):
                 else:
                     self._batch_slots.release()
 
-    def _commit(self, idx: int, digest: bytes, chunk) -> None:
+    def _commit(self, idx: int, digest: bytes, chunk,
+                known: "bool | None" = None) -> None:
         end, _ = self.records[idx]
         self.records[idx] = (end, digest)
         t0 = time.perf_counter()
-        self._insert(digest, chunk)          # inherited new/known counting
+        # inherited new/known counting; `known` is the batched-probe
+        # hint (None on the per-chunk path — insert probes the index
+        # itself, still disk-free for negatives)
+        self._insert_probed(digest, chunk, known)
         METRICS.add("insert", len(chunk), time.perf_counter() - t0, 1)

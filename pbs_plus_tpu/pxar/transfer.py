@@ -216,15 +216,39 @@ class _ChunkedStream:
         else:
             self.stats.known_chunks += 1
 
+    def _probe_known(self, digests: "list[bytes]") -> "list[bool] | None":
+        """One batched dedup-index probe for a whole digest batch
+        (ChunkStore.probe_batch → chunkindex.DedupIndex); None when the
+        store has no index — callers then insert per digest."""
+        probe = getattr(self.store, "probe_batch", None)
+        if probe is None:
+            return None
+        return probe(digests)
+
+    def _insert_probed(self, digest: bytes, chunk: bytes,
+                       known: "bool | None") -> None:
+        """Insert with a batched-probe hint: a probed-present digest
+        takes the dedup-hit tail (GC-mark touch + pbs upgrade probe)
+        without re-probing membership; ``note_dedup_hit`` returning
+        False (file vanished under a stale index) falls back to the
+        authoritative insert with the bytes still in hand."""
+        if known and self.store.note_dedup_hit(digest):
+            self.stats.known_chunks += 1
+        else:
+            self._insert(digest, chunk)
+
     def _flush_hashes(self) -> None:
         if not self._pending:
             return
         assert self._hasher is not None
         digests = self._hasher([c for _, c in self._pending])
-        for (idx, chunk), digest in zip(self._pending, digests):
+        known = self._probe_known(digests)
+        for i, ((idx, chunk), digest) in enumerate(zip(self._pending,
+                                                       digests)):
             end, _ = self.records[idx]
             self.records[idx] = (end, digest)
-            self._insert(digest, chunk)
+            self._insert_probed(digest, chunk,
+                                known[i] if known is not None else None)
         self._pending.clear()
         self._pending_bytes = 0
 

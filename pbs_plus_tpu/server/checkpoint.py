@@ -342,7 +342,11 @@ def load_latest(ds: Datastore, backup_type: str, backup_id: str,
             pidx = DynamicIndex.parse(os.path.join(path, PAYLOAD_IDX))
             digests = {midx.digest(i) for i in range(len(midx))}
             digests.update(pidx.digest(i) for i in range(len(pidx)))
-            missing = sum(1 for d in digests if not ds.chunks.has(d))
+            # disk-TRUE check, bypassing the dedup index on purpose: a
+            # resume spliced over a vanished chunk (GC race, disk loss)
+            # would publish a hole, so this integrity gate must not
+            # trust any memory-resident view
+            missing = sum(1 for d in digests if not ds.chunks.on_disk(d))
             if missing:
                 raise ValueError(f"{missing} referenced chunk(s) missing "
                                  "from the store")

@@ -308,6 +308,43 @@ class MetricsRegistry:
               "vector self-test)",
               [({}, float(co["events"].get("vector_fallbacks", 0)))])
 
+        # -- dedup index (pxar/chunkindex.py; docs/data-plane.md
+        #    "Dedup index") ---------------------------------------------------
+        from ..pxar import chunkindex as _chunkindex
+        di = _chunkindex.metrics_snapshot()
+        gauge("pbs_plus_dedup_index_probes_total",
+              "Membership probes answered by the dedup index (batched "
+              "probes count one per digest)", [({}, float(di["probes"]))])
+        gauge("pbs_plus_dedup_index_hits_total",
+              "Probes confirmed present (dedup hits)",
+              [({}, float(di["hits"]))])
+        gauge("pbs_plus_dedup_index_false_positives_total",
+              "Filter positives rejected by the exact confirm (never a "
+              "false dedup skip)", [({}, float(di["false_positives"]))])
+        gauge("pbs_plus_dedup_index_inserts_total",
+              "Digests inserted into the index",
+              [({}, float(di["inserts"]))])
+        gauge("pbs_plus_dedup_index_rebuilds_total",
+              "Boot-time shard-scan rebuilds",
+              [({}, float(di["rebuilds"]))])
+        gauge("pbs_plus_dedup_index_discards_total",
+              "Digests discarded by GC sweeps",
+              [({}, float(di["discards"]))])
+        gauge("pbs_plus_dedup_index_snapshot_loads_total",
+              "Journaled index snapshots loaded at boot",
+              [({}, float(di["snapshot_loads"]))])
+        gauge("pbs_plus_dedup_index_snapshot_saves_total",
+              "Journaled index snapshots persisted (post-sweep); a "
+              "sweep without a matching save means boots re-pay the "
+              "shard scan", [({}, float(di["snapshot_saves"]))])
+        gauge("pbs_plus_dedup_index_entries",
+              "Digests resident across live dedup indexes",
+              [({}, float(di["entries"]))])
+        gauge("pbs_plus_dedup_index_resident_bytes",
+              "Estimated resident bytes of live dedup indexes (filter "
+              "table + exact host set)",
+              [({}, float(di["resident_bytes"]))])
+
         # -- read-path chunk cache (pxar/chunkcache.py) -----------------------
         from ..pxar import chunkcache as _chunkcache
         cc = _chunkcache.metrics_snapshot()

@@ -109,8 +109,9 @@ def mark_live_chunks(ds: Datastore) -> int:
             for i in range(len(idx.ends)):
                 live.add(idx.digests[i].tobytes())
     live.update(_checkpoint.live_checkpoint_digests(ds))
-    for dg in live:
-        ds.chunks.touch(dg)
+    # shard-parallel mark (pxar/datastore.py touch_many): per-shard
+    # utime loops overlap their syscall waits
+    ds.chunks.touch_many(live)
     return len(live)
 
 

@@ -107,6 +107,13 @@ class ServerConfig:
     # (0 = auto: min(8, cores); 1 = sequential)
     chunk_cache_mb: int = -1
     verify_workers: int = 0
+    # dedup index + store sharding (pxar/chunkindex.py, docs/
+    # data-plane.md "Dedup index"): memory budget of the cuckoo-filter
+    # membership front in MiB (0 disables it) and the chunk store's
+    # logical shard count.  Negative values fall back to the
+    # PBS_PLUS_DEDUP_INDEX_MB / PBS_PLUS_STORE_SHARDS environment knobs
+    dedup_index_mb: int = -1
+    store_shards: int = -1
     # fleet admission + queueing (docs/fleet.md): per-client session-open
     # token bucket, global opens/s bucket, concurrent-session ceiling
     # (AgentsManager), and the jobs waiting-queue bound (JobsManager,
@@ -159,7 +166,11 @@ class Server:
                 config.chunker, cpu_backend=config.chunker_backend),
             batch_hasher=make_batch_hasher(config.chunker),
             pbs_format=config.datastore_format == "pbs",
-            pipeline_workers=config.pipeline_workers)
+            pipeline_workers=config.pipeline_workers,
+            store_shards=(None if config.store_shards < 0
+                          else config.store_shards),
+            dedup_index_mb=(None if config.dedup_index_mb < 0
+                            else config.dedup_index_mb))
         self.scheduler = Scheduler(
             self.db, self.jobs,
             enqueue_backup=self._enqueue_backup_row,
@@ -535,7 +546,19 @@ class Server:
                     row.chunker, cpu_backend=self.config.chunker_backend),
                 batch_hasher=make_batch_hasher(row.chunker),
                 pbs_format=self.config.datastore_format == "pbs",
-                pipeline_workers=self.config.pipeline_workers)
+                pipeline_workers=self.config.pipeline_workers,
+                store_shards=(None if self.config.store_shards < 0
+                              else self.config.store_shards),
+                dedup_index_mb=0)
+            # the per-job store shares the server datastore's directory —
+            # share the ONE dedup index too (built above with index
+            # disabled), so the two views can never disagree about
+            # membership within this process.  RAW `_index`, not the
+            # property: the getter would run the lazy boot scan HERE,
+            # on the event loop — boot state rides the index object and
+            # the scan happens on whichever writer thread probes first
+            store.datastore.chunks.index = \
+                self.datastore.datastore.chunks._index
 
         async def execute():
             from . import hooks

@@ -74,6 +74,15 @@ class Env:
     # scan prefetches (0 disables readahead)
     chunk_cache_mb: int = 256
     chunk_readahead: int = 4
+    # dedup index (pxar/chunkindex.py, docs/data-plane.md "Dedup
+    # index"): initial byte budget of the memory-resident cuckoo-filter
+    # membership front (MiB; the filter still grows under load-factor
+    # pressure; 0 disables the index — negative dedup probes then fall
+    # back to a per-digest disk stat) and the chunk store's logical
+    # shard count (per-shard locks + compressors; GC mark/sweep runs
+    # shard-parallel)
+    dedup_index_mb: int = 64
+    store_shards: int = 16
     # fleet admission control (arpc/agents_manager.py, docs/fleet.md):
     # per-client token bucket (the old hardcoded 10/s burst 20), a
     # global session-open rate bucket, and a hard ceiling on concurrent
@@ -122,6 +131,8 @@ def env() -> Env:
         checkpoint_interval=e.get("PBS_PLUS_CHECKPOINT_INTERVAL", ""),
         chunk_cache_mb=_int_env(e, "PBS_PLUS_CHUNK_CACHE_MB", "256"),
         chunk_readahead=_int_env(e, "PBS_PLUS_CHUNK_READAHEAD", "4"),
+        dedup_index_mb=_int_env(e, "PBS_PLUS_DEDUP_INDEX_MB", "64"),
+        store_shards=_int_env(e, "PBS_PLUS_STORE_SHARDS", "16"),
         agent_rate=_float_env(e, "PBS_PLUS_AGENT_RATE",
                               str(CLIENT_RATE_LIMIT_PER_SEC)),
         agent_burst=_int_env(e, "PBS_PLUS_AGENT_BURST",

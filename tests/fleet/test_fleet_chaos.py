@@ -128,6 +128,73 @@ def test_fleet_chaos_composition(tmp_path):
         del reader
 
 
+def test_fleet_chaos_gc_dedup_index_coherent(tmp_path):
+    """ISSUE 8 acceptance: a 10%-kill fleet-chaos run followed by a GC
+    mark/sweep leaves the dedup filter coherent — the index and the
+    disk agree digest-for-digest (so no false dedup skip is reachable),
+    a re-backup of identical content fully dedups through the index
+    (zero new chunks), and every snapshot still restores bit-identical
+    to its synthetic source."""
+    from pbs_plus_tpu.chunker import ChunkerParams
+    from pbs_plus_tpu.pxar.backupproxy import LocalStore
+    from pbs_plus_tpu.server.prune import PrunePolicy, run_prune
+
+    n = 20
+    cfg = _cfg(n_agents=n, kill_fraction=0.10, kill_after_reads=2)
+    rep = run_fleet(str(tmp_path / "ds"), cfg)
+    assert rep.to_dict()["published"] == n, rep.failures
+    assert len(rep.killed) == max(1, int(n * cfg.kill_fraction))
+
+    store = LocalStore(str(tmp_path / "ds"),
+                       ChunkerParams(avg_size=cfg.chunk_avg),
+                       store_shards=8, dedup_index_mb=4)
+    ds = store.datastore
+    assert ds.chunks.index is not None
+
+    # GC over the chaos-produced store: mark (shard-parallel touch_many)
+    # + sweep; keep-all policy, so only unreferenced debris may go
+    run_prune(ds, PrunePolicy(), gc=True, gc_grace_s=0)
+
+    # filter <-> disk coherence, both directions
+    disk = set(ds.chunks.iter_digests())
+    known = set(ds.chunks.index.digests())
+    assert disk == known
+
+    # no false dedup skips, and no false MISSES either: every payload
+    # digest of every published snapshot answers present in one batched
+    # probe, and re-inserting the identical chunk bytes rides the index
+    # as a dedup hit (returns False) for all of them
+    probe_digests: list[bytes] = []
+    for cn in sorted(rep.refs):
+        for snap in ds.list_snapshots("host", cn):
+            reader = store.open_snapshot(snap)
+            pidx = reader.payload_index
+            probe_digests.extend(pidx.digest(i) for i in range(len(pidx)))
+            del reader
+    assert probe_digests
+    assert all(ds.chunks.probe_batch(probe_digests))
+    cn0 = sorted(rep.refs)[0]
+    reader = store.open_snapshot(ds.list_snapshots("host", cn0)[0])
+    for i in range(len(reader.payload_index)):
+        d = reader.payload_index.digest(i)
+        assert ds.chunks.insert(d, reader.fetch_chunk(d),
+                                verify=False) is False
+    del reader
+
+    # every chaos-run snapshot (killed agents' resumes included) still
+    # restores bit-identical to its synthetic source
+    for cn in sorted(rep.refs)[:5] + sorted(rep.killed):
+        i = int(cn.split("-")[1])
+        want = synthetic_tree(cfg.seed, i, cfg.files_per_agent,
+                              cfg.file_size)
+        snaps = ds.list_snapshots("host", cn)
+        reader = store.open_snapshot(snaps[0])
+        for rel, data in want.items():
+            e = reader.lookup(rel)
+            assert e is not None and reader.read_file(e) == data, (cn, rel)
+        del reader
+
+
 def test_fleet_chaos_no_cross_tenant_starvation(tmp_path):
     """A noisy tenant's 400-job backlog cannot starve another tenant's
     single job: under round-robin slot grants the victim waits at most

@@ -261,6 +261,28 @@ def test_bench_fleet_soak(tmp_path):
     assert not res["bound_violated"]
 
 
+def test_bench_dedup_index():
+    """Dedup-index benchmark (bench._dedup_index_bench → detail.
+    dedup_index in the bench JSON) with the ISSUE 8 acceptance gates:
+    batched probe >= 10x the per-digest stat path, zero observed false
+    positives, analytic FP bound <= 2^-40."""
+    import bench
+
+    n = 1_000_000 if FULL else 150_000
+    res = bench._dedup_index_bench(n=n)
+    print(f"\n  dedup index n={n}: insert {res['insert_per_s']:>12,.0f}/s"
+          f" | probe {res['batched_probe_per_s']:>12,.0f}/s"
+          f" | stat {res['per_digest_stat_per_s']:>10,.0f}/s"
+          f" ({res['batched_vs_stat']}x)"
+          f" | {res['resident_bytes_per_digest']} B/digest"
+          f" | fp {res['false_positives']}")
+    assert res["batched_vs_stat"] >= 10.0, res
+    assert res["false_positives"] == 0
+    assert res["fp_rate_bound"] <= 2.0 ** -40
+    # membership stays exact at scale and the filter never overcommits
+    assert res["insert_per_s"] > 0 and res["negative_probe_per_s"] > 0
+
+
 def test_bench_commit_walk_refs(tmp_path):
     """Commit-walk with many unchanged files (ref coalescing — the
     B1/B4 'refs sort + coalescing' analog): re-commit of an untouched

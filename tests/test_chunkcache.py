@@ -459,9 +459,9 @@ def test_insert_dedup_hit_skips_datablob_reprobe(tmp_path, monkeypatch):
     probes = []
     orig = ChunkStore._upgrade_to_datablob
 
-    def counting(self, p):
+    def counting(self, p, shard=0):
         probes.append(p)
-        return orig(self, p)
+        return orig(self, p, shard)
 
     monkeypatch.setattr(ChunkStore, "_upgrade_to_datablob", counting)
     assert cs.insert(d, data) is True

@@ -37,7 +37,7 @@ def test_registry_has_expected_rules():
         "no-hostsync-in-hot-loop", "subprocess-timeout",
         "thread-hygiene", "resource-ctx", "mutable-default",
         "failpoint-discipline", "cache-discipline",
-        "bounded-queue-discipline",
+        "bounded-queue-discipline", "index-discipline",
     }
 
 
@@ -77,6 +77,60 @@ def test_cache_discipline_scoped_to_read_path_modules():
         def load(store, digest):
             return store.get(digest)
     """, path="pbs_plus_tpu/pxar/chunkcache.py", rules=["cache-discipline"])
+    assert v == []
+
+
+# -------------------------------------------------- index-discipline
+
+
+def test_index_discipline_flags_exists_on_chunks_path():
+    v = run_lint("""
+        import os
+        def probe(ds, digest):
+            return os.path.exists(os.path.join(ds.base, ".chunks",
+                                               digest.hex()))
+    """, path="pbs_plus_tpu/server/verification_job.py",
+        rules=["index-discipline"])
+    assert names(v) == ["index-discipline"]
+    assert "membership oracle" in v[0].message
+
+
+def test_index_discipline_flags_stat_on_path_builder():
+    v = run_lint("""
+        import os
+        def hot(store, digest):
+            return os.stat(store._path(digest)).st_size > 0
+    """, path="pbs_plus_tpu/pxar/remote.py", rules=["index-discipline"])
+    assert names(v) == ["index-discipline"]
+
+
+def test_index_discipline_clean_on_non_chunk_paths():
+    v = run_lint("""
+        import os
+        def check(snapdir):
+            return os.path.exists(os.path.join(snapdir, "manifest.json"))
+    """, path="pbs_plus_tpu/server/restore_job.py",
+        rules=["index-discipline"])
+    assert v == []
+
+
+def test_index_discipline_datastore_module_exempt():
+    # the store implements the oracle: its own legacy fallback probe
+    # (index disabled) is sanctioned
+    v = run_lint("""
+        import os
+        def has(self, digest):
+            return os.path.exists(self._path(digest))
+    """, path="pbs_plus_tpu/pxar/datastore.py", rules=["index-discipline"])
+    assert v == []
+
+
+def test_index_discipline_out_of_scope_module_clean():
+    v = run_lint("""
+        import os
+        def peek(base, digest):
+            return os.path.exists(os.path.join(base, ".chunks", digest))
+    """, path="pbs_plus_tpu/agent/client.py", rules=["index-discipline"])
     assert v == []
 
 

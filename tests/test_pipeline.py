@@ -224,18 +224,34 @@ def test_session_writer_pipeline_end_to_end(tmp_path):
 def test_session_writer_shares_one_locked_store(tmp_path):
     """Meta (writer thread) and payload (committer thread) insert into
     the same store concurrently; SessionWriter must hand both streams
-    ONE _LockedStore so those inserts serialize."""
+    ONE safely-shareable store: the sharded ChunkStore passes through
+    unwrapped (it is thread-safe per shard — ISSUE 8), while a
+    non-thread-safe store still gets ONE shared _LockedStore."""
     from pbs_plus_tpu.pxar.pipeline import _LockedStore
     from pbs_plus_tpu.pxar.transfer import SessionWriter
 
     st = ChunkStore(str(tmp_path / "ls"))
+    assert st.thread_safe
     w = SessionWriter(st, payload_params=P, pipeline_workers=2)
-    assert isinstance(w.payload.store, _LockedStore)
-    assert w.meta.store is w.payload.store
+    assert w.payload.store is st            # no re-serializing wrap
+    assert w.meta.store is st
     w.finish()
+
+    class _UnsafeStore:
+        def insert(self, digest, data, *, verify=True):
+            return True
+
+        def touch(self, digest):
+            pass
+
+    us = _UnsafeStore()
+    w1 = SessionWriter(us, payload_params=P, pipeline_workers=2)
+    assert isinstance(w1.payload.store, _LockedStore)
+    assert w1.meta.store is w1.payload.store
+    w1.finish()
     # sequential sessions stay unwrapped (no lock overhead)
-    w0 = SessionWriter(st, payload_params=P)
-    assert w0.meta.store is st
+    w0 = SessionWriter(us, payload_params=P)
+    assert w0.meta.store is us
 
 
 def test_meta_finish_failure_reaps_payload_pipeline(tmp_path):
@@ -272,25 +288,35 @@ def test_metrics_snapshot_counts_stages(tmp_path):
 
 
 def test_locked_store_memoized_across_writers(tmp_path):
-    """Concurrent jobs share the server's ONE ChunkStore; every wrap of
-    the same store object must return the same proxy (one lock), or two
-    jobs' committers race the shared zstd context under different
-    locks."""
+    """Concurrent jobs share the server's ONE store; every wrap of the
+    same non-thread-safe store object must return the same proxy (one
+    lock), or two jobs' committers race the shared zstd context under
+    different locks.  The sharded ChunkStore is thread-safe and passes
+    through locked_store identically for every caller."""
     from pbs_plus_tpu.pxar.pipeline import _LockedStore, locked_store
     from pbs_plus_tpu.pxar.transfer import SessionWriter
 
     st = ChunkStore(str(tmp_path / "ls"))
-    p1 = locked_store(st)
-    p2 = locked_store(st)
-    assert p1 is p2 and isinstance(p1, _LockedStore)
-    assert locked_store(p1) is p1           # idempotent on the proxy
-
+    assert locked_store(st) is st           # thread-safe: no wrap at all
     w1 = SessionWriter(st, payload_params=P, pipeline_workers=2)
     w2 = SessionWriter(st, payload_params=P, pipeline_workers=2)
-    assert w1.payload.store is w2.payload.store
-    assert w1.payload.store._lock is w2.payload.store._lock
+    assert w1.payload.store is st and w2.payload.store is st
     w1.finish()
     w2.finish()
+
+    class _UnsafeStore:
+        def insert(self, digest, data, *, verify=True):
+            return True
+
+        def touch(self, digest):
+            pass
+
+    us = _UnsafeStore()
+    p1 = locked_store(us)
+    p2 = locked_store(us)
+    assert p1 is p2 and isinstance(p1, _LockedStore)
+    assert locked_store(p1) is p1           # idempotent on the proxy
+    assert p1._lock is p2._lock
 
 
 def test_finish_after_close_raises_not_corrupt_records(tmp_path):

@@ -20,6 +20,7 @@ from .mutable_defaults import MutableDefault
 from .failpoint_discipline import FailpointDiscipline
 from .cache_discipline import CacheDiscipline
 from .bounded_queue import BoundedQueueDiscipline
+from .index_discipline import IndexDiscipline
 
 RULE_CLASSES = [
     NoSilentSwallow,
@@ -34,6 +35,7 @@ RULE_CLASSES = [
     FailpointDiscipline,
     CacheDiscipline,
     BoundedQueueDiscipline,
+    IndexDiscipline,
 ]
 
 

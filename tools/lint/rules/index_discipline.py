@@ -1,0 +1,62 @@
+"""index-discipline — the dedup index is the only chunk-membership oracle.
+
+Invariant (pxar/chunkindex.py, docs/data-plane.md "Dedup index"): code
+under pbs_plus_tpu/pxar/ and pbs_plus_tpu/server/ must not probe chunk
+existence with filesystem calls (``os.path.exists`` / ``os.stat`` /
+``os.path.isfile`` / ``os.lstat``) on ``.chunks`` paths.  A direct
+probe pays a disk stat per digest (the exact cost the index exists to
+eliminate), bypasses the batched probe path, and — worse — can
+disagree with the index around a GC sweep, reintroducing the false
+dedup skips the sweep-coherence discipline rules out.  Go through the
+datastore module's sanctioned membership surface instead:
+``ChunkStore.has`` / ``probe_batch`` (index-backed), or
+``chunk_size``/``get`` when the chunk is already known live.
+
+``pxar/datastore.py`` itself is exempt — it implements the oracle.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+from ._util import call_name
+
+_SCOPES = ("pbs_plus_tpu/pxar/", "pbs_plus_tpu/server/")
+_EXEMPT = "pbs_plus_tpu/pxar/datastore.py"
+_PROBES = frozenset({
+    "os.path.exists", "os.path.lexists", "os.path.isfile",
+    "os.stat", "os.lstat",
+})
+# argument-text markers that say "this is a chunk path": the chunk dir
+# itself, the store's path builder, or a digest-derived path
+_CHUNK_MARKERS = (".chunks", "._path(", "chunk_path", "digest")
+
+
+class IndexDiscipline(Rule):
+    name = "index-discipline"
+    invariant = ("pxar/server modules never probe chunk existence via "
+                 "os.path.exists/os.stat on .chunks paths — the dedup "
+                 "index (ChunkStore.has/probe_batch) is the only "
+                 "membership oracle")
+
+    def begin_file(self, ctx):
+        return ctx.path.startswith(_SCOPES) and ctx.path != _EXEMPT
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        if call_name(node) not in _PROBES or not node.args:
+            return
+        try:
+            arg_src = ast.unparse(node.args[0])
+        except Exception:
+            return
+        low = arg_src.lower()
+        if not any(m in low for m in _CHUNK_MARKERS):
+            return
+        ctx.report(self, node,
+                   f"`{call_name(node)}({arg_src})` probes chunk "
+                   "existence on disk: one stat per digest, bypassing "
+                   "the dedup index and its GC sweep coherence — use "
+                   "ChunkStore.has / ChunkStore.probe_batch "
+                   "(pxar/chunkindex.py), the sanctioned membership "
+                   "oracle")
