@@ -486,6 +486,109 @@ def _dedup_index_bench(n: int | None = None, *,
     }
 
 
+def _delta_bench(mib: int = 16, *, generations: int = 6,
+                 mutate_frac: float = 0.005,
+                 chunk_avg: int = 64 << 10) -> dict:
+    """Similarity-tier benchmark (docs/data-plane.md "Similarity
+    tier"): a synthetic near-duplicate corpus per the CDC-survey
+    methodology (arXiv 2409.06066) — generation g mutates
+    ``mutate_frac`` of generation g-1's bytes in place — backed up into
+    a tier-off and a tier-on store.  Every chunk of every generation
+    past the first is novel to the exact-dedup tier (each carries
+    mutations), so the exact tier's ratio flatlines; the similarity
+    tier should store those chunks as small deltas.  Reported: dedup
+    ratio (logical payload bytes / on-disk chunk bytes) for both
+    stores, the tier-on/tier-off improvement (gated >= 1.5x in
+    tests/test_bench_harness.py), and the pbs_plus_delta_* counters
+    the run produced."""
+    import io
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from pbs_plus_tpu.chunker import ChunkerParams
+    from pbs_plus_tpu.pxar.backupproxy import LocalStore
+    from pbs_plus_tpu.pxar.format import KIND_DIR, KIND_FILE, Entry
+    from pbs_plus_tpu.pxar.similarityindex import metrics_snapshot
+
+    params = ChunkerParams(avg_size=chunk_avg)
+    rng = np.random.default_rng(17)
+    per_gen = (mib << 20) // generations
+    gens = [rng.integers(0, 256, per_gen, dtype=np.uint8)]
+    n_mut = max(1, int(per_gen * mutate_frac))
+    for _ in range(generations - 1):
+        g = gens[-1].copy()
+        idx = rng.choice(per_gen, n_mut, replace=False)
+        g[idx] = rng.integers(0, 256, n_mut, dtype=np.uint8)
+        gens.append(g)
+    logical = per_gen * generations
+
+    tmp = tempfile.mkdtemp(prefix="pbs-delta-bench-")
+    try:
+        def chunk_disk_bytes(store):
+            base = store.datastore.chunks.base
+            total = 0
+            for dirpath, _dirs, files in os.walk(base):
+                for f in files:
+                    total += os.path.getsize(os.path.join(dirpath, f))
+            return total
+
+        def run(name, **delta_kw):
+            store = LocalStore(os.path.join(tmp, name), params, **delta_kw)
+            sess = store.start_session(backup_type="host", backup_id="d")
+            sess.writer.write_entry(Entry(path="", kind=KIND_DIR))
+            for i, g in enumerate(gens):
+                sess.writer.write_entry_reader(
+                    Entry(path=f"gen{i:02d}.bin", kind=KIND_FILE),
+                    io.BytesIO(g.tobytes()))
+            sess.finish()
+            return store, sess.ref
+
+        m0 = metrics_snapshot()
+        off_store, off_ref = run("off", delta_tier=False)
+        t0 = time.perf_counter()
+        on_store, on_ref = run("on", delta_tier=True)
+        on_wall = time.perf_counter() - t0
+        m1 = metrics_snapshot()
+
+        off_disk = chunk_disk_bytes(off_store)
+        on_disk = chunk_disk_bytes(on_store)
+        ratio_off = logical / off_disk
+        ratio_on = logical / on_disk
+
+        # restore parity: the tier must not change a single byte
+        r_on = on_store.open_snapshot(on_ref)
+        r_off = off_store.open_snapshot(off_ref)
+        for i, g in enumerate(gens):
+            e = r_on.lookup(f"gen{i:02d}.bin")
+            if r_on.read_file(e) != g.tobytes():
+                raise AssertionError("tier-on restore diverged from source")
+        if [r for r in r_on.payload_index.records()] != \
+                [r for r in r_off.payload_index.records()]:
+            raise AssertionError("tier-on index records diverged")
+
+        return {
+            "source_mib": logical >> 20,
+            "generations": generations,
+            "mutate_frac": mutate_frac,
+            "chunk_avg": chunk_avg,
+            "dedup_ratio_off": round(ratio_off, 2),
+            "dedup_ratio_on": round(ratio_on, 2),
+            "on_vs_off": round(ratio_on / ratio_off, 2),
+            "disk_bytes_off": off_disk,
+            "disk_bytes_on": on_disk,
+            "tier_on_wall_s": round(on_wall, 3),
+            "delta_probes": m1["probes"] - m0["probes"],
+            "delta_hits": m1["hits"] - m0["hits"],
+            "delta_bytes_saved": m1["bytes_saved"] - m0["bytes_saved"],
+            "delta_chain_rejects": m1["chain_rejects"]
+            - m0["chain_rejects"],
+            "restore_parity": True,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _fleet_bench(n_agents: int | None = None) -> dict:
     """Loopback fleet soak (docs/fleet.md): N simulated agents speak real
     aRPC through AgentsManager admission and the fair jobs plane, one
@@ -852,6 +955,13 @@ def main() -> None:
         dedup_index = None
     if dedup_index is not None:
         result["detail"]["dedup_index"] = dedup_index
+    try:
+        delta = _delta_bench()
+    except Exception as e:
+        sys.stderr.write(f"[bench] delta tier bench unavailable: {e}\n")
+        delta = None
+    if delta is not None:
+        result["detail"]["delta"] = delta
     result["machine"] = _machine_context()
     print(json.dumps(result))
 

@@ -10,6 +10,28 @@ aggregated sketches (or per-chunk sketch sets).
 minhash: K universal-hash permutations over the digest set; the
 component-wise minimum forms the signature; expected fraction of equal
 components estimates Jaccard similarity of two snapshots' chunk sets.
+
+ISSUE 9 promotes these kernels from dormant analytics into the
+similarity-dedup tier's resemblance index (pxar/similarityindex.py).
+Two additions serve that:
+
+- **numpy host fallbacks** (``simhash_sketch_host``,
+  ``minhash_signature_host``): CPU-only tier-1 must never require a
+  device, so every kernel has a numpy twin, parity-pinned in
+  tests/test_ops.py — the ``ops/cuckoo.lookup_host`` discipline.
+- **content sketches** (``content_sketch_host`` /
+  ``content_sketch_device``): per-chunk simhash over content-defined
+  samples of the chunk BYTES (not its digest — a near-duplicate chunk
+  has a wholly different digest but mostly-identical byte windows).
+  Each overlapping 4-byte window hashes through two integer mixes;
+  windows whose first mix lands in a 1/64 sample class contribute their
+  (lo, hi) hash words as a 64-bit feature; the per-bit majority over
+  the feature set packs into a 64-bit sketch.  All arithmetic is
+  uint32/int32 wraparound, so the numpy and jax paths are bit-identical
+  by construction (no float sign boundaries), and Hamming distance
+  between sketches tracks byte-level similarity: mutating p%% of a
+  chunk's bytes perturbs ~4p%% of windows, leaving the majority vote —
+  and hence most sketch bits — intact.
 """
 
 from __future__ import annotations
@@ -102,3 +124,214 @@ def minhash_similarity(sig_a: np.ndarray, sig_b: np.ndarray) -> float:
     if sig_a.shape != sig_b.shape:
         raise ValueError("signature length mismatch")
     return float(np.mean(sig_a == sig_b))
+
+
+# -- numpy host fallbacks (parity pinned in tests/test_ops.py) --------------
+
+def simhash_sketch_host(digests: np.ndarray, *, k: int = 64,
+                        proj: np.ndarray | None = None) -> np.ndarray:
+    """numpy twin of ``simhash_sketch``: uint8[N,32] → uint32[N, k/32].
+    Same ±1 bit expansion, same projection (share the jax-made ``proj``
+    for cross-path parity), scores accumulated in float64 so the sign
+    decision never rides a float32 summation-order boundary."""
+    if k % 32:
+        raise ValueError("k must be a multiple of 32")
+    if proj is None:
+        proj = np.asarray(simhash_projection(k))
+    d = np.asarray(digests, dtype=np.uint8).reshape(-1, 32)
+    shifts = np.arange(7, -1, -1, dtype=np.uint8)
+    bits = ((d[:, :, None] >> shifts[None, None, :]) & np.uint8(1))
+    bits = bits.reshape(d.shape[0], 256).astype(np.float64) * 2.0 - 1.0
+    scores = bits @ np.asarray(proj, dtype=np.float64)
+    b = (scores >= 0).astype(np.uint32).reshape(-1, k // 32, 32)
+    sh = np.arange(31, -1, -1, dtype=np.uint32)
+    return np.sum(b << sh[None, None, :], axis=-1, dtype=np.uint32)
+
+
+def minhash_signature_host(digests: np.ndarray, *, k: int = 128,
+                           seed: int = 99) -> np.ndarray:
+    """numpy twin of ``minhash_signature`` (uint32 wraparound arithmetic
+    — exact parity)."""
+    d = np.asarray(digests, dtype=np.uint8).reshape(-1, 32).astype(np.uint32)
+    a, b = _minhash_params(k, seed)
+    w = (d[:, 0] << np.uint32(24)) | (d[:, 1] << np.uint32(16)) \
+        | (d[:, 2] << np.uint32(8)) | d[:, 3]
+    w = w ^ ((d[:, 4] << np.uint32(24)) | (d[:, 5] << np.uint32(16))
+             | (d[:, 6] << np.uint32(8)) | d[:, 7])
+    with np.errstate(over="ignore"):
+        h = w[:, None].astype(np.uint32) * a[None, :] + b[None, :]
+    return np.min(h, axis=0).astype(np.uint32)
+
+
+def pairwise_hamming_host(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """numpy twin of ``pairwise_hamming``: uint32[N,W] x uint32[M,W] →
+    int32[N,M] (exact — popcount over xor)."""
+    x = a[:, None, :] ^ b[None, :, :]
+    return np.sum(np.unpackbits(
+        x.astype(">u4").view(np.uint8), axis=-1), axis=-1).astype(np.int32)
+
+
+# -- content sketches (the resemblance-index kernel) ------------------------
+
+_WMULT = np.uint32(0x9E3779B1)     # Knuth/golden-ratio multiplicative hash
+_MIX2 = np.uint32(0x85EBCA6B)      # murmur3 finalizer odd constant
+_SAMPLE_MASK = np.uint32(63)       # 1/64 of windows become features
+
+
+def _window_words_host(b: np.ndarray) -> np.ndarray:
+    """uint8[n] → uint32[n-3] big-endian 4-byte windows."""
+    w = b.astype(np.uint32)
+    return ((w[:-3] << np.uint32(24)) | (w[1:-2] << np.uint32(16))
+            | (w[2:-1] << np.uint32(8)) | w[3:])
+
+
+def _mix_host(w: np.ndarray, mult: np.uint32) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        h = (w * mult).astype(np.uint32)
+        h ^= h >> np.uint32(15)
+        h = (h * np.uint32(0x2C1B3C6D)).astype(np.uint32)
+        h ^= h >> np.uint32(12)
+    return h
+
+
+def content_sketch_host(chunks: "list[bytes]") -> np.ndarray:
+    """Batched 64-bit content simhash per chunk: list of byte strings →
+    uint64[N] sketches (module docstring).  Pure numpy — the CPU-only
+    tier-1 path; ``content_sketch_device`` is the jax twin for
+    accelerator hosts, parity-pinned."""
+    out = np.empty(len(chunks), dtype=np.uint64)
+    for i, chunk in enumerate(chunks):
+        out[i] = _content_sketch_one_host(chunk)
+    return out
+
+
+_SENTINEL64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _content_sketch_one_host(chunk: bytes) -> np.uint64:
+    b = np.frombuffer(chunk, dtype=np.uint8)
+    if b.size < 4:
+        # degenerate chunk: sketch the padded bytes directly so equal
+        # tiny chunks still sketch equal (they dedup exactly anyway)
+        b = np.concatenate([b, np.zeros(4 - b.size, dtype=np.uint8)])
+    w = _window_words_host(b)
+    h_lo = _mix_host(w, _WMULT)
+    sel = (h_lo & _SAMPLE_MASK) == 0
+    if not sel.any():
+        sel = np.zeros(w.size, dtype=bool)
+        sel[0] = True               # at least one feature per chunk
+    lo = h_lo[sel]
+    hi = _mix_host(w[sel], _MIX2)
+    # SET semantics: the majority votes once per UNIQUE feature.  Real
+    # data is full of repeated windows (zero runs, common headers) — a
+    # multiset vote lets one hot feature drown every other bit and
+    # collapses all such chunks onto one sketch.  The all-ones value
+    # doubles as the device path's padding sentinel, so it is excluded
+    # here too (a 2^-64 feature loss; parity is structural).
+    f = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+    f = f[f != _SENTINEL64]
+    if f.size == 0:
+        f = np.zeros(1, dtype=np.uint64)
+    uniq = np.unique(f)
+    m = uniq.size
+    lo_u = (uniq & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi_u = (uniq >> np.uint64(32)).astype(np.uint32)
+    shifts = np.arange(32, dtype=np.uint32)
+    ones_lo = ((lo_u[:, None] >> shifts[None, :]) & np.uint32(1)) \
+        .sum(axis=0, dtype=np.int64)
+    ones_hi = ((hi_u[:, None] >> shifts[None, :]) & np.uint32(1)) \
+        .sum(axis=0, dtype=np.int64)
+    # majority vote with a deterministic >=half tie-break (both paths
+    # use the same integer comparison, so parity is structural)
+    bits_lo = (2 * ones_lo >= m).astype(np.uint64)
+    bits_hi = (2 * ones_hi >= m).astype(np.uint64)
+    sh64 = np.arange(32, dtype=np.uint64)
+    word_lo = np.bitwise_or.reduce(bits_lo << sh64)
+    word_hi = np.bitwise_or.reduce(bits_hi << sh64)
+    return np.uint64((int(word_hi) << 32) | int(word_lo))
+
+
+@jax.jit
+def _content_sketch_words(data: jax.Array, lengths: jax.Array) -> jax.Array:
+    """uint8[N,L] padded chunks + int32[N] lengths → uint32[N,2]
+    (lo, hi) sketch words — integer-exact twin of the host path.
+
+    Set semantics without uint64 (jax defaults to 32-bit): unsampled
+    positions force the (0xFFFFFFFF, 0xFFFFFFFF) sentinel pair, the
+    pairs sort lexicographically by (hi, lo) via two stable argsorts,
+    and a first-occurrence mask over the sorted run counts each unique
+    non-sentinel feature exactly once — the host path's ``np.unique``."""
+    w8 = data.astype(jnp.uint32)
+    w = (w8[:, :-3] << np.uint32(24)) | (w8[:, 1:-2] << np.uint32(16)) \
+        | (w8[:, 2:-1] << np.uint32(8)) | w8[:, 3:]
+
+    def mix(x, mult):
+        h = x * mult
+        h = h ^ (h >> np.uint32(15))
+        h = h * np.uint32(0x2C1B3C6D)
+        return h ^ (h >> np.uint32(12))
+
+    h_lo = mix(w, jnp.uint32(int(_WMULT)))
+    h_hi = mix(w, jnp.uint32(int(_MIX2)))
+    pos = jnp.arange(w.shape[1], dtype=jnp.int32)
+    valid = pos[None, :] < (lengths[:, None] - 3)
+    sel = valid & ((h_lo & jnp.uint32(int(_SAMPLE_MASK))) == 0)
+    none = ~jnp.any(sel, axis=1)
+    # degenerate rows take window 0 as their lone feature (host parity)
+    sel = sel | (none[:, None] & (pos[None, :] == 0))
+    sent = jnp.uint32(0xFFFFFFFF)
+    lo = jnp.where(sel, h_lo, sent)
+    hi = jnp.where(sel, h_hi, sent)
+    # lexicographic sort by (hi, lo): stable argsort on the minor key,
+    # then stable argsort on the gathered major key
+    i1 = jnp.argsort(lo, axis=1, stable=True)
+    lo1 = jnp.take_along_axis(lo, i1, axis=1)
+    hi1 = jnp.take_along_axis(hi, i1, axis=1)
+    i2 = jnp.argsort(hi1, axis=1, stable=True)
+    lo2 = jnp.take_along_axis(lo1, i2, axis=1)
+    hi2 = jnp.take_along_axis(hi1, i2, axis=1)
+    first = jnp.concatenate(
+        [jnp.ones((lo2.shape[0], 1), dtype=bool),
+         (lo2[:, 1:] != lo2[:, :-1]) | (hi2[:, 1:] != hi2[:, :-1])],
+        axis=1)
+    cnt = first & ~((lo2 == sent) & (hi2 == sent))
+    m = jnp.sum(cnt, axis=1, dtype=jnp.int32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+
+    def majority(h):
+        bits = ((h[:, :, None] >> shifts[None, None, :]) & jnp.uint32(1))
+        ones = jnp.sum(jnp.where(cnt[:, :, None], bits, 0),
+                       axis=1, dtype=jnp.int32)
+        word_bits = (2 * ones >= jnp.maximum(m, 1)[:, None]) \
+            .astype(jnp.uint32)
+        return jnp.sum(word_bits << shifts[None, :], axis=1,
+                       dtype=jnp.uint32)
+
+    w_lo, w_hi = majority(lo2), majority(hi2)
+    # every feature was the sentinel (2^-64 per feature): the host
+    # substitutes the single zero feature, whose sketch is 0
+    zero = jnp.zeros_like(w_lo)
+    return jnp.stack([jnp.where(m == 0, zero, w_lo),
+                      jnp.where(m == 0, zero, w_hi)], axis=1)
+
+
+def content_sketch_device(chunks: "list[bytes]") -> np.ndarray:
+    """jax twin of ``content_sketch_host`` (one padded batched dispatch;
+    uint64 assembled on the host because jax defaults to 32-bit).
+    Bit-identical to the host path — tests/test_ops.py pins it."""
+    if not chunks:
+        return np.empty(0, dtype=np.uint64)
+    lens = np.array([max(4, len(c)) for c in chunks], dtype=np.int32)
+    L = max(4, int(lens.max()))
+    padded = np.zeros((len(chunks), L), dtype=np.uint8)
+    for i, c in enumerate(chunks):
+        padded[i, :len(c)] = np.frombuffer(c, dtype=np.uint8)
+    words = np.asarray(_content_sketch_words(jnp.asarray(padded),
+                                             jnp.asarray(lens)))
+    return (words[:, 1].astype(np.uint64) << np.uint64(32)) \
+        | words[:, 0].astype(np.uint64)
+
+
+def sketch_hamming(a: int, b: int) -> int:
+    """Hamming distance between two 64-bit content sketches."""
+    return int(bin(int(a) ^ int(b)).count("1"))

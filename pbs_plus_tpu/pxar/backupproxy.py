@@ -184,10 +184,16 @@ class LocalStore:
                  batch_hasher=None, pbs_format: bool = False,
                  pipeline_workers: int = 0,
                  store_shards: "int | None" = None,
-                 dedup_index_mb: "int | None" = None):
+                 dedup_index_mb: "int | None" = None,
+                 delta_tier: "bool | None" = None,
+                 delta_threshold: "int | None" = None,
+                 delta_max_chain: "int | None" = None):
         self.datastore = Datastore(base_dir, pbs_format=pbs_format,
                                    store_shards=store_shards,
-                                   dedup_index_mb=dedup_index_mb)
+                                   dedup_index_mb=dedup_index_mb,
+                                   delta_tier=delta_tier,
+                                   delta_threshold=delta_threshold,
+                                   delta_max_chain=delta_max_chain)
         self.params = params
         self._chunker_factory = chunker_factory
         self.batch_hasher = batch_hasher

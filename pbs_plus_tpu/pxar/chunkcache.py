@@ -103,9 +103,16 @@ class ChunkCache:
                 stats["misses"] = stats.get("misses", 0) + 1
         return self._flight.do(digest, lambda: self._load(store, digest))
 
-    def _load(self, store, digest: bytes, *, prefetched: bool = False) -> bytes:
+    def _load(self, store, digest: bytes, *, prefetched: bool = False,
+              _chain: tuple = ()) -> bytes:
         """Single-flight body: verified load + admission.  Runs on the
-        calling thread (foreground miss) or the prefetch pool."""
+        calling thread (foreground miss) or the prefetch pool.
+
+        Delta-capable stores (``ChunkStore.get_resolved``) are handed a
+        resolver that pulls delta BASES back through this cache
+        (``_base_resolver``) — a hot base decompresses once and serves
+        every delta above it plus its own direct readers (pbslint rule
+        ``delta-discipline``)."""
         with self._lock:
             # a caller that lost the lookup race to a just-landed flight
             # must not issue a second disk read for resident bytes
@@ -114,13 +121,40 @@ class ChunkCache:
                 self._d.move_to_end(digest)
                 return ent[0]
         try:
-            data = store.get(digest)     # verifies sha256(data) == digest
+            getter = getattr(store, "get_resolved", None)
+            if getter is None:
+                data = store.get(digest)     # verifies sha256 == digest
+            else:
+                data = getter(digest,
+                              self._base_resolver(store, _chain + (digest,)))
         except BaseException:
             with self._lock:
                 self.counters["load_errors"] += 1
             raise
         self._admit(digest, data, prefetched=prefetched)
         return data
+
+    def _base_resolver(self, store, chain: tuple):
+        """Resolver closure for delta bases: cache hit or a direct load
+        admitted on success.  Deliberately NOT single-flighted — a
+        corrupt cross-referencing chain in two threads could deadlock
+        two flights against each other; the worst case without the
+        flight is one duplicated base read under a race.  ``chain``
+        carries the digests above this resolution, so a corrupt cyclic
+        chain raises instead of recursing."""
+        def resolve(base_digest: bytes) -> bytes:
+            if base_digest in chain or len(chain) > 64:
+                raise IOError(
+                    f"delta base cycle at {base_digest.hex()[:16]}")
+            with self._lock:
+                ent = self._d.get(base_digest)
+                if ent is not None:
+                    self._d.move_to_end(base_digest)
+                    self.counters["hits"] += 1
+                    return ent[0]
+                self.counters["misses"] += 1
+            return self._load(store, base_digest, _chain=chain)
+        return resolve
 
     def _admit(self, digest: bytes, data: bytes, *,
                prefetched: bool = False) -> None:

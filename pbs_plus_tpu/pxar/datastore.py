@@ -35,6 +35,7 @@ except ImportError:                 # image lacks the wheel; ctypes shim
     from ..utils import zstdshim as zstandard
 
 from ..utils import failpoints, validate
+from ..utils.log import L
 
 DIDX_MAGIC = b"TPXD"
 DIDX_VERSION = 1
@@ -116,7 +117,10 @@ class ChunkStore:
                  blob_format: str = "zstd",
                  n_shards: "int | None" = None,
                  index_budget_mb: "int | None" = None,
-                 index=None):
+                 index=None,
+                 delta_tier: "bool | None" = None,
+                 delta_threshold: "int | None" = None,
+                 delta_max_chain: "int | None" = None):
         """blob_format="zstd" (native raw zstd frame) | "pbs" (stock-PBS
         DataBlob envelope: magic + crc32 + zstd payload).  Reads sniff
         the on-disk magic, so a datastore may hold both formats.
@@ -124,7 +128,16 @@ class ChunkStore:
         ``n_shards``: logical shard count (None → PBS_PLUS_STORE_SHARDS).
         ``index``: an explicit DedupIndex (tests); else one is built
         from ``index_budget_mb`` (None → PBS_PLUS_DEDUP_INDEX_MB,
-        0 → index disabled, legacy utime-probe path)."""
+        0 → index disabled, legacy utime-probe path).
+
+        ``delta_tier`` enables the similarity-dedup tier (ISSUE 9,
+        docs/data-plane.md "Similarity tier"): novel chunks resembling a
+        stored base (``delta_threshold`` max sketch Hamming distance,
+        chain depth bounded by ``delta_max_chain``) are stored as delta
+        blobs against it (pxar/deltablob.py).  None → the
+        PBS_PLUS_DELTA_TIER / _DELTA_THRESHOLD / _DELTA_MAX_CHAIN
+        environment knobs.  Forced off for pbs-format stores — a stock
+        PBS cannot decode delta blobs."""
         from ..utils import conf as _conf
         self.base = os.path.join(base, ".chunks")
         os.makedirs(self.base, exist_ok=True)
@@ -170,6 +183,33 @@ class ChunkStore:
             # a caller-supplied index is taken as-is (tests pre-seed it)
             index.mark_booted()
         self._index_snap = os.path.join(base, ".chunkindex", "snapshot")
+        # similarity-dedup tier (docs/data-plane.md "Similarity tier")
+        env = _conf.env()
+        if delta_tier is None:
+            delta_tier = env.delta_tier
+        self._sim = None
+        # set once the ".delta-tier" marker is known written: GC's mark
+        # must run the base closure on any store that EVER wrote deltas,
+        # even with the tier since turned off
+        self._delta_marked = False
+        # base-pin protocol (docs/data-plane.md "Similarity tier"): a
+        # delta commit pins its base here (exists-confirm + pin under
+        # ONE mutex) and the sweep's unlink skips pinned digests under
+        # the same mutex — without it, a sweep could unlink a base in
+        # the window between the writer's base fetch and its delta
+        # commit, publishing a chunk that can never reassemble.  The
+        # mutex is only ever taken while holding a shard lock (writer:
+        # its chunk's; sweep: the victim's), a consistent order, and
+        # never held across encode/IO-heavy work
+        self._pin_lock = threading.Lock()
+        self._pinned_bases: dict[bytes, int] = {}
+        if delta_tier and blob_format != "pbs":
+            from .similarityindex import SimilarityIndex
+            self._sim = SimilarityIndex(
+                threshold=(env.delta_threshold if delta_threshold is None
+                           else delta_threshold),
+                max_chain=(env.delta_max_chain if delta_max_chain is None
+                           else delta_max_chain))
 
     # -- index lifecycle ---------------------------------------------------
     @property
@@ -251,6 +291,31 @@ class ChunkStore:
             return None
         return self.index.probe_batch(digests)
 
+    # -- similarity tier ---------------------------------------------------
+    @property
+    def similarity(self):
+        """The attached SimilarityIndex (None = tier disabled)."""
+        return self._sim
+
+    @similarity.setter
+    def similarity(self, sim) -> None:
+        """Attach another store's similarity index (the server's
+        per-job chunker-override store shares the primary's — two
+        views of one directory must never hold split sketch state,
+        the ``index`` sharing discipline)."""
+        self._sim = sim
+
+    def presketch_batch(self, digests: "list[bytes]", chunks: "list",
+                        known: "list[bool] | None") -> int:
+        """Batched sketch computation for a whole hash batch's novel
+        chunks (ONE kernel call — the write path calls this right after
+        its exact-index ``probe_batch``, so the per-chunk inserts that
+        follow find their sketches precomputed).  No-op when the tier
+        is off."""
+        if self._sim is None:
+            return 0
+        return self._sim.presketch(digests, chunks, known)
+
     def insert(self, digest: bytes, data: bytes, *, verify: bool = True) -> bool:
         """Store a chunk; returns True if it was new.  ``verify`` re-hashes
         for corrupt-write containment — writers that just computed the
@@ -292,7 +357,9 @@ class ChunkStore:
                     return False
             if verify and hashlib.sha256(data).digest() != digest:
                 raise ValueError("chunk digest mismatch on insert")
-            self._write_chunk(p, data, shard)
+            if self._sim is None or not self._try_delta_write(
+                    digest, data, p, shard):
+                self._write_chunk(p, data, shard)
             if self.index is not None:
                 self.index.insert(digest)
                 if self.blob_format == "pbs":
@@ -328,17 +395,123 @@ class ChunkStore:
         self._note_datablob_hit(digest, p, shard)
         return True
 
+    def _try_delta_write(self, digest: bytes, data, p: str,
+                         shard: int) -> bool:
+        """Similarity-tier insert attempt for a novel chunk (caller
+        holds the shard lock): sketch → banded candidate → delta encode
+        against the base, written only when it actually beats a plain
+        blob.  Returns True when a delta blob landed; False = caller
+        writes the full blob.  EVERY failure direction falls back to the
+        full write — an unprofitable delta, a vanished/corrupt base, an
+        injected ``pbsstore.delta.encode`` fault — so the tier can only
+        ever save bytes, never lose chunks."""
+        sim = self._sim
+        data_b = data if isinstance(data, bytes) else bytes(data)
+        sketch = sim.take_sketch(digest, data_b)
+        cand = sim.candidate(sketch, exclude=digest)
+        if cand is None:
+            sim.add(digest, sketch, 0)
+            return False
+        base_digest, base_depth = cand
+        from .similarityindex import METRICS as _SM
+        try:
+            failpoints.hit("pbsstore.delta.encode")
+            # base bytes through the shared read cache: a hot base
+            # decompresses once across many encodes (and later
+            # reassemblies) — reads take no shard lock, so holding this
+            # chunk's shard lock here cannot deadlock
+            from . import chunkcache as _cc
+            base = _cc.shared_cache().get(self, base_digest)
+            from . import deltablob as _delta
+            blob = _delta.encode(data_b, base, base_digest,
+                                 depth=base_depth + 1, level=self._level)
+        except FileNotFoundError:
+            # index stale against an external delete: stop offering it
+            sim.discard(base_digest)
+            _SM.add("encode_fallbacks")
+            sim.add(digest, sketch, 0)
+            return False
+        except Exception as e:
+            L.warning("delta encode failed for %s (base %s): %s — "
+                      "falling back to full blob", digest.hex()[:16],
+                      base_digest.hex()[:16], e)
+            _SM.add("encode_fallbacks")
+            sim.add(digest, sketch, 0)
+            return False
+        # the honest profitability gate compares against what the plain
+        # write would actually cost on disk (zstd already shrinks
+        # compressible chunks without any base) — computed once here and
+        # reused for the fallback write, so losing the gate never pays a
+        # second compression
+        plain = self._shard_cctx[shard].compress(data_b)
+        if blob is None or len(blob) >= 0.9 * len(plain):
+            _SM.add("encode_fallbacks")
+            sim.add(digest, sketch, 0)
+            self._write_payload(p, plain)
+            return True
+        if not self._ensure_delta_marker():
+            # cannot durably record that this store holds deltas — a
+            # later tier-off GC would then skip the base closure and
+            # could sweep this delta's base; store full instead
+            _SM.add("encode_fallbacks")
+            sim.add(digest, sketch, 0)
+            self._write_payload(p, plain)
+            return True
+        # pin the base for the commit window: exists-confirm and pin
+        # are atomic against the sweep's pinned-check+unlink (both
+        # under _pin_lock), so either the sweep already took the base
+        # (confirm fails → full blob) or the base survives until the
+        # delta is durably on disk.  The bytes fetched above may have
+        # been a cache hit for an already-unlinked file — only THIS
+        # confirm makes the reference safe.
+        bp = self._path(base_digest)
+        with self._pin_lock:
+            if not os.path.exists(bp):
+                gone = True
+            else:
+                gone = False
+                self._pinned_bases[base_digest] = \
+                    self._pinned_bases.get(base_digest, 0) + 1
+        if gone:
+            sim.discard(base_digest)
+            _SM.add("encode_fallbacks")
+            sim.add(digest, sketch, 0)
+            self._write_payload(p, plain)
+            return True
+        try:
+            # GC-mark the base: the NEXT sweep sees it fresh, like any
+            # chunk an in-flight session just referenced
+            try:
+                os.utime(bp)
+            except OSError:
+                L.debug("delta base utime failed for %s",
+                        base_digest.hex()[:16])
+            self._write_payload(p, blob)
+        finally:
+            with self._pin_lock:
+                n = self._pinned_bases.pop(base_digest, 1) - 1
+                if n > 0:
+                    self._pinned_bases[base_digest] = n
+        sim.add(digest, sketch, base_depth + 1)
+        _SM.add("hits")
+        _SM.add("bytes_saved", len(plain) - len(blob))
+        return True
+
     def _write_chunk(self, p: str, data: bytes, shard: int) -> None:
-        d = os.path.dirname(p)
-        if d not in self._made_dirs:
-            os.makedirs(d, exist_ok=True)
-            self._made_dirs.add(d)
-        tmp = f"{p}.tmp.{os.getpid()}.{threading.get_ident()}"
         if self.blob_format == "pbs":
             from .pbsformat import blob_encode
             payload = blob_encode(data, cctx=self._shard_cctx[shard])
         else:
             payload = self._shard_cctx[shard].compress(data)
+        self._write_payload(p, payload)
+
+    def _write_payload(self, p: str, payload: bytes) -> None:
+        """tmp+rename an already-encoded on-disk payload into place."""
+        d = os.path.dirname(p)
+        if d not in self._made_dirs:
+            os.makedirs(d, exist_ok=True)
+            self._made_dirs.add(d)
+        tmp = f"{p}.tmp.{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "wb") as f:
             f.write(payload)
         os.replace(tmp, p)
@@ -392,7 +565,28 @@ class ChunkStore:
             f.write(blob_encode(data, cctx=self._shard_cctx[shard]))
         os.replace(tmp, p)
 
+    # absolute ceiling on a delta chain while REASSEMBLING — far above
+    # any configurable max_chain; purely a corruption guard so a
+    # damaged header can never recurse unboundedly
+    MAX_DELTA_DEPTH = 64
+
     def get(self, digest: bytes) -> bytes:
+        """Decompressed, verified chunk bytes.  Delta blobs resolve
+        their base recursively through direct store reads — the
+        READ-PATH consumers must instead go through the chunk cache
+        (``get_resolved`` with the cache's resolver, wired by
+        ``ChunkCache._load``), so a hot base decompresses once (pbslint
+        rule ``delta-discipline``)."""
+        return self.get_resolved(digest, None)
+
+    def get_resolved(self, digest: bytes, resolver,
+                     _chain: tuple = ()) -> bytes:
+        """``get`` with pluggable base resolution: ``resolver(base_digest)
+        -> bytes`` supplies delta bases (the chunk cache passes itself,
+        making base reuse a cache hit); None falls back to recursive
+        direct reads.  Every result — including reassembled deltas —
+        re-verifies against ``digest`` before it is returned, so a wrong
+        or corrupt base can never serve wrong bytes."""
         with open(self._path(digest), "rb") as f:
             raw = f.read()
         # read-side fault injection (docs/fault-injection.md): `raise`/
@@ -400,6 +594,51 @@ class ChunkStore:
         # frame so the digest check below must catch it — proving a bad
         # chunk is never admitted to the read cache
         raw = failpoints.hit("pbsstore.chunk.read", raw)
+        from .deltablob import DeltaError, is_delta, parse_header
+        if is_delta(raw):
+            from .similarityindex import METRICS as _SM
+            # counted before the failpoint: an injected read fault IS a
+            # delta read attempt, and chaos tests audit the pairing
+            _SM.add("delta_reads")
+            # delta-specific fault injection: fires only for delta
+            # blobs, between the raw read and the reassembly
+            try:
+                raw = failpoints.hit("pbsstore.delta.read", raw)
+            except BaseException:
+                _SM.add("read_errors")
+                raise
+            try:
+                _codec, depth, _rsz, base_digest = parse_header(raw)
+                if depth > self.MAX_DELTA_DEPTH or \
+                        len(_chain) >= self.MAX_DELTA_DEPTH or \
+                        base_digest == digest or base_digest in _chain:
+                    raise DeltaError(
+                        f"delta chain corrupt at {digest.hex()[:16]} "
+                        f"(depth {depth}, chain {len(_chain)})")
+            except DeltaError:
+                _SM.add("read_errors")
+                raise
+            # base-resolution failures propagate UNCOUNTED: a failed
+            # inner read of a chained delta already counted itself at
+            # its own frame — re-counting here would report one broken
+            # reassembly as depth-many read errors
+            _SM.add("base_resolves")
+            if resolver is not None:
+                base = resolver(base_digest)
+            else:
+                base = self.get_resolved(base_digest, None,
+                                         _chain + (digest,))
+            from .deltablob import decode as _delta_decode
+            try:
+                data = _delta_decode(raw, base)
+            except (DeltaError, OSError):
+                _SM.add("read_errors")
+                raise
+            if hashlib.sha256(data).digest() != digest:
+                _SM.add("read_errors")
+                raise IOError(f"delta chunk {digest.hex()} reassembled "
+                              "to wrong bytes")
+            return data
         from .pbsformat import blob_decode, is_datablob
         if is_datablob(raw):
             data = blob_decode(raw, dctx=self._dctx)
@@ -442,6 +681,81 @@ class ChunkStore:
 
     def chunk_size(self, digest: bytes) -> int:
         return os.path.getsize(self._path(digest))
+
+    def delta_base_of(self, digest: bytes) -> "bytes | None":
+        """The base digest this chunk's on-disk blob deltas against
+        (None = full blob or missing).  Reads only the fixed-size
+        header — the GC mark's closure walk stays cheap."""
+        from .deltablob import HEADER_SIZE, DeltaError, is_delta, \
+            parse_header
+        try:
+            with open(self._path(digest), "rb") as f:
+                head = f.read(HEADER_SIZE)
+        except OSError:
+            return None
+        if not is_delta(head):
+            return None
+        try:
+            return parse_header(head)[3]
+        except DeltaError:
+            return None
+
+    def delta_closure(self, digests: "set[bytes]") -> "set[bytes]":
+        """Close a live digest set over delta base references: every
+        chunk a live delta (transitively) reassembles from is itself
+        live.  GC's mark MUST touch the closure, not the raw set — a
+        base referenced only by deltas has no snapshot index entry, and
+        sweeping it would orphan every delta above it.  Derived from
+        the on-disk headers, never from index memory, so it survives
+        restarts and index loss.
+
+        The ``.delta-tier`` marker (written durably BEFORE the first
+        delta blob) is the sole gate: no marker proves no delta exists
+        on disk — tier on or off — so a store that never delta'd pays
+        zero per-chunk header reads per GC (the PR 8 disk-free-probe
+        discipline)."""
+        if not (self._delta_marked or self._store_may_hold_deltas()):
+            return digests
+        out = set(digests)
+        frontier = list(digests)
+        hops = 0
+        while frontier and hops <= self.MAX_DELTA_DEPTH:
+            nxt: list[bytes] = []
+            for d in frontier:
+                base = self.delta_base_of(d)
+                if base is not None and base not in out:
+                    out.add(base)
+                    nxt.append(base)
+            frontier = nxt
+            hops += 1
+        return out
+
+    def _store_may_hold_deltas(self) -> bool:
+        """Tier currently off: a previous run may still have written
+        delta blobs, so the closure must still run unless the store has
+        never seen the tier.  Cheap sentinel: the tier drops a marker
+        file before its first delta write."""
+        return os.path.exists(self._delta_marker_path())
+
+    def _delta_marker_path(self) -> str:
+        return os.path.join(os.path.dirname(self.base), ".delta-tier")
+
+    def _ensure_delta_marker(self) -> bool:
+        """Durably mark the store as delta-bearing BEFORE the first
+        delta blob lands (``_store_may_hold_deltas``); False = marker
+        unwritable, caller must not write the delta."""
+        if self._delta_marked:
+            return True
+        try:
+            with open(self._delta_marker_path(), "w") as f:
+                f.write("delta blobs present; GC mark must close over "
+                        "bases (docs/data-plane.md Similarity tier)\n")
+        except OSError as e:
+            L.warning("delta-tier marker unwritable (%s); storing full "
+                      "blobs", e)
+            return False
+        self._delta_marked = True
+        return True
 
     def iter_digests(self) -> Iterator[bytes]:
         for sub in sorted(os.listdir(self.base)):
@@ -543,13 +857,28 @@ class ChunkStore:
                     try:
                         st = os.stat(p)
                         if max(st.st_atime, st.st_mtime) < before:
-                            if self.index is not None:
-                                # discard BEFORE unlink: if the unlink
-                                # then fails the chunk survives
-                                # index-less (safe false negative),
-                                # never the reverse
-                                self.index.discard(digest)
-                            os.unlink(p)
+                            with self._pin_lock:
+                                if digest in self._pinned_bases:
+                                    # a delta commit is mid-flight
+                                    # against this base: skipping is
+                                    # the only safe answer (the writer
+                                    # confirmed existence under this
+                                    # same mutex and utimes it)
+                                    continue
+                                if self.index is not None:
+                                    # discard BEFORE unlink: if the
+                                    # unlink then fails the chunk
+                                    # survives index-less (safe false
+                                    # negative), never the reverse
+                                    self.index.discard(digest)
+                                if self._sim is not None:
+                                    # same ordering for the sketch
+                                    # entry: a failed unlink leaves a
+                                    # chunk the tier merely stops
+                                    # offering as a base — never an
+                                    # offered base with no file
+                                    self._sim.discard(digest)
+                                os.unlink(p)
                             # counted only after a successful unlink —
                             # an EPERM failure must not inflate
                             # bytes_freed
@@ -735,21 +1064,29 @@ class Datastore:
 
     def __init__(self, base: str, *, pbs_format: bool = False,
                  store_shards: "int | None" = None,
-                 dedup_index_mb: "int | None" = None):
+                 dedup_index_mb: "int | None" = None,
+                 delta_tier: "bool | None" = None,
+                 delta_threshold: "int | None" = None,
+                 delta_max_chain: "int | None" = None):
         """pbs_format=True publishes snapshots in the stock-PBS on-disk
         layout (DataBlob chunks, PBS dynamic indexes under .didx names,
         index.json.blob manifest) so a PBS can serve what this build
         writes.  Reads sniff per-file, so both layouts coexist.
         ``store_shards``/``dedup_index_mb`` size the chunk store's shard
         count and dedup-index budget (None → the PBS_PLUS_STORE_SHARDS /
-        PBS_PLUS_DEDUP_INDEX_MB environment knobs)."""
+        PBS_PLUS_DEDUP_INDEX_MB environment knobs); the ``delta_*``
+        knobs configure the similarity-dedup tier (None → the
+        PBS_PLUS_DELTA_* environment knobs; see ChunkStore)."""
         self.base = base
         self.pbs_format = pbs_format
         os.makedirs(base, exist_ok=True)
         self.chunks = ChunkStore(base,
                                  blob_format="pbs" if pbs_format else "zstd",
                                  n_shards=store_shards,
-                                 index_budget_mb=dedup_index_mb)
+                                 index_budget_mb=dedup_index_mb,
+                                 delta_tier=delta_tier,
+                                 delta_threshold=delta_threshold,
+                                 delta_max_chain=delta_max_chain)
 
     @property
     def meta_idx_name(self) -> str:

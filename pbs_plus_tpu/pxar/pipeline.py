@@ -369,6 +369,11 @@ class PipelinedStream(_ChunkedStream):
                         # writer's _flush_hashes uses, so new/known
                         # accounting stays bit-identical
                         known = self._probe_known(digests)
+                        # one batched sketch pass per hash batch too
+                        # (similarity tier): identical batches to the
+                        # sequential writer's _flush_hashes
+                        self._presketch(digests,
+                                        [c for _, c in batch], known)
                         for i, ((idx, chunk), digest) in enumerate(
                                 zip(batch, digests)):
                             self._commit(idx, digest, chunk,

@@ -237,12 +237,25 @@ class _ChunkedStream:
         else:
             self._insert(digest, chunk)
 
+    def _presketch(self, digests: "list[bytes]", chunks: "list",
+                   known: "list[bool] | None") -> None:
+        """Similarity-tier batch hook: one batched sketch computation
+        for the hash batch's novel chunks (ChunkStore.presketch_batch →
+        similarityindex.presketch), right after the exact-index probe.
+        The per-chunk inserts that follow consume the precomputed
+        sketches, so sequential and pipelined writers sketch in the
+        same batches — accounting stays bit-identical."""
+        pres = getattr(self.store, "presketch_batch", None)
+        if pres is not None:
+            pres(digests, chunks, known)
+
     def _flush_hashes(self) -> None:
         if not self._pending:
             return
         assert self._hasher is not None
         digests = self._hasher([c for _, c in self._pending])
         known = self._probe_known(digests)
+        self._presketch(digests, [c for _, c in self._pending], known)
         for i, ((idx, chunk), digest) in enumerate(zip(self._pending,
                                                        digests)):
             end, _ = self.records[idx]

@@ -345,6 +345,42 @@ class MetricsRegistry:
               "table + exact host set)",
               [({}, float(di["resident_bytes"]))])
 
+        # -- similarity-dedup delta tier (pxar/similarityindex.py;
+        #    docs/data-plane.md "Similarity tier") ---------------------------
+        from ..pxar import similarityindex as _simindex
+        dl = _simindex.metrics_snapshot()
+        gauge("pbs_plus_delta_probes_total",
+              "Novel chunks probed against the resemblance index",
+              [({}, float(dl["probes"]))])
+        gauge("pbs_plus_delta_candidates_total",
+              "Banded sketch candidates examined across probes",
+              [({}, float(dl["candidates"]))])
+        gauge("pbs_plus_delta_hits_total",
+              "Novel chunks stored as delta blobs against a base",
+              [({}, float(dl["hits"]))])
+        gauge("pbs_plus_delta_bytes_saved_total",
+              "On-disk bytes saved vs the plain compressed blob",
+              [({}, float(dl["bytes_saved"]))])
+        gauge("pbs_plus_delta_chain_rejects_total",
+              "Probes whose only candidates sat at the max chain depth",
+              [({}, float(dl["chain_rejects"]))])
+        gauge("pbs_plus_delta_encode_fallbacks_total",
+              "Delta attempts that fell back to a full blob "
+              "(unprofitable encode, vanished base, injected fault)",
+              [({}, float(dl["encode_fallbacks"]))])
+        gauge("pbs_plus_delta_reads_total",
+              "Delta blobs reassembled on the read path",
+              [({}, float(dl["delta_reads"]))])
+        gauge("pbs_plus_delta_base_resolves_total",
+              "Base-chunk resolutions performed for delta reassembly",
+              [({}, float(dl["base_resolves"]))])
+        gauge("pbs_plus_delta_read_errors_total",
+              "Delta reassemblies that failed (corrupt payload/base — "
+              "raised, never served)", [({}, float(dl["read_errors"]))])
+        gauge("pbs_plus_delta_entries",
+              "Sketches resident across live resemblance indexes",
+              [({}, float(dl["entries"]))])
+
         # -- read-path chunk cache (pxar/chunkcache.py) -----------------------
         from ..pxar import chunkcache as _chunkcache
         cc = _chunkcache.metrics_snapshot()

@@ -109,6 +109,12 @@ def mark_live_chunks(ds: Datastore) -> int:
             for i in range(len(idx.ends)):
                 live.add(idx.digests[i].tobytes())
     live.update(_checkpoint.live_checkpoint_digests(ds))
+    # similarity tier (docs/data-plane.md "Similarity tier"): a delta
+    # blob reassembles from its base chunk, so every base a live delta
+    # (transitively) references is live too even when no snapshot index
+    # names it — the closure reads the on-disk delta headers, so it
+    # holds across restarts and with the tier since turned off
+    live = ds.chunks.delta_closure(live)
     # shard-parallel mark (pxar/datastore.py touch_many): per-shard
     # utime loops overlap their syscall waits
     ds.chunks.touch_many(live)

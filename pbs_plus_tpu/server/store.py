@@ -114,6 +114,17 @@ class ServerConfig:
     # PBS_PLUS_DEDUP_INDEX_MB / PBS_PLUS_STORE_SHARDS environment knobs
     dedup_index_mb: int = -1
     store_shards: int = -1
+    # similarity-dedup delta tier (pxar/similarityindex.py +
+    # pxar/deltablob.py, docs/data-plane.md "Similarity tier"):
+    # delta_tier 1 stores near-duplicate chunks as deltas against a
+    # resembling base, 0 disables; delta_threshold = max sketch Hamming
+    # distance (of 64) to accept a base; delta_max_chain bounds
+    # reassembly depth.  Negative values fall back to the
+    # PBS_PLUS_DELTA_TIER / _DELTA_THRESHOLD / _DELTA_MAX_CHAIN
+    # environment knobs (utils/conf.py)
+    delta_tier: int = -1
+    delta_threshold: int = -1
+    delta_max_chain: int = -1
     # fleet admission + queueing (docs/fleet.md): per-client session-open
     # token bucket, global opens/s bucket, concurrent-session ceiling
     # (AgentsManager), and the jobs waiting-queue bound (JobsManager,
@@ -170,7 +181,13 @@ class Server:
             store_shards=(None if config.store_shards < 0
                           else config.store_shards),
             dedup_index_mb=(None if config.dedup_index_mb < 0
-                            else config.dedup_index_mb))
+                            else config.dedup_index_mb),
+            delta_tier=(None if config.delta_tier < 0
+                        else bool(config.delta_tier)),
+            delta_threshold=(None if config.delta_threshold < 0
+                             else config.delta_threshold),
+            delta_max_chain=(None if config.delta_max_chain < 0
+                             else config.delta_max_chain))
         self.scheduler = Scheduler(
             self.db, self.jobs,
             enqueue_backup=self._enqueue_backup_row,
@@ -559,6 +576,9 @@ class Server:
             # the scan happens on whichever writer thread probes first
             store.datastore.chunks.index = \
                 self.datastore.datastore.chunks._index
+            # same sharing rule for the similarity tier's sketch state
+            store.datastore.chunks.similarity = \
+                self.datastore.datastore.chunks.similarity
 
         async def execute():
             from . import hooks

@@ -83,6 +83,16 @@ class Env:
     # shard-parallel)
     dedup_index_mb: int = 64
     store_shards: int = 16
+    # similarity-dedup tier (pxar/similarityindex.py + pxar/deltablob.py,
+    # docs/data-plane.md "Similarity tier"): store near-duplicate chunks
+    # as deltas against a resembling base chunk.  delta_tier 0 disables
+    # (default — opt-in, restores stay bit-identical either way);
+    # delta_threshold is the max sketch Hamming distance (of 64) to
+    # accept a base; delta_max_chain bounds the base-hop depth a
+    # reassembly may pay
+    delta_tier: bool = False
+    delta_threshold: int = 14
+    delta_max_chain: int = 3
     # fleet admission control (arpc/agents_manager.py, docs/fleet.md):
     # per-client token bucket (the old hardcoded 10/s burst 20), a
     # global session-open rate bucket, and a hard ceiling on concurrent
@@ -133,6 +143,10 @@ def env() -> Env:
         chunk_readahead=_int_env(e, "PBS_PLUS_CHUNK_READAHEAD", "4"),
         dedup_index_mb=_int_env(e, "PBS_PLUS_DEDUP_INDEX_MB", "64"),
         store_shards=_int_env(e, "PBS_PLUS_STORE_SHARDS", "16"),
+        delta_tier=e.get("PBS_PLUS_DELTA_TIER", "").lower()
+        in ("1", "true", "yes"),
+        delta_threshold=_int_env(e, "PBS_PLUS_DELTA_THRESHOLD", "14"),
+        delta_max_chain=_int_env(e, "PBS_PLUS_DELTA_MAX_CHAIN", "3"),
         agent_rate=_float_env(e, "PBS_PLUS_AGENT_RATE",
                               str(CLIENT_RATE_LIMIT_PER_SEC)),
         agent_burst=_int_env(e, "PBS_PLUS_AGENT_BURST",

@@ -38,6 +38,7 @@ def test_registry_has_expected_rules():
         "thread-hygiene", "resource-ctx", "mutable-default",
         "failpoint-discipline", "cache-discipline",
         "bounded-queue-discipline", "index-discipline",
+        "delta-discipline",
     }
 
 
@@ -77,6 +78,58 @@ def test_cache_discipline_scoped_to_read_path_modules():
         def load(store, digest):
             return store.get(digest)
     """, path="pbs_plus_tpu/pxar/chunkcache.py", rules=["cache-discipline"])
+    assert v == []
+
+
+# -------------------------------------------------- delta-discipline
+
+
+def test_delta_discipline_flags_resolverless_call():
+    v = run_lint("""
+        def load(store, digest):
+            return store.get_resolved(digest)
+    """, path="pbs_plus_tpu/server/restore_job.py",
+        rules=["delta-discipline"])
+    assert names(v) == ["delta-discipline"]
+    assert "chunk cache" in v[0].message
+
+
+def test_delta_discipline_flags_none_resolver():
+    v = run_lint("""
+        def load(store, digest):
+            return store.get_resolved(digest, None)
+    """, path="pbs_plus_tpu/pxar/remote.py", rules=["delta-discipline"])
+    assert names(v) == ["delta-discipline"]
+    v = run_lint("""
+        def load(store, digest):
+            return store.get_resolved(digest, resolver=None)
+    """, path="pbs_plus_tpu/pxar/remote.py", rules=["delta-discipline"])
+    assert names(v) == ["delta-discipline"]
+
+
+def test_delta_discipline_real_resolver_clean():
+    v = run_lint("""
+        def load(self, store, digest, chain):
+            return store.get_resolved(
+                digest, self._base_resolver(store, chain))
+    """, path="pbs_plus_tpu/pxar/chunkcache.py", rules=["delta-discipline"])
+    assert v == []
+
+
+def test_delta_discipline_datastore_exempt():
+    # the oracle's own plain `get` is the sanctioned recursive fallback
+    v = run_lint("""
+        def get(self, digest):
+            return self.get_resolved(digest, None)
+    """, path="pbs_plus_tpu/pxar/datastore.py", rules=["delta-discipline"])
+    assert v == []
+
+
+def test_delta_discipline_unrelated_calls_clean():
+    v = run_lint("""
+        def load(payload, digest):
+            return payload.get(digest)
+    """, path="pbs_plus_tpu/pxar/remote.py", rules=["delta-discipline"])
     assert v == []
 
 

@@ -227,6 +227,71 @@ def test_minhash_estimates_jaccard():
     assert minhash_similarity(sig_a, sig_a) == 1.0
 
 
+def test_simhash_host_parity():
+    """numpy host twin of the jax simhash kernel (ISSUE 9: CPU-only
+    tier-1 must never require a device) — bit-identical sketches on a
+    fixed digest corpus, shared projection."""
+    from pbs_plus_tpu.ops.similarity import (
+        pairwise_hamming_host, simhash_sketch_host)
+    digs = np.frombuffer(
+        b"".join(hashlib.sha256(bytes([i & 0xFF, i >> 8, 11])).digest()
+                 for i in range(300)), np.uint8).reshape(-1, 32)
+    dev = np.asarray(simhash_sketch(digs))
+    host = simhash_sketch_host(digs)
+    assert np.array_equal(dev, host)
+    # pairwise-hamming twin is exact too
+    want = np.asarray(pairwise_hamming(jnp.asarray(dev[:16]),
+                                       jnp.asarray(dev[:16])))
+    assert np.array_equal(pairwise_hamming_host(host[:16], host[:16]), want)
+
+
+def test_minhash_host_parity():
+    from pbs_plus_tpu.ops.similarity import minhash_signature_host
+    digs = np.frombuffer(
+        b"".join(hashlib.sha256(bytes([i & 0xFF, i >> 8, 12])).digest()
+                 for i in range(500)), np.uint8).reshape(-1, 32)
+    for k in (64, 128, 256):
+        assert np.array_equal(minhash_signature(digs, k=k),
+                              minhash_signature_host(digs, k=k)), k
+
+
+def test_content_sketch_device_host_parity():
+    """The resemblance-index kernel (64-bit content simhash over
+    sampled windows): numpy host path == jax device path bit-for-bit,
+    including degenerate tiny chunks and mixed lengths in one batch."""
+    from pbs_plus_tpu.ops.similarity import (
+        content_sketch_device, content_sketch_host)
+    rng = np.random.default_rng(13)
+    chunks = [rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+              for n in (1, 3, 4, 7, 64, 1000, 16 << 10, 64 << 10)]
+    host = content_sketch_host(chunks)
+    dev = content_sketch_device(chunks)
+    assert np.array_equal(host, dev)
+    assert content_sketch_device([]).shape == (0,)
+
+
+def test_content_sketch_tracks_similarity():
+    """Hamming distance between content sketches tracks byte-level
+    similarity: in-place mutations stay near, unrelated chunks stay
+    far — the separation the delta tier's threshold rides on."""
+    from pbs_plus_tpu.ops.similarity import (
+        content_sketch_host, sketch_hamming)
+    rng = np.random.default_rng(14)
+    n = 64 << 10
+    base = rng.integers(0, 256, n, dtype=np.uint8)
+    mut = base.copy()
+    idx = rng.choice(n, n // 200, replace=False)       # 0.5% of bytes
+    mut[idx] ^= 0xFF
+    other = rng.integers(0, 256, n, dtype=np.uint8)
+    s = content_sketch_host([base.tobytes(), mut.tobytes(),
+                             other.tobytes()])
+    near = sketch_hamming(s[0], s[1])
+    far = sketch_hamming(s[0], s[2])
+    assert near <= 10
+    assert far >= 18
+    assert sketch_hamming(s[0], s[0]) == 0
+
+
 def test_sha256_unroll_parity():
     """Digests identical across block-unroll factors (the TPU tuning knob)."""
     from pbs_plus_tpu.ops.sha256 import sha256_stream_chunks

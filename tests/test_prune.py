@@ -221,6 +221,60 @@ def test_sweep_failpoint_fires_after_mark(tmp_path):
         assert ds.chunks.has(dg)
 
 
+def test_gc_mark_closes_over_delta_bases(tmp_path):
+    """Similarity tier (ISSUE 9): after pruning every snapshot that
+    references a delta's BASE chunk directly, a zero-grace GC must
+    still keep the base alive as long as a surviving snapshot holds a
+    delta that reassembles from it — the mark's ``delta_closure``."""
+    store = LocalStore(str(tmp_path / "ds"), P, delta_tier=True)
+    rng = np.random.default_rng(31)
+    blob = rng.integers(0, 256, 96 << 10, dtype=np.uint8)
+    src = tmp_path / "src"
+    src.mkdir()
+
+    # snapshot 1: the base generation
+    (src / "f.bin").write_bytes(blob.tobytes())
+    s1 = store.start_session(backup_type="host", backup_id="g",
+                             backup_time=1_753_000_000)
+    backup_tree(s1, str(src))
+    s1.finish()
+    # snapshot 2: a mutated generation — its chunks delta against s1's
+    mut = blob.copy()
+    mut[rng.choice(len(mut), 400, replace=False)] ^= 0xFF
+    (src / "f.bin").write_bytes(mut.tobytes())
+    s2 = store.start_session(backup_type="host", backup_id="g",
+                             backup_time=1_753_003_600,
+                             auto_previous=False)
+    backup_tree(s2, str(src))
+    s2.finish()
+
+    ds = store.datastore
+    _m2, p2 = ds.load_indexes(s2.ref)
+    published2 = {p2.digest(i) for i in range(len(p2))}
+    bases = {ds.chunks.delta_base_of(d) for d in published2} - {None}
+    assert bases, "tier never engaged — nothing to prove"
+    assert not bases & published2       # bases live only via snapshot 1
+
+    # prune snapshot 1 away; zero-grace GC; age everything first so
+    # only the mark's touches decide survival
+    old = time.time() - 10 * 24 * 3600
+    for dg in ds.chunks.iter_digests():
+        os.utime(ds.chunks._path(dg), (old, old))
+    rep = run_prune(ds, PrunePolicy(keep_last=1), gc_grace_s=0.0)
+    assert str(s1.ref) in rep.removed and str(s2.ref) in rep.kept
+    for b in bases:
+        assert ds.chunks.on_disk(b), "live delta's base was swept"
+    # the surviving snapshot restores bit-identical
+    reader = store.open_snapshot(s2.ref)
+    assert reader.read_file(reader.lookup("f.bin")) == mut.tobytes()
+    # a second GC with snapshot 2 gone reaps bases + deltas alike
+    ds.remove_snapshot(s2.ref)
+    for dg in ds.chunks.iter_digests():
+        os.utime(ds.chunks._path(dg), (old, old))
+    run_prune(ds, PrunePolicy(), gc_grace_s=0.0)
+    assert list(ds.chunks.iter_digests()) == []
+
+
 def test_prune_web_route_and_snapshot_delete(tmp_path):
     from aiohttp import ClientSession
     from test_web import _mk_server

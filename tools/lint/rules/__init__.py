@@ -21,6 +21,7 @@ from .failpoint_discipline import FailpointDiscipline
 from .cache_discipline import CacheDiscipline
 from .bounded_queue import BoundedQueueDiscipline
 from .index_discipline import IndexDiscipline
+from .delta_discipline import DeltaDiscipline
 
 RULE_CLASSES = [
     NoSilentSwallow,
@@ -36,6 +37,7 @@ RULE_CLASSES = [
     CacheDiscipline,
     BoundedQueueDiscipline,
     IndexDiscipline,
+    DeltaDiscipline,
 ]
 
 

@@ -1,0 +1,59 @@
+"""delta-discipline — delta reassembly resolves bases through the cache.
+
+Invariant (pxar/deltablob.py, docs/data-plane.md "Similarity tier"): a
+delta-capable chunk read (``ChunkStore.get_resolved``) must be handed a
+real base resolver — the chunk cache passes itself
+(``ChunkCache._base_resolver``), so one hot base decompresses once and
+serves every delta above it plus its own direct readers.  Calling
+``get_resolved`` with no resolver (or ``None``) silently degrades every
+base hop to a direct store read: each reassembly of an N-deep chain
+pays N opens+decompressions and the base never becomes a cache hit —
+exactly the per-read cost the tier's read path is designed to
+amortize.  Use ``ChunkCache.get`` (which wires the resolver) or pass
+one explicitly; ``pxar/datastore.py`` is exempt as the oracle (its
+plain ``get`` IS the sanctioned resolver-less recursive fallback for
+non-read-path callers).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+
+_SCOPE = "pbs_plus_tpu/"
+_EXEMPT = "pbs_plus_tpu/pxar/datastore.py"
+
+
+class DeltaDiscipline(Rule):
+    name = "delta-discipline"
+    invariant = ("delta-capable chunk reads (get_resolved) pass a real "
+                 "base resolver so delta bases resolve through the "
+                 "chunk cache, never per-read direct store reads")
+
+    def begin_file(self, ctx):
+        return ctx.path.startswith(_SCOPE) and ctx.path != _EXEMPT
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        func = node.func
+        if not isinstance(func, ast.Attribute) or \
+                func.attr != "get_resolved":
+            return
+        resolver = None
+        if len(node.args) >= 2:
+            resolver = node.args[1]
+        else:
+            for kw in node.keywords:
+                if kw.arg == "resolver":
+                    resolver = kw.value
+        missing = resolver is None or (
+            isinstance(resolver, ast.Constant) and resolver.value is None)
+        if not missing:
+            return
+        ctx.report(self, node,
+                   "`get_resolved(...)` without a base resolver degrades "
+                   "every delta base hop to a direct store read (one "
+                   "open+decompress per hop per reassembly, no cache "
+                   "reuse) — resolve through the chunk cache "
+                   "(ChunkCache.get wires the resolver) or pass one "
+                   "explicitly")
