@@ -589,6 +589,95 @@ def _delta_bench(mib: int = 16, *, generations: int = 6,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _sync_bench(mib: int = 16, *, chunk_avg: int = 64 << 10,
+                mutate_frac: float = 0.005) -> dict:
+    """Datastore-replication benchmark (docs/sync.md): back a ``mib``
+    random file up into a source store, mirror it into an empty
+    destination (the INITIAL sync — every chunk crosses the wire,
+    compressed-as-stored), then mutate a contiguous ``mutate_frac``
+    region (the realistic near-dup shape: localized edits / appended
+    logs), back up the new generation and re-sync (the INCREMENTAL
+    sync — the batched destination probes skip everything but the
+    dirtied chunks).  Reported: wire bytes for both runs, their ratio
+    (gated <= 10% in tests/test_bench_harness.py), probe batches,
+    chunks skipped, and a third no-op re-sync proving zero transfer
+    for an unchanged group."""
+    import io
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from pbs_plus_tpu.chunker import ChunkerParams
+    from pbs_plus_tpu.pxar.backupproxy import LocalStore
+    from pbs_plus_tpu.pxar.datastore import Datastore
+    from pbs_plus_tpu.pxar.format import KIND_DIR, KIND_FILE, Entry
+    from pbs_plus_tpu.pxar.syncwire import (LocalSyncDest,
+                                            LocalSyncSource, run_sync)
+
+    params = ChunkerParams(avg_size=chunk_avg)
+    rng = np.random.default_rng(23)
+    size = mib << 20
+    gen0 = rng.integers(0, 256, size, dtype=np.uint8)
+
+    tmp = tempfile.mkdtemp(prefix="pbs-sync-bench-")
+    try:
+        src = LocalStore(os.path.join(tmp, "src"), params)
+
+        def backup(data: np.ndarray) -> None:
+            sess = src.start_session(backup_type="host", backup_id="s")
+            sess.writer.write_entry(Entry(path="", kind=KIND_DIR))
+            sess.writer.write_entry_reader(
+                Entry(path="data.bin", kind=KIND_FILE),
+                io.BytesIO(data.tobytes()))
+            sess.finish()
+
+        backup(gen0)
+        dst = Datastore(os.path.join(tmp, "dst"))
+        source = LocalSyncSource(src.datastore)
+        dest = LocalSyncDest(dst)
+
+        t0 = time.perf_counter()
+        initial = run_sync(source, dest, job_id="bench",
+                           state_root=os.path.join(tmp, "dst"))
+        t_init = time.perf_counter() - t0
+
+        # generation 2: one contiguous mutate_frac region rewritten
+        gen1 = gen0.copy()
+        n_mut = max(1, int(size * mutate_frac))
+        start = int(rng.integers(0, size - n_mut))
+        gen1[start:start + n_mut] = rng.integers(0, 256, n_mut,
+                                                 dtype=np.uint8)
+        backup(gen1)
+
+        t0 = time.perf_counter()
+        incr = run_sync(source, dest, job_id="bench",
+                        state_root=os.path.join(tmp, "dst"))
+        t_incr = time.perf_counter() - t0
+        resync = run_sync(source, dest, job_id="bench",
+                          state_root=os.path.join(tmp, "dst"))
+
+        return {
+            "source_mib": mib,
+            "chunk_avg": chunk_avg,
+            "mutate_frac": mutate_frac,
+            "initial_wire_bytes": initial["bytes_wire"],
+            "initial_chunks": initial["chunks_transferred"],
+            "initial_probe_batches": initial["probe_batches"],
+            "initial_wall_s": round(t_init, 3),
+            "incremental_wire_bytes": incr["bytes_wire"],
+            "incremental_chunks": incr["chunks_transferred"],
+            "incremental_chunks_skipped": incr["chunks_skipped"],
+            "incremental_probe_batches": incr["probe_batches"],
+            "incremental_wall_s": round(t_incr, 3),
+            "wire_ratio": round(incr["bytes_wire"]
+                                / max(1, initial["bytes_wire"]), 4),
+            "resync_chunks": resync["chunks_transferred"],
+            "resync_wire_bytes": resync["bytes_wire"],
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _fleet_bench(n_agents: int | None = None) -> dict:
     """Loopback fleet soak (docs/fleet.md): N simulated agents speak real
     aRPC through AgentsManager admission and the fair jobs plane, one
@@ -962,6 +1051,13 @@ def main() -> None:
         delta = None
     if delta is not None:
         result["detail"]["delta"] = delta
+    try:
+        sync = _sync_bench()
+    except Exception as e:
+        sys.stderr.write(f"[bench] sync bench unavailable: {e}\n")
+        sync = None
+    if sync is not None:
+        result["detail"]["sync"] = sync
     result["machine"] = _machine_context()
     print(json.dumps(result))
 

@@ -64,6 +64,17 @@ SNAP_MAGIC = b"TPXI"
 SNAP_VERSION = 1
 _SNAP_HDR = struct.Struct("<4sHHQQ")
 
+# optional trailing sketch section (ISSUE 10 satellite / ROADMAP item 3):
+# the similarity tier's resemblance entries persist alongside the exact
+# index so a restarted server keeps offering pre-restart delta bases.
+# Independently checksummed and strictly optional — a corrupt, truncated
+# or absent section degrades to the organic sketch rebuild while the
+# main digest payload still loads.
+SKETCH_MAGIC = b"TPXS"
+SKETCH_VERSION = 1
+_SKETCH_HDR = struct.Struct("<4sHHQ")
+_SKETCH_REC = struct.Struct("<32sQB")      # digest, sketch u64, depth u8
+
 # per-entry resident estimate beyond the filter table: a 32-byte bytes
 # object + set-slot overhead in the exact host set (CPython ≈ 89 B for
 # the object, ~32 B amortized slot) — the gauge is an estimate, the
@@ -132,6 +143,10 @@ class DedupIndex:
         # first loads, the other sees `booted` and skips the scan
         self._booted = False
         self._boot_lock = threading.Lock()
+        # sketch entries recovered by the last load_snapshot (consumed
+        # by ChunkStore._boot_index into the similarity tier); None =
+        # snapshot had no valid sketch section
+        self.loaded_sketches: "list[tuple[bytes, int, int]] | None" = None
         METRICS.register(self)
 
     # -- boot gate (driven by ChunkStore's lazy `index` property) ----------
@@ -269,9 +284,15 @@ class DedupIndex:
             self._datablob.add(digest)
 
     # -- persistence -------------------------------------------------------
-    def save_snapshot(self, path: str) -> None:
+    def save_snapshot(self, path: str,
+                      sketches: "list[tuple[bytes, int, int]] | None"
+                      = None) -> None:
         """Atomic journaled snapshot: header + known digests + DataBlob
-        subset + sha256 trailer over the payload."""
+        subset + sha256 trailer over the payload.  ``sketches`` — the
+        similarity tier's (digest, sketch, depth) entries — append as
+        an independently-checksummed optional section so a restarted
+        server keeps offering pre-restart delta bases (corrupt/absent
+        section → organic rebuild, main payload unaffected)."""
         with self._lock:
             known = sorted(self._cuckoo._known)
             blob = sorted(self._datablob)
@@ -284,13 +305,25 @@ class DedupIndex:
             f.write(hdr)
             f.write(payload)
             f.write(digest)
+            if sketches is not None:
+                shdr = _SKETCH_HDR.pack(SKETCH_MAGIC, SKETCH_VERSION, 0,
+                                        len(sketches))
+                recs = b"".join(
+                    _SKETCH_REC.pack(d, s & ((1 << 64) - 1), min(255, dp))
+                    for d, s, dp in sketches)
+                f.write(shdr)
+                f.write(recs)
+                f.write(hashlib.sha256(shdr + recs).digest())
         os.replace(tmp, path)
         METRICS.add("snapshot_saves")
 
     def load_snapshot(self, path: str) -> bool:
         """Replace contents from a snapshot; False (and unchanged) on a
         missing/corrupt/truncated file — the caller then rebuilds from
-        a shard scan."""
+        a shard scan.  A valid trailing sketch section lands in
+        ``self.loaded_sketches`` for the similarity tier; any defect
+        there leaves the main load intact and the sketches None."""
+        self.loaded_sketches = None
         try:
             with open(path, "rb") as f:
                 raw = f.read()
@@ -302,8 +335,9 @@ class DedupIndex:
         if magic != SNAP_MAGIC or ver != SNAP_VERSION:
             return False
         body_end = _SNAP_HDR.size + 32 * (n_known + n_blob)
-        if len(raw) != body_end + 32 or \
-                hashlib.sha256(raw[:body_end]).digest() != raw[body_end:]:
+        if len(raw) < body_end + 32 or \
+                hashlib.sha256(raw[:body_end]).digest() != \
+                raw[body_end:body_end + 32]:
             return False
         off = _SNAP_HDR.size
         known = [raw[off + 32 * i:off + 32 * (i + 1)]
@@ -316,8 +350,38 @@ class DedupIndex:
             fresh.insert_many(known)
             self._cuckoo = fresh
             self._datablob = set(blob)
+        self.loaded_sketches = self._parse_sketch_section(
+            raw, body_end + 32)
         METRICS.add("snapshot_loads")
         return True
+
+    @staticmethod
+    def _parse_sketch_section(raw: bytes, start: int
+                              ) -> "list[tuple[bytes, int, int]] | None":
+        """The optional sketch section at ``start``; None on anything
+        short of a fully-valid section (its own sha256 trailer must
+        check out — a torn tail degrades to organic rebuild, never to
+        half-loaded sketch state)."""
+        if start >= len(raw):
+            return None                       # v1 snapshot: no section
+        sect = raw[start:]
+        if len(sect) < _SKETCH_HDR.size + 32:
+            return None
+        magic, ver, _, count = _SKETCH_HDR.unpack_from(sect)
+        if magic != SKETCH_MAGIC or ver != SKETCH_VERSION:
+            return None
+        body_end = _SKETCH_HDR.size + _SKETCH_REC.size * count
+        if len(sect) != body_end + 32 or \
+                hashlib.sha256(sect[:body_end]).digest() != \
+                sect[body_end:]:
+            return None
+        out: list[tuple[bytes, int, int]] = []
+        off = _SKETCH_HDR.size
+        for _ in range(count):
+            d, s, dp = _SKETCH_REC.unpack_from(sect, off)
+            off += _SKETCH_REC.size
+            out.append((d, s, dp))
+        return out
 
 
 def _device_probe_enabled() -> bool:

@@ -235,7 +235,10 @@ class ChunkStore:
     def _boot_index(self) -> None:
         """Populate the index at first use: consume-once snapshot if
         present (unlinked even on a failed load, so a crash later can
-        never resurrect it stale), else a full shard scan."""
+        never resurrect it stale), else a full shard scan.  A valid
+        sketch section re-seeds the similarity tier (tier on), so the
+        server keeps offering pre-restart delta bases; a corrupt or
+        absent section just leaves the tier to rebuild organically."""
         loaded = False
         try:
             loaded = self._index.load_snapshot(self._index_snap)
@@ -246,15 +249,25 @@ class ChunkStore:
                 pass
         if not loaded:
             self._index.rebuild(self.iter_digests())
+            return
+        sketches = self._index.loaded_sketches
+        self._index.loaded_sketches = None      # consume-once, like the file
+        if sketches and self._sim is not None:
+            self._sim.load_entries(sketches)
 
     def save_index_snapshot(self) -> bool:
         """Persist the index so the next open skips the shard scan
         (called after every sweep; safe to call any time — anything
-        inserted after the save is re-learned as a false negative)."""
+        inserted after the save is re-learned as a false negative).
+        With the similarity tier on, the resemblance entries ride along
+        in the snapshot's optional sketch section."""
         if self.index is None:
             return False
         os.makedirs(os.path.dirname(self._index_snap), exist_ok=True)
-        self.index.save_snapshot(self._index_snap)
+        self.index.save_snapshot(
+            self._index_snap,
+            sketches=(self._sim.export_entries()
+                      if self._sim is not None else None))
         return True
 
     @property
@@ -290,6 +303,115 @@ class ChunkStore:
         if self.index is None:
             return None
         return self.index.probe_batch(digests)
+
+    def on_disk_many(self, digests: "list[bytes]") -> "list[bool]":
+        """Batched disk-TRUE existence (``on_disk`` over a whole batch
+        in ONE call).  The sync engine's sanctioned membership fallback
+        for index-less destinations (pbslint rule ``sync-discipline``:
+        sync code negotiates membership via ``probe_batch``/
+        ``on_disk_many``, never per-digest loops of its own)."""
+        return [os.path.exists(self._path(d)) for d in digests]
+
+    # -- raw (compressed-as-stored) transfer surface — docs/sync.md --------
+    def get_raw(self, digest: bytes) -> bytes:
+        """The on-disk payload exactly as stored (raw zstd frame, PBS
+        DataBlob, or delta blob — callers sniff).  The sync wire reads
+        this so replicas exchange compressed bytes with no decompress/
+        recompress round-trip; integrity is re-checked by the receiving
+        ``insert_raw``.  Raises FileNotFoundError when absent."""
+        with open(self._path(digest), "rb") as f:
+            return f.read()
+
+    def insert_raw(self, digest: bytes, payload: bytes, *,
+                   verify: bool = True) -> bool:
+        """Store an already-encoded on-disk payload verbatim (the sync
+        wire's compressed-as-stored write).  Verification before the
+        payload becomes reachable:
+
+        - full blobs decode in memory and must hash back to ``digest``
+          (one decompress, never a recompress);
+        - delta blobs are header-checked before the write and then
+          verified by a read-back reassembly through their (already
+          mirrored — the engine transfers closure bases first) base
+          chain; a failed read-back unlinks the file again, so a
+          corrupt transfer can never leave a torn chunk behind.
+
+        A delta payload also forces the durable ``.delta-tier`` marker
+        BEFORE the write — a mirror holding delta blobs must run GC's
+        base closure exactly like the store that encoded them
+        (``delta_closure``) — except into a pbs-format store, where the
+        reassembled bytes land as a full DataBlob instead (the PR 9
+        invariant: a stock PBS cannot decode delta blobs, so they are
+        never written where one must read them).  Raises ValueError/
+        DeltaError/IOError on a payload that does not verify; nothing
+        reaches the final path until it has — a failed transfer can
+        never clobber a chunk the store already held."""
+        from .deltablob import is_delta, parse_header
+        from .pbsformat import blob_decode, blob_wrap_compressed, \
+            is_datablob
+        p = self._path(digest)
+        shard = self.shard_of(digest)
+        delta = is_delta(payload)
+        datablob = False
+        if delta:
+            base_digest = parse_header(payload)[3]   # structural gate
+            if verify or self.blob_format == "pbs":
+                # bases transfer first (the sync engine's ordering), so
+                # the chain resolves from THIS store: reassemble in
+                # memory and re-hash BEFORE anything lands on disk —
+                # symmetric with the full-blob path below
+                from .deltablob import decode as _delta_decode
+                base = self.get_resolved(base_digest, None)
+                data = _delta_decode(payload, base)
+                if hashlib.sha256(data).digest() != digest:
+                    raise ValueError(
+                        f"delta chunk {digest.hex()} reassembles to "
+                        "wrong bytes")
+            if self.blob_format == "pbs":
+                # store the reassembled bytes as a full DataBlob (the
+                # one cross-format case that pays a recompress — stock-
+                # PBS readability beats the as-stored purity here)
+                from .pbsformat import blob_encode
+                with self._shard_locks[shard]:
+                    self._write_payload(
+                        p, blob_encode(data, cctx=self._shard_cctx[shard]))
+                    if self.index is not None:
+                        self.index.insert(digest)
+                        self.index.mark_datablob(digest)
+                    else:
+                        self._remember_datablob(digest)
+                return True
+            if not self._ensure_delta_marker():
+                raise IOError(
+                    f"delta-tier marker unwritable; cannot mirror delta "
+                    f"blob {digest.hex()[:16]} as-stored")
+        else:
+            datablob = is_datablob(payload)
+            if self.blob_format == "pbs" and not datablob:
+                # pbs-format mirror receiving a native raw-zstd frame:
+                # wrap the envelope so a stock PBS can decode it — the
+                # compressed payload itself is untouched
+                payload = blob_wrap_compressed(payload)
+                datablob = True
+            if verify:
+                if datablob:
+                    data = blob_decode(payload, dctx=self._dctx)
+                else:
+                    data = self._dctx.decompress(payload,
+                                                 max_output_size=1 << 30)
+                if hashlib.sha256(data).digest() != digest:
+                    raise ValueError(
+                        f"raw chunk {digest.hex()} does not verify "
+                        "against its digest")
+        with self._shard_locks[shard]:
+            self._write_payload(p, payload)
+            if self.index is not None:
+                self.index.insert(digest)
+                if datablob:
+                    self.index.mark_datablob(digest)
+            elif datablob and self.blob_format == "pbs":
+                self._remember_datablob(digest)
+        return True
 
     # -- similarity tier ---------------------------------------------------
     @property

@@ -176,6 +176,17 @@ def blob_encode(data: bytes, *, compress: bool = True, level: int = 3,
     return _BLOB_HDR.pack(UNCOMPRESSED_BLOB_MAGIC, zlib.crc32(data)) + data
 
 
+def blob_wrap_compressed(frame: bytes) -> bytes:
+    """Wrap an ALREADY-compressed zstd frame as a compressed DataBlob
+    without touching the payload — the sync wire's format adapter when a
+    native raw-zstd chunk lands in a pbs-format mirror: only the 12-byte
+    envelope is added, never a decompress/recompress round-trip
+    (docs/sync.md)."""
+    if frame[:4] != _ZSTD_FRAME_MAGIC:
+        raise ValueError("not a zstd frame")
+    return _BLOB_HDR.pack(COMPRESSED_BLOB_MAGIC, zlib.crc32(frame)) + frame
+
+
 def blob_decode(raw: bytes, *, max_size: int = 1 << 30,
                 dctx: "zstandard.ZstdDecompressor | None" = None) -> bytes:
     if len(raw) < _BLOB_HDR.size:

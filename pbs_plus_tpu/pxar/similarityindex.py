@@ -279,6 +279,28 @@ class SimilarityIndex:
     def discard_many(self, digests: Iterable[bytes]) -> int:
         return sum(1 for d in digests if self.discard(d))
 
+    # -- persistence (rides the dedup-index snapshot's sketch section,
+    #    pxar/chunkindex.py — ISSUE 10 satellite / ROADMAP item 3) ---------
+    def export_entries(self) -> "list[tuple[bytes, int, int]]":
+        """(digest, sketch, depth) in insertion order — written into the
+        ``.chunkindex`` snapshot after every sweep so a restarted server
+        keeps offering pre-restart delta bases."""
+        with self._lock:
+            return [(d, s, dp) for d, (s, dp) in self._entries.items()]
+
+    def load_entries(self,
+                     entries: "Iterable[tuple[bytes, int, int]]") -> int:
+        """Re-seed from persisted entries (insertion order preserved, so
+        band buckets and the recency window rebuild exactly like the
+        original insert sequence).  A stale entry — its chunk swept
+        after the snapshot was saved — is only ever a wasted candidate:
+        the writer's base fetch fails and drops it (module docstring)."""
+        n = 0
+        for d, s, dp in entries:
+            self.add(d, s, dp)
+            n += 1
+        return n
+
     # -- introspection -----------------------------------------------------
     def has(self, digest: bytes) -> bool:
         with self._lock:

@@ -168,6 +168,29 @@ _MIGRATIONS: list[str] = [
     ALTER TABLE backup_jobs ADD COLUMN pipeline_workers
         INTEGER NOT NULL DEFAULT 0;
     """,
+    # 008 — datastore replication: sync jobs (pxar/syncwire.py,
+    # docs/sync.md).  A pull job replicates FROM the peer into the
+    # server datastore; push replicates INTO the peer.  The peer is
+    # either a remote sync wire (remote_url + remote_token) or a
+    # second local datastore directory (peer_path).
+    """
+    CREATE TABLE sync_jobs (
+        id TEXT PRIMARY KEY,
+        direction TEXT NOT NULL DEFAULT 'pull',
+        remote_url TEXT NOT NULL DEFAULT '',
+        remote_token TEXT NOT NULL DEFAULT '',
+        peer_path TEXT NOT NULL DEFAULT '',
+        backup_type TEXT NOT NULL DEFAULT '',
+        backup_id TEXT NOT NULL DEFAULT '',
+        namespace TEXT NOT NULL DEFAULT '',
+        schedule TEXT NOT NULL DEFAULT '',
+        enabled INTEGER NOT NULL DEFAULT 1,
+        last_run_at REAL,
+        last_status TEXT,
+        last_report TEXT,
+        created_at REAL NOT NULL
+    );
+    """,
 ]
 
 
@@ -487,6 +510,64 @@ class Database:
                 """UPDATE verification_jobs SET last_run_at=?, last_status=?,
                    last_report=? WHERE id=?""",
                 (time.time(), status, json.dumps(report), vid))
+
+    # -- sync jobs (datastore replication, docs/sync.md) ---------------------
+    def upsert_sync_job(self, sid: str, *, direction: str = "pull",
+                        remote_url: str = "", remote_token: str = "",
+                        peer_path: str = "", backup_type: str = "",
+                        backup_id: str = "", namespace: str = "",
+                        schedule: str = "", enabled: bool = True) -> None:
+        from ..utils import validate
+        validate.job_id(sid)
+        if direction not in ("pull", "push"):
+            raise ValueError(f"sync direction must be pull|push, "
+                             f"got {direction!r}")
+        if bool(remote_url) == bool(peer_path):
+            raise ValueError("exactly one of remote_url / peer_path "
+                             "must be set")
+        with self._lock, self._conn:
+            self._conn.execute(
+                """INSERT INTO sync_jobs (id,direction,remote_url,
+                   remote_token,peer_path,backup_type,backup_id,namespace,
+                   schedule,enabled,created_at) VALUES (?,?,?,?,?,?,?,?,?,?,?)
+                   ON CONFLICT(id) DO UPDATE SET
+                     direction=excluded.direction,
+                     remote_url=excluded.remote_url,
+                     remote_token=excluded.remote_token,
+                     peer_path=excluded.peer_path,
+                     backup_type=excluded.backup_type,
+                     backup_id=excluded.backup_id,
+                     namespace=excluded.namespace,
+                     schedule=excluded.schedule,
+                     enabled=excluded.enabled""",
+                (sid, direction, remote_url, remote_token, peer_path,
+                 backup_type, backup_id, namespace, schedule, int(enabled),
+                 time.time()))
+
+    def get_sync_job(self, sid: str) -> Optional[dict]:
+        with self._lock:
+            r = self._conn.execute(
+                "SELECT * FROM sync_jobs WHERE id=?", (sid,)).fetchone()
+        return dict(r) if r else None
+
+    def list_sync_jobs(self, *, enabled_only: bool = False) -> list[dict]:
+        q = "SELECT * FROM sync_jobs"
+        if enabled_only:
+            q += " WHERE enabled=1"
+        with self._lock:
+            return [dict(r) for r in self._conn.execute(q)]
+
+    def delete_sync_job(self, sid: str) -> None:
+        with self._lock, self._conn:
+            self._conn.execute("DELETE FROM sync_jobs WHERE id=?", (sid,))
+
+    def record_sync_result(self, sid: str, status: str,
+                           report: dict) -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                """UPDATE sync_jobs SET last_run_at=?, last_status=?,
+                   last_report=? WHERE id=?""",
+                (time.time(), status, json.dumps(report), sid))
 
     # -- hook scripts (reference: Script entity + PBS_PLUS__* env
     #    protocol, internal/server/jobs/{env,shell}.go) ----------------------

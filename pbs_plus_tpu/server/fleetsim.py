@@ -79,6 +79,14 @@ class FleetConfig:
     connect_concurrency: int = 32        # simultaneous dials in the storm
     connect_attempts: int = 25           # per-agent retries on 429/503
     job_timeout_s: float = 300.0
+    # replication traffic (ISSUE 10 fleet tie-in): drive this many sync
+    # jobs through the SAME jobs plane concurrently with the backup
+    # round — all in one "sync" fairness lane (the verification
+    # crowding rule), mirroring the fleet datastore into
+    # sync_mirror_dir (default "<datastore>-mirror"); a final catch-up
+    # sync after the backup rounds makes the mirror complete
+    sync_jobs: int = 0
+    sync_mirror_dir: str = ""
 
 
 def has_checkpoint(store: LocalStore, cn: str) -> bool:
@@ -500,6 +508,12 @@ class FleetReport:
     # per-target only" witness
     breaker_states_round1: dict = field(default_factory=dict)
     killed: set = field(default_factory=set)       # cns that crashed
+    # replication traffic driven through the same fairness lanes
+    sync_completed: int = 0
+    sync_failed: int = 0
+    sync_chunks: int = 0
+    sync_wire_bytes: int = 0
+    sync_failures: dict = field(default_factory=dict)  # job_id → error
 
     @staticmethod
     def _pct(xs: list, q: float) -> float:
@@ -548,6 +562,10 @@ class FleetReport:
             "running_max": self.running_max,
             "sessions_max": self.sessions_max,
             "bound_violated": self.bound_violated,
+            "sync_completed": self.sync_completed,
+            "sync_failed": self.sync_failed,
+            "sync_chunks": self.sync_chunks,
+            "sync_wire_bytes": self.sync_wire_bytes,
         }
 
 
@@ -655,8 +673,47 @@ async def run_fleet_async(datastore_dir: str,
                                 tenant=tenant, execute=execute,
                                 on_error=on_error))
 
+    # -- concurrent replication traffic (ISSUE 10 fleet tie-in) ------------
+    mirror_dir = cfg.sync_mirror_dir or f"{datastore_dir}-mirror"
+    mirror_ds = None
+
+    def submit_sync(job_id: str) -> None:
+        from ..pxar.syncwire import (LocalSyncDest, LocalSyncSource,
+                                     run_sync)
+
+        async def execute():
+            res = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: run_sync(
+                    LocalSyncSource(server.store.datastore),
+                    LocalSyncDest(mirror_ds),
+                    job_id=job_id, state_root=mirror_dir))
+            report.sync_completed += 1
+            report.sync_chunks += res["chunks_transferred"]
+            report.sync_wire_bytes += res["bytes_wire"]
+            report.sync_failures.pop(job_id, None)
+
+        async def on_error(exc: BaseException):
+            report.sync_failed += 1
+            report.sync_failures[job_id] = f"{type(exc).__name__}: {exc}"
+
+        # ONE shared "sync" fairness lane for every replication job —
+        # the verification crowding rule (docs/fleet.md "Fairness"): a
+        # sync backlog competes as a single tenant and can never starve
+        # backup tenants out of slot grants
+        server.jobs.enqueue(Job(id=f"sync:{job_id}", kind="sync",
+                                tenant="sync", execute=execute,
+                                on_error=on_error))
+
+    if cfg.sync_jobs > 0:
+        from ..pxar.datastore import Datastore
+        mirror_ds = Datastore(mirror_dir)
+
     for i in range(cfg.n_agents):
         submit(f"sim-{i:04d}", i, f"job-{i:04d}-r1")
+    # interleave the replication backlog with the backup storm so both
+    # kinds of traffic contend for the same execution slots
+    for i in range(cfg.sync_jobs):
+        submit_sync(f"fleet-sync-{i:02d}")
     await server.jobs.drain(timeout=cfg.job_timeout_s)
     report.breaker_states_round1 = {
         k: cb.state for k, cb in server.jobs._breakers.items()}
@@ -676,6 +733,13 @@ async def run_fleet_async(datastore_dir: str,
                 agents[cn] = a
             report.requeued += 1
             submit(cn, i, f"job-{i:04d}-r2")
+        await server.jobs.drain(timeout=cfg.job_timeout_s)
+
+    if cfg.sync_jobs > 0:
+        # catch-up pass once every backup published: the mirror ends the
+        # soak holding every snapshot (concurrent passes only mirrored
+        # what was published when their listing ran)
+        submit_sync("fleet-sync-final")
         await server.jobs.drain(timeout=cfg.job_timeout_s)
 
     report.wall_s = time.perf_counter() - t_start

@@ -381,6 +381,51 @@ class MetricsRegistry:
               "Sketches resident across live resemblance indexes",
               [({}, float(dl["entries"]))])
 
+        # -- datastore replication (pxar/syncwire.py; docs/sync.md) ----------
+        from ..pxar import syncwire as _syncwire
+        sy = _syncwire.metrics_snapshot()
+        gauge("pbs_plus_sync_jobs_total",
+              "Sync runs started", [({}, float(sy["jobs"]))])
+        gauge("pbs_plus_sync_snapshots_total",
+              "Snapshots mirrored to a destination",
+              [({}, float(sy["snapshots"]))])
+        gauge("pbs_plus_sync_chunks_probed_total",
+              "Digests membership-probed at sync destinations "
+              "(batched probes count one per digest)",
+              [({}, float(sy["chunks_probed"]))])
+        gauge("pbs_plus_sync_probe_batches_total",
+              "Membership negotiation batches (one vectorized "
+              "destination probe each)",
+              [({}, float(sy["probe_batches"]))])
+        gauge("pbs_plus_sync_chunks_transferred_total",
+              "Chunks that crossed the wire (the destination was "
+              "missing them)", [({}, float(sy["chunks_transferred"]))])
+        gauge("pbs_plus_sync_chunks_skipped_total",
+              "Chunks the destination already held (dedup skips)",
+              [({}, float(sy["chunks_skipped"]))])
+        gauge("pbs_plus_sync_bytes_wire_total",
+              "Compressed-as-stored bytes transferred",
+              [({}, float(sy["bytes_wire"]))])
+        gauge("pbs_plus_sync_bytes_logical_total",
+              "Logical snapshot bytes represented by mirrored "
+              "snapshots", [({}, float(sy["bytes_logical"]))])
+        gauge("pbs_plus_sync_resumes_total",
+              "Sync runs that resumed an interrupted predecessor",
+              [({}, float(sy["resumes"]))])
+        gauge("pbs_plus_sync_errors_total",
+              "Sync runs that failed (typed SyncError)",
+              [({}, float(sy["errors"]))])
+        sync_rows = s.db.list_sync_jobs()
+        gauge("pbs_plus_sync_last_run_timestamp",
+              "Unix time of the sync job's last run",
+              [({"job": r["id"]}, r["last_run_at"] or 0)
+               for r in sync_rows])
+        gauge("pbs_plus_sync_last_run_success",
+              "1 if the sync job's last run succeeded",
+              [({"job": r["id"]},
+                1.0 if r["last_status"] == "success" else 0.0)
+               for r in sync_rows])
+
         # -- read-path chunk cache (pxar/chunkcache.py) -----------------------
         from ..pxar import chunkcache as _chunkcache
         cc = _chunkcache.metrics_snapshot()

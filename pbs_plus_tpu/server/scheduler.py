@@ -23,17 +23,20 @@ TICK_S = 30.0
 
 EnqueueFn = Callable[[database.BackupJobRow], Awaitable[None]]
 VerifyFn = Callable[[dict], Awaitable[None]]
+SyncFn = Callable[[dict], Awaitable[None]]
 
 
 class Scheduler:
     def __init__(self, db: database.Database, jobs: JobsManager, *,
                  enqueue_backup: EnqueueFn,
                  enqueue_verification: VerifyFn | None = None,
+                 enqueue_sync: SyncFn | None = None,
                  tick_s: float = TICK_S):
         self.db = db
         self.jobs = jobs
         self.enqueue_backup = enqueue_backup
         self.enqueue_verification = enqueue_verification
+        self.enqueue_sync = enqueue_sync
         self.tick_s = tick_s
         self._last_enqueued: dict[str, dt.datetime] = {}
         self._retry_at: dict[str, float] = {}
@@ -83,6 +86,7 @@ class Scheduler:
                 self._last_enqueued[row.id] = now
                 await self.enqueue_backup(row)
         await self._tick_verifications(now)
+        await self._tick_syncs(now)
 
     def _reference_time(self, row: database.BackupJobRow,
                         now: dt.datetime) -> dt.datetime:
@@ -170,3 +174,24 @@ class Scheduler:
             if due:
                 self._pending_verifications.discard(v["id"])
                 await self.enqueue_verification(v)
+
+    async def _tick_syncs(self, now: dt.datetime) -> None:
+        """Calendar-due sync jobs (datastore replication, docs/sync.md)
+        — plumbed exactly like verification schedules; the sync job
+        layer dedups an already-running id itself."""
+        if self.enqueue_sync is None:
+            return
+        for s in self.db.list_sync_jobs(enabled_only=True):
+            if not s["schedule"]:
+                continue
+            try:
+                ref = (dt.datetime.fromtimestamp(s["last_run_at"])
+                       if s["last_run_at"]
+                       else now - dt.timedelta(seconds=2 * self.tick_s))
+                nxt = calendar.compute_next_event(s["schedule"], ref)
+            except calendar.CalendarError:
+                L.warning("sync job %s has invalid schedule %r",
+                          s["id"], s["schedule"])
+                continue
+            if nxt is not None and nxt <= now:
+                await self.enqueue_sync(s)

@@ -191,7 +191,8 @@ class Server:
         self.scheduler = Scheduler(
             self.db, self.jobs,
             enqueue_backup=self._enqueue_backup_row,
-            enqueue_verification=self._enqueue_verification)
+            enqueue_verification=self._enqueue_verification,
+            enqueue_sync=self._enqueue_sync)
         self.router = Router()          # control-plane server handlers
         self._register_handlers()
         # routers pre-attached to expected job sessions (restore jobs serve
@@ -213,6 +214,7 @@ class Server:
         self.started_at = time.time()
         self.live_progress: dict[str, tuple[float, object]] = {}
         self.last_run_stats: dict[str, dict] = {}
+        self.last_sync_stats: dict[str, dict] = {}
 
     # -- admission ---------------------------------------------------------
     async def _is_expected_host(self, cn: str, cert_der: bytes) -> bool:
@@ -699,3 +701,7 @@ class Server:
     async def _enqueue_verification(self, v: dict) -> None:
         from .verification_job import enqueue_verification
         enqueue_verification(self, v)
+
+    async def _enqueue_sync(self, s: dict) -> None:
+        from .sync_job import enqueue_sync
+        enqueue_sync(self, s)

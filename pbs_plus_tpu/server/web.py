@@ -21,6 +21,7 @@ aiohttp application; route groups:
     POST /api2/json/d2d/token                    issue bootstrap token
     GET  /api2/json/d2d/filetree?target=&path=   live agent browse
     GET/POST /api2/json/d2d/verification         verification jobs
+    GET/POST/DELETE /api2/json/d2d/sync          sync jobs (replication)
 
 Auth: API routes use bearer tokens minted by ``api_token`` (sealed in DB);
 with ``pbs_auth_key_path`` configured (PBS-host drop-in) the middleware
@@ -686,6 +687,65 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
         return web.json_response(
             {"started": enqueue_verification(server, v)})
 
+    # -- sync jobs (datastore replication, docs/sync.md) -------------------
+    async def sync_list(request):
+        rows = []
+        for r in server.db.list_sync_jobs():
+            r = dict(r)
+            # the peer bearer token grants write access to the remote
+            # store — it must never echo back to API readers
+            r["remote_token"] = "***" if r.get("remote_token") else ""
+            rows.append(r)
+        return web.json_response({"data": rows})
+
+    async def sync_upsert(request):
+        b = await request.json()
+        token = b.get("remote_token", "")
+        if token == "***":
+            # a client resubmitting the redacted listing keeps the
+            # stored secret instead of clobbering it with the mask
+            row = server.db.get_sync_job(b.get("id", ""))
+            token = row["remote_token"] if row else ""
+        try:
+            server.db.upsert_sync_job(
+                b["id"], direction=b.get("direction", "pull"),
+                remote_url=b.get("remote_url", ""),
+                remote_token=token,
+                peer_path=b.get("peer_path", ""),
+                backup_type=b.get("backup_type", ""),
+                backup_id=b.get("backup_id", ""),
+                namespace=b.get("namespace", ""),
+                schedule=b.get("schedule", ""),
+                enabled=bool(b.get("enabled", True)))
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"ok": True})
+
+    async def sync_delete(request):
+        server.db.delete_sync_job(request.match_info["id"])
+        return web.json_response({"ok": True})
+
+    async def sync_run(request):
+        from .sync_job import enqueue_sync
+        row = server.db.get_sync_job(request.match_info["id"])
+        if row is None:
+            return web.json_response({"error": "unknown job"}, status=404)
+        return web.json_response({"started": enqueue_sync(server, row)})
+
+    async def sync_results(request):
+        row = server.db.get_sync_job(request.match_info["id"])
+        if row is None:
+            return web.json_response({"error": "unknown job"}, status=404)
+        report = {}
+        if row.get("last_report"):
+            try:
+                report = json.loads(row["last_report"])
+            except ValueError:
+                pass
+        return web.json_response({"data": {
+            "id": row["id"], "last_run_at": row["last_run_at"],
+            "last_status": row["last_status"], "report": report}})
+
     app.router.add_get("/plus/healthz", healthz)
     app.router.add_get("/plus/readyz", readyz)
     app.router.add_get("/plus/metrics", metrics_handler)
@@ -1193,6 +1253,11 @@ echo "  --bootstrap-token <token_id:secret>"
                 None, ds.remove_snapshot, ref)
         return web.json_response({"ok": True})
 
+    app.router.add_get("/api2/json/d2d/sync", sync_list)
+    app.router.add_post("/api2/json/d2d/sync", sync_upsert)
+    app.router.add_delete("/api2/json/d2d/sync/{id}", sync_delete)
+    app.router.add_post("/api2/json/d2d/sync/{id}/run", sync_run)
+    app.router.add_get("/api2/json/d2d/sync/{id}/results", sync_results)
     app.router.add_get("/api2/json/d2d/verification", verification_list)
     app.router.add_post("/api2/json/d2d/verification", verification_upsert)
     app.router.add_post("/api2/json/d2d/verification/{id}/run",

@@ -108,6 +108,10 @@ class Env:
     # jobs queue bound (server/jobs.py): enqueues past this many
     # waiting jobs fast-fail with QueueFullError; 0 = unbounded
     max_queued_jobs: int = 1024
+    # datastore replication (pxar/syncwire.py, docs/sync.md): digests
+    # per membership-negotiation batch — one vectorized destination
+    # probe_batch (and at most one chunk transfer round) per batch
+    sync_batch: int = 1024
     extra: dict = field(default_factory=dict)
 
 
@@ -157,6 +161,7 @@ def env() -> Env:
         mux_write_deadline_s=_float_env(e, "PBS_PLUS_MUX_WRITE_DEADLINE",
                                         "60"),
         max_queued_jobs=_int_env(e, "PBS_PLUS_MAX_QUEUED_JOBS", "1024"),
+        sync_batch=_int_env(e, "PBS_PLUS_SYNC_BATCH", "1024"),
     )
 
 

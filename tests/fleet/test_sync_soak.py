@@ -1,0 +1,71 @@
+"""Concurrent replication + backup soak (ISSUE 10 fleet tie-in,
+docs/sync.md "Fleet interplay"): sync jobs ride the SAME bounded jobs
+queue and fairness lanes as backup traffic — one shared "sync" tenant
+(the verification crowding rule from docs/fleet.md) — while a fleet of
+loopback agents runs real backups.  Asserted: every backup publishes
+(no backup-tenant starvation behind the sync backlog), every sync
+completes, every bounded queue stays within bounds, and the mirror ends
+the soak bit-identical to the fleet datastore."""
+
+import os
+
+import pytest
+
+from pbs_plus_tpu.pxar.datastore import Datastore
+from pbs_plus_tpu.pxar.transfer import SplitReader
+from pbs_plus_tpu.server.fleetsim import FleetConfig, run_fleet
+
+FULL = bool(os.environ.get("PBS_PLUS_FLEET"))
+
+
+def _sync_soak(tmp_path, n_agents: int, sync_jobs: int) -> tuple:
+    cfg = FleetConfig(n_agents=n_agents, tenants=4,
+                      max_concurrent=4, max_queued=2 * n_agents,
+                      sync_jobs=sync_jobs,
+                      sync_mirror_dir=str(tmp_path / "mirror"))
+    rep = run_fleet(str(tmp_path / "ds"), cfg)
+    return rep, rep.to_dict()
+
+
+def _assert_sync_soak(tmp_path, rep, d, n_agents, sync_jobs) -> None:
+    # no backup-tenant starvation: every backup published even while
+    # the sync backlog competed for the same execution slots
+    assert d["published"] == n_agents, rep.failures
+    assert d["failed"] == 0
+    # every sync (the concurrent ones + the final catch-up) completed
+    assert d["sync_completed"] == sync_jobs + 1, rep.sync_failures
+    assert d["sync_failed"] == 0, rep.sync_failures
+    assert d["sync_chunks"] > 0 and d["sync_wire_bytes"] > 0
+    # bounded queues held their bounds throughout
+    assert not d["bound_violated"]
+    assert rep.queued_max <= 2 * n_agents
+    # the catch-up pass leaves the mirror holding EVERY snapshot,
+    # bit-identical to the fleet datastore
+    src = Datastore(str(tmp_path / "ds"))
+    dst = Datastore(str(tmp_path / "mirror"))
+    src_snaps = src.list_snapshots(all_namespaces=True)
+    assert [str(r) for r in dst.list_snapshots(all_namespaces=True)] == \
+        [str(r) for r in src_snaps]
+    assert len(src_snaps) == n_agents
+    for ref in src_snaps[:8]:                 # spot-check bit identity
+        r1 = SplitReader.open_snapshot(src, ref)
+        r2 = SplitReader.open_snapshot(dst, ref)
+        assert list(r1.meta_index.records()) == \
+            list(r2.meta_index.records())
+        assert list(r1.payload_index.records()) == \
+            list(r2.payload_index.records())
+
+
+def test_sync_and_backup_share_fairness_lanes(tmp_path):
+    n = 24
+    rep, d = _sync_soak(tmp_path, n, sync_jobs=3)
+    _assert_sync_soak(tmp_path, rep, d, n, 3)
+
+
+@pytest.mark.slow
+def test_sync_soak_full(tmp_path):
+    if not FULL:
+        pytest.skip("set PBS_PLUS_FLEET=1 for the full sync soak")
+    n = 200
+    rep, d = _sync_soak(tmp_path, n, sync_jobs=8)
+    _assert_sync_soak(tmp_path, rep, d, n, 8)

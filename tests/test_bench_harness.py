@@ -346,3 +346,29 @@ def test_bench_commit_walk_refs(tmp_path):
           f"reencoded {st['bytes_reencoded']} B")
     assert st["ref_chunks"] > 0
     assert st["new_chunks"] * 10 < st["ref_chunks"]
+
+
+def test_bench_sync():
+    """Replication benchmark (bench._sync_bench → detail.sync in the
+    bench JSON) with the ISSUE 10 acceptance gate: the incremental
+    re-sync after a contiguous 0.5% mutation transfers <= 10% of the
+    initial sync's wire bytes (the batched destination probes skip the
+    untouched chunks), and a third sync of the unchanged group
+    transfers exactly zero."""
+    import bench
+
+    res = bench._sync_bench(mib=16 if FULL else 6)
+    print(f"\n  sync: initial {res['initial_wire_bytes'] >> 20} MiB "
+          f"({res['initial_chunks']} chunks, "
+          f"{res['initial_probe_batches']} probe batches) | incr "
+          f"{res['incremental_wire_bytes'] >> 10} KiB "
+          f"({res['incremental_chunks']} chunks, "
+          f"{res['incremental_chunks_skipped']} skipped) | ratio "
+          f"{res['wire_ratio']}")
+    assert res["initial_chunks"] > 0 and res["initial_wire_bytes"] > 0
+    assert res["wire_ratio"] <= 0.10, res
+    assert res["incremental_chunks_skipped"] > 0
+    assert res["incremental_probe_batches"] >= 1
+    # an unchanged group re-syncs with zero transfer, zero wire bytes
+    assert res["resync_chunks"] == 0
+    assert res["resync_wire_bytes"] == 0

@@ -38,7 +38,7 @@ def test_registry_has_expected_rules():
         "thread-hygiene", "resource-ctx", "mutable-default",
         "failpoint-discipline", "cache-discipline",
         "bounded-queue-discipline", "index-discipline",
-        "delta-discipline",
+        "delta-discipline", "sync-discipline",
     }
 
 
@@ -130,6 +130,66 @@ def test_delta_discipline_unrelated_calls_clean():
         def load(payload, digest):
             return payload.get(digest)
     """, path="pbs_plus_tpu/pxar/remote.py", rules=["delta-discipline"])
+    assert v == []
+
+
+# -------------------------------------------------- sync-discipline
+
+
+def test_sync_discipline_flags_per_digest_has_loop():
+    v = run_lint("""
+        def negotiate(dest, digests):
+            return [d for d in digests if not dest.chunks.has(d)]
+    """, path="pbs_plus_tpu/pxar/syncwire.py", rules=["sync-discipline"])
+    assert names(v) == ["sync-discipline"]
+    assert "probe_batch" in v[0].message
+
+
+def test_sync_discipline_flags_contains_and_on_disk():
+    v = run_lint("""
+        def check(index, store, d):
+            return index.contains(d) or store.on_disk(d)
+    """, path="pbs_plus_tpu/server/sync_job.py", rules=["sync-discipline"])
+    assert names(v) == ["sync-discipline", "sync-discipline"]
+
+
+def test_sync_discipline_flags_exists_on_chunk_path():
+    v = run_lint("""
+        import os
+        def probe(store, digest):
+            return os.path.exists(store._path(digest))
+    """, path="pbs_plus_tpu/pxar/syncwire.py", rules=["sync-discipline"])
+    assert names(v) == ["sync-discipline"]
+
+
+def test_sync_discipline_batched_calls_clean():
+    v = run_lint("""
+        def negotiate(dest, digests):
+            present = dest.chunks.probe_batch(digests)
+            if present is None:
+                present = dest.chunks.on_disk_many(digests)
+            return [d for d, ok in zip(digests, present) if not ok]
+    """, path="pbs_plus_tpu/pxar/syncwire.py", rules=["sync-discipline"])
+    assert v == []
+
+
+def test_sync_discipline_non_chunk_exists_clean():
+    # snapshot-dir / state-file existence is not chunk membership
+    v = run_lint("""
+        import os
+        def has_snapshot(ds, ref):
+            return os.path.exists(os.path.join(ds.snapshot_dir(ref),
+                                               "manifest.json"))
+    """, path="pbs_plus_tpu/pxar/syncwire.py", rules=["sync-discipline"])
+    assert v == []
+
+
+def test_sync_discipline_out_of_scope_clean():
+    # the membership surface itself lives outside the sync modules
+    v = run_lint("""
+        def has(self, digest):
+            return self.index.contains(digest)
+    """, path="pbs_plus_tpu/pxar/datastore.py", rules=["sync-discipline"])
     assert v == []
 
 

@@ -569,3 +569,122 @@ def test_delta_closure_survives_tier_off_restart(tmp_path):
     reopened = ChunkStore(str(tmp_path / "ds"), delta_tier=False)
     assert reopened.similarity is None
     assert reopened.delta_closure({dn}) == {dn, db}
+
+
+# ------------------------- sketch persistence (ISSUE 10 satellite /
+#                           ROADMAP item 3: survive restarts) ---------
+
+def test_sketches_persist_across_restart(tmp_path):
+    """The dedup-index snapshot carries the resemblance entries: a
+    restarted tier-on store offers PRE-restart delta bases instead of
+    waiting for organic re-inserts."""
+    store = _delta_store(tmp_path)
+    base = _rand(64 << 10)
+    db = _dig(base)
+    store.insert(db, base, verify=False)
+    assert store.similarity.has(db)
+    assert store.save_index_snapshot()
+
+    reopened = _delta_store(tmp_path)
+    _ = reopened.index                      # lazy boot consumes snapshot
+    assert reopened.similarity.has(db), "pre-restart sketch lost"
+    assert reopened.similarity.depth_of(db) == 0
+    # a near-dup inserted AFTER the restart deltas against the
+    # pre-restart base
+    near = _mutate(base, 0.002, seed=91)
+    dn = _dig(near)
+    reopened.insert(dn, near, verify=False)
+    assert reopened.delta_base_of(dn) == db
+    assert reopened.get(dn) == near
+
+
+def test_sketch_depths_persist(tmp_path):
+    """Chain depths survive the roundtrip — without them a restarted
+    index would hand out max-chain bases and overshoot the bound."""
+    store = _delta_store(tmp_path)
+    base = _rand(48 << 10)
+    near = _mutate(base, 0.002, seed=92)
+    db, dn = _dig(base), _dig(near)
+    store.insert(db, base, verify=False)
+    store.insert(dn, near, verify=False)
+    assert store.similarity.depth_of(dn) == 1
+    store.save_index_snapshot()
+    reopened = _delta_store(tmp_path)
+    _ = reopened.index
+    assert reopened.similarity.depth_of(dn) == 1
+    assert reopened.similarity.depth_of(db) == 0
+
+
+def test_corrupt_sketch_section_degrades_to_organic(tmp_path):
+    """A flipped byte anywhere in the sketch section: the exact index
+    still loads from the snapshot, the tier just rebuilds organically —
+    never a crash, never half-loaded sketch state."""
+    store = _delta_store(tmp_path)
+    base = _rand(48 << 10)
+    db = _dig(base)
+    store.insert(db, base, verify=False)
+    store.save_index_snapshot()
+    snap = os.path.join(str(tmp_path / "ds"), ".chunkindex", "snapshot")
+    raw = bytearray(open(snap, "rb").read())
+    raw[-7] ^= 0x01                          # inside the sketch trailer
+    open(snap, "wb").write(bytes(raw))
+
+    reopened = _delta_store(tmp_path)
+    _ = reopened.index
+    assert reopened.index.contains(db)       # main payload intact
+    assert not reopened.similarity.has(db)   # sketches: organic rebuild
+    # organic rebuild proceeds normally
+    near = _mutate(base, 0.002, seed=93)
+    reopened.insert(_dig(near), near, verify=False)
+    assert reopened.similarity.has(_dig(near))
+
+
+def test_truncated_sketch_section_degrades(tmp_path):
+    store = _delta_store(tmp_path)
+    base = _rand(32 << 10)
+    db = _dig(base)
+    store.insert(db, base, verify=False)
+    store.save_index_snapshot()
+    snap = os.path.join(str(tmp_path / "ds"), ".chunkindex", "snapshot")
+    raw = open(snap, "rb").read()
+    open(snap, "wb").write(raw[:-10])        # tear the section tail
+    reopened = _delta_store(tmp_path)
+    _ = reopened.index
+    assert reopened.index.contains(db)
+    assert not reopened.similarity.has(db)
+
+
+def test_v1_snapshot_without_sketch_section_loads(tmp_path):
+    """A tier-off store writes no sketch section (the v1 byte layout);
+    a tier-on reopen loads the digests and leaves the tier organic."""
+    store = ChunkStore(str(tmp_path / "ds"), delta_tier=False)
+    data = _rand(16 << 10)
+    d = _dig(data)
+    store.insert(d, data, verify=False)
+    store.save_index_snapshot()
+    reopened = _delta_store(tmp_path)
+    _ = reopened.index
+    assert reopened.index.contains(d)
+    assert len(reopened.similarity) == 0
+
+
+def test_sweep_resaves_snapshot_with_surviving_sketches(tmp_path):
+    """The post-sweep snapshot save keeps only surviving sketches — a
+    swept base can never be offered by a restarted server."""
+    store = _delta_store(tmp_path)
+    keep = _rand(32 << 10)
+    drop = _rand(32 << 10, np.random.default_rng(7))
+    dk, dd = _dig(keep), _dig(drop)
+    store.insert(dk, keep, verify=False)
+    store.insert(dd, drop, verify=False)
+    time.sleep(0.02)
+    cutoff = time.time()
+    time.sleep(0.05)     # fs timestamp clock may lag time.time() by ms
+    store.touch(dk)                          # mark: keep survives
+    store.sweep(cutoff)                      # drop is unlinked + re-saved
+    reopened = _delta_store(tmp_path)
+    _ = reopened.index
+    assert reopened.similarity.has(dk)
+    assert not reopened.similarity.has(dd)
+    assert reopened.index.contains(dk)
+    assert not reopened.index.contains(dd)

@@ -1,0 +1,669 @@
+"""Datastore replication battery (pxar/syncwire.py + server/sync_job.py,
+docs/sync.md — ISSUE 10).
+
+The acceptance core: mirrored snapshots are BIT-identical to the source
+(index records, tree decode, restore bytes — including snapshots whose
+chunks are delta blobs, which transfer as-stored with their base
+closure); a second sync of an unchanged group transfers zero chunks and
+performs zero per-digest destination disk probes (batched index probes
+only — structurally asserted by counting chunk-path stats and poisoning
+the per-digest membership surface); a mid-sync kill resumes with
+strictly fewer transferred chunks than the full set; a corrupt transfer
+is a typed failure that leaves no torn chunks and no .tmp debris."""
+
+import asyncio
+import io
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from pbs_plus_tpu.chunker import ChunkerParams
+from pbs_plus_tpu.pxar import syncwire
+from pbs_plus_tpu.pxar.backupproxy import LocalStore
+from pbs_plus_tpu.pxar.datastore import Datastore
+from pbs_plus_tpu.pxar.deltablob import is_delta, parse_header
+from pbs_plus_tpu.pxar.format import KIND_DIR, KIND_FILE, Entry
+from pbs_plus_tpu.pxar.syncwire import (
+    HttpSyncDest, HttpSyncSource, LocalSyncDest, LocalSyncSource,
+    SyncError, SyncWireError, SyncWireServer, run_sync)
+from pbs_plus_tpu.pxar.transfer import SplitReader
+from pbs_plus_tpu.utils import failpoints
+
+P = ChunkerParams(avg_size=4 << 10)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.disarm_all()
+    yield
+    failpoints.disarm_all()
+
+
+def make_snapshot(store: LocalStore, files: dict[str, bytes], *,
+                  backup_id: str = "a", backup_time: float | None = None):
+    sess = store.start_session(backup_type="host", backup_id=backup_id,
+                               backup_time=backup_time)
+    sess.writer.write_entry(Entry(path="", kind=KIND_DIR))
+    for name, data in sorted(files.items()):
+        sess.writer.write_entry_reader(
+            Entry(path=name, kind=KIND_FILE), io.BytesIO(data))
+    sess.finish()
+    return sess.ref
+
+
+def snapshot_digests(ds: Datastore, ref) -> set[bytes]:
+    midx, pidx = ds.load_indexes(ref)
+    return {midx.digest(i) for i in range(len(midx))} | \
+        {pidx.digest(i) for i in range(len(pidx))}
+
+
+def assert_mirror_identical(src_ds: Datastore, dst_ds: Datastore, ref,
+                            files: dict[str, bytes]) -> None:
+    """Index records, tree decode, and restore bytes all bit-identical."""
+    r1 = SplitReader.open_snapshot(src_ds, ref)
+    r2 = SplitReader.open_snapshot(dst_ds, ref)
+    assert list(r1.meta_index.records()) == list(r2.meta_index.records())
+    assert list(r1.payload_index.records()) == \
+        list(r2.payload_index.records())
+    assert r1.meta_index.uuid == r2.meta_index.uuid
+    assert [e.path for e in r1.entries()] == [e.path for e in r2.entries()]
+    for name, data in files.items():
+        assert r2.read_file(r2.lookup(name)) == data
+    assert src_ds.load_manifest(ref) == dst_ds.load_manifest(ref)
+
+
+def no_tmp_debris(ds: Datastore) -> bool:
+    for dirpath, _dirs, names in os.walk(ds.chunks.base):
+        for n in names:
+            if ".tmp" in n:
+                return False
+    return True
+
+
+rng = np.random.default_rng(11)
+
+
+# ---------------------------------------------------------------- mirror
+
+
+def test_local_mirror_bit_identical(tmp_path):
+    src = LocalStore(str(tmp_path / "src"), P)
+    files1 = {"a.bin": rng.integers(0, 256, 96 << 10,
+                                    dtype=np.uint8).tobytes(),
+              "b.txt": b"hello sync\n" * 400}
+    ref1 = make_snapshot(src, files1)
+    # second generation dedups against the first
+    files2 = dict(files1, **{"c.bin": rng.integers(
+        0, 256, 32 << 10, dtype=np.uint8).tobytes()})
+    ref2 = make_snapshot(src, files2)
+
+    dst = Datastore(str(tmp_path / "dst"))
+    stats = run_sync(LocalSyncSource(src.datastore), LocalSyncDest(dst),
+                     job_id="j1", state_root=str(tmp_path / "dst"))
+    assert stats["snapshots_synced"] == 2
+    assert stats["chunks_transferred"] > 0
+    assert stats["bytes_wire"] > 0
+    assert_mirror_identical(src.datastore, dst, ref1, files1)
+    assert_mirror_identical(src.datastore, dst, ref2, files2)
+    # the mirror sees the same snapshot listing
+    assert [str(r) for r in dst.list_snapshots()] == \
+        [str(r) for r in src.datastore.list_snapshots()]
+    assert no_tmp_debris(dst)
+
+
+def test_transfer_is_compressed_as_stored(tmp_path):
+    """Wire payloads are the exact on-disk bytes — no decompress/
+    recompress round-trip (byte-compare source vs mirror chunk files)."""
+    src = LocalStore(str(tmp_path / "src"), P)
+    files = {"a.bin": rng.integers(0, 256, 48 << 10,
+                                   dtype=np.uint8).tobytes()}
+    ref = make_snapshot(src, files)
+    dst = Datastore(str(tmp_path / "dst"))
+    run_sync(LocalSyncSource(src.datastore), LocalSyncDest(dst))
+    for d in snapshot_digests(src.datastore, ref):
+        assert dst.chunks.get_raw(d) == src.datastore.chunks.get_raw(d)
+
+
+def test_mirror_into_pbs_format_wraps_without_recompress(tmp_path):
+    """A native raw-zstd chunk landing in a pbs-format mirror gains the
+    12-byte DataBlob envelope, payload untouched."""
+    src = LocalStore(str(tmp_path / "src"), P)
+    files = {"a.bin": rng.integers(0, 256, 24 << 10,
+                                   dtype=np.uint8).tobytes()}
+    ref = make_snapshot(src, files)
+    dst = Datastore(str(tmp_path / "dst"), pbs_format=True)
+    run_sync(LocalSyncSource(src.datastore), LocalSyncDest(dst))
+    from pbs_plus_tpu.pxar.pbsformat import is_datablob
+    for d in snapshot_digests(src.datastore, ref):
+        src_raw = src.datastore.chunks.get_raw(d)
+        dst_raw = dst.chunks.get_raw(d)
+        assert is_datablob(dst_raw)
+        assert dst_raw[12:] == src_raw          # envelope only
+        assert dst.chunks.get(d) == src.datastore.chunks.get(d)
+
+
+# ------------------------------------------------- second-sync structure
+
+
+def test_second_sync_transfers_zero(tmp_path):
+    src = LocalStore(str(tmp_path / "src"), P)
+    make_snapshot(src, {"a.bin": rng.integers(
+        0, 256, 64 << 10, dtype=np.uint8).tobytes()})
+    dst = Datastore(str(tmp_path / "dst"))
+    run_sync(LocalSyncSource(src.datastore), LocalSyncDest(dst),
+             job_id="j", state_root=str(tmp_path / "dst"))
+    stats = run_sync(LocalSyncSource(src.datastore), LocalSyncDest(dst),
+                     job_id="j", state_root=str(tmp_path / "dst"))
+    assert stats["chunks_transferred"] == 0
+    assert stats["bytes_wire"] == 0
+    assert stats["snapshots_skipped"] == 1
+    assert stats["probe_batches"] == 0      # published manifest short-cut
+
+
+def test_unchanged_group_probes_batched_and_disk_free(tmp_path,
+                                                      monkeypatch):
+    """The structural acceptance witness: re-mirroring a group whose
+    chunks are all present performs ONLY batched index probes — zero
+    per-digest destination disk probes (chunk-path exists/stat counted
+    at zero) and zero per-digest membership calls (the surface is
+    poisoned)."""
+    src = LocalStore(str(tmp_path / "src"), P)
+    files = {"a.bin": rng.integers(0, 256, 64 << 10,
+                                   dtype=np.uint8).tobytes()}
+    ref = make_snapshot(src, files)
+    dst = Datastore(str(tmp_path / "dst"))
+    dest = LocalSyncDest(dst)
+    run_sync(LocalSyncSource(src.datastore), dest)
+    # drop the published snapshot dirs but keep every chunk: the next
+    # sync must re-negotiate the whole digest set and transfer nothing
+    shutil.rmtree(os.path.join(str(tmp_path / "dst"), "host"))
+    assert not dest.has_snapshot(ref)
+
+    dst_chunks = dst.chunks.base
+    counts = {"disk_probes": 0, "probe_batches": 0}
+    real_exists, real_stat = os.path.exists, os.stat
+
+    def in_dest_chunks(p) -> bool:
+        try:
+            p = os.fspath(p)
+        except TypeError:
+            return False
+        return str(p).startswith(dst_chunks) and \
+            len(os.path.basename(str(p))) == 64
+
+    def exists(p):
+        if in_dest_chunks(p):
+            counts["disk_probes"] += 1
+        return real_exists(p)
+
+    def stat(p, *a, **kw):
+        if in_dest_chunks(p):
+            counts["disk_probes"] += 1
+        return real_stat(p, *a, **kw)
+
+    monkeypatch.setattr(os.path, "exists", exists)
+    monkeypatch.setattr(os, "stat", stat)
+    # poison the per-digest membership surface outright
+    from pbs_plus_tpu.pxar.chunkindex import DedupIndex
+    from pbs_plus_tpu.pxar.datastore import ChunkStore
+
+    def _forbidden(self, *a, **kw):
+        raise AssertionError("per-digest membership call in sync path")
+    monkeypatch.setattr(ChunkStore, "has", _forbidden)
+    monkeypatch.setattr(ChunkStore, "on_disk", _forbidden)
+    monkeypatch.setattr(DedupIndex, "contains", _forbidden)
+    real_probe = DedupIndex.probe_batch
+
+    def counting_probe(self, digests):
+        counts["probe_batches"] += 1
+        return real_probe(self, digests)
+    monkeypatch.setattr(DedupIndex, "probe_batch", counting_probe)
+
+    stats = run_sync(LocalSyncSource(src.datastore), dest)
+    assert stats["snapshots_synced"] == 1
+    assert stats["chunks_transferred"] == 0
+    assert stats["chunks_skipped"] == stats["chunks_probed"] > 0
+    assert counts["probe_batches"] >= 1
+    assert counts["disk_probes"] == 0, counts
+    assert_mirror_identical(src.datastore, dst, ref, files)
+
+
+# ------------------------------------------------------ delta closure
+
+
+def _near_dup(data: bytes, *, every: int = 8 << 10) -> bytes:
+    """Flip one byte per ``every``-sized region: every chunk is novel to
+    the exact tier, similar enough for the delta tier."""
+    out = bytearray(data)
+    for off in range(0, len(out), every):
+        out[off] ^= 0xFF
+    return bytes(out)
+
+
+def test_delta_blob_mirror_with_base_closure(tmp_path):
+    """Snapshots holding delta blobs mirror bit-identically: the deltas
+    transfer as-stored and their base chains ride along via the source
+    delta closure — even when no surviving snapshot references the
+    bases directly."""
+    src = LocalStore(str(tmp_path / "src"), P, delta_tier=True)
+    gen0 = rng.integers(0, 256, 96 << 10, dtype=np.uint8).tobytes()
+    ref0 = make_snapshot(src, {"a.bin": gen0})
+    gen1 = _near_dup(gen0)
+    ref1 = make_snapshot(src, {"a.bin": gen1})
+    src_ds = src.datastore
+    deltas = [d for d in snapshot_digests(src_ds, ref1)
+              if is_delta(src_ds.chunks.get_raw(d))]
+    assert deltas, "corpus produced no delta blobs; test is vacuous"
+    bases = {parse_header(src_ds.chunks.get_raw(d))[3] for d in deltas}
+    # the bases belong to gen0 only — drop gen0's snapshot so the sync
+    # can only learn them through the delta closure
+    assert not (bases & snapshot_digests(src_ds, ref1))
+    shutil.rmtree(src_ds.snapshot_dir(ref0))
+
+    dst = Datastore(str(tmp_path / "dst"))
+    stats = run_sync(LocalSyncSource(src_ds), LocalSyncDest(dst))
+    assert stats["snapshots_synced"] == 1
+    assert_mirror_identical(src_ds, dst, ref1, {"a.bin": gen1})
+    for d in deltas:
+        assert dst.chunks.get_raw(d) == src_ds.chunks.get_raw(d)
+    for b in bases:
+        assert dst.chunks.get_raw(b) == src_ds.chunks.get_raw(b)
+    # the mirror must run GC's base closure like the encoding store
+    assert os.path.exists(os.path.join(str(tmp_path / "dst"),
+                                       ".delta-tier"))
+    closure = dst.chunks.delta_closure(snapshot_digests(dst, ref1))
+    assert bases <= closure
+
+
+# ------------------------------------------------------- chaos / resume
+
+
+def test_kill_mid_sync_resume_strictly_less(tmp_path):
+    src = LocalStore(str(tmp_path / "src"), P)
+    files = {"a.bin": rng.integers(0, 256, 128 << 10,
+                                   dtype=np.uint8).tobytes()}
+    ref = make_snapshot(src, files)
+    full = len(snapshot_digests(src.datastore, ref))
+    assert full > 20
+    dst = Datastore(str(tmp_path / "dst"))
+    m0 = syncwire.metrics_snapshot()
+    with failpoints.armed("pbsstore.sync.transfer", "raise", nth=20):
+        with pytest.raises(SyncError):
+            run_sync(LocalSyncSource(src.datastore), LocalSyncDest(dst),
+                     job_id="j", state_root=str(tmp_path / "dst"),
+                     batch=8)
+    m1 = syncwire.metrics_snapshot()
+    landed = m1["chunks_transferred"] - m0["chunks_transferred"]
+    assert 0 < landed < full
+    assert no_tmp_debris(dst)
+    # nothing half-published
+    assert dst.list_snapshots() == []
+
+    stats = run_sync(LocalSyncSource(src.datastore), LocalSyncDest(dst),
+                     job_id="j", state_root=str(tmp_path / "dst"),
+                     batch=8)
+    assert stats["resumed"] is True
+    assert stats["chunks_transferred"] < full       # strictly less
+    assert stats["chunks_transferred"] + landed >= full
+    m2 = syncwire.metrics_snapshot()
+    assert m2["resumes"] == m1["resumes"] + 1
+    assert_mirror_identical(src.datastore, dst, ref, files)
+    # durable per-group progress recorded
+    state = json.loads(open(os.path.join(
+        str(tmp_path / "dst"), ".sync", "j", "state.json")).read())
+    assert str(ref) in state["done"]
+    assert state["in_progress"] is None
+
+
+def test_transfer_corrupt_typed_failure_no_torn_chunks(tmp_path):
+    src = LocalStore(str(tmp_path / "src"), P)
+    files = {"a.bin": rng.integers(0, 256, 64 << 10,
+                                   dtype=np.uint8).tobytes()}
+    ref = make_snapshot(src, files)
+    dst = Datastore(str(tmp_path / "dst"))
+    with failpoints.armed("pbsstore.sync.transfer", "corrupt", nth=5):
+        with pytest.raises(SyncError):
+            run_sync(LocalSyncSource(src.datastore), LocalSyncDest(dst))
+    # every chunk that DID land decodes and verifies; no .tmp debris;
+    # no half-published snapshot
+    for d in dst.chunks.iter_digests():
+        assert dst.chunks.get(d)
+    assert no_tmp_debris(dst)
+    assert dst.list_snapshots() == []
+    # a clean retry completes and mirrors bit-identically
+    stats = run_sync(LocalSyncSource(src.datastore), LocalSyncDest(dst))
+    assert stats["snapshots_synced"] == 1
+    assert_mirror_identical(src.datastore, dst, ref, files)
+
+
+def test_probe_and_commit_faults_are_typed_and_clean(tmp_path):
+    src = LocalStore(str(tmp_path / "src"), P)
+    files = {"a.bin": rng.integers(0, 256, 32 << 10,
+                                   dtype=np.uint8).tobytes()}
+    ref = make_snapshot(src, files)
+    dst = Datastore(str(tmp_path / "dst"))
+    with failpoints.armed("pbsstore.sync.probe", "raise"):
+        with pytest.raises(SyncError):
+            run_sync(LocalSyncSource(src.datastore), LocalSyncDest(dst))
+    assert dst.list_snapshots() == []
+    with failpoints.armed("pbsstore.sync.commit", "raise"):
+        with pytest.raises(SyncError):
+            run_sync(LocalSyncSource(src.datastore), LocalSyncDest(dst))
+    # chunks landed (they dedup on resume) but no snapshot is visible
+    # and no staging dir survived
+    assert dst.list_snapshots() == []
+    snap_parent = os.path.dirname(dst.snapshot_dir(ref))
+    if os.path.isdir(snap_parent):
+        assert not [n for n in os.listdir(snap_parent) if ".tmp" in n]
+    stats = run_sync(LocalSyncSource(src.datastore), LocalSyncDest(dst))
+    assert stats["chunks_transferred"] == 0     # everything re-probed
+    assert_mirror_identical(src.datastore, dst, ref, files)
+
+
+# ------------------------------------------------------------ HTTP wire
+
+
+def test_http_wire_pull_push_and_auth(tmp_path):
+    src = LocalStore(str(tmp_path / "src"), P)
+    files = {"a.bin": rng.integers(0, 256, 48 << 10,
+                                   dtype=np.uint8).tobytes()}
+    ref = make_snapshot(src, files)
+    srv = SyncWireServer(src.datastore, "tok-src")
+    port = srv.start()
+    try:
+        # bad token → typed wire error, nothing mirrored
+        bad = HttpSyncSource(f"http://127.0.0.1:{port}", "wrong")
+        with pytest.raises(SyncWireError):
+            bad.list_snapshots()
+        bad.close()
+        # pull over the wire
+        dst = Datastore(str(tmp_path / "dst"))
+        source = HttpSyncSource(f"http://127.0.0.1:{port}", "tok-src")
+        stats = run_sync(source, LocalSyncDest(dst), job_id="pull",
+                         state_root=str(tmp_path / "dst"))
+        source.close()
+        assert stats["snapshots_synced"] == 1
+        assert_mirror_identical(src.datastore, dst, ref, files)
+    finally:
+        srv.stop()
+    # push into a remote destination: the peer answers membership with
+    # one vectorized probe per batch
+    dst2 = Datastore(str(tmp_path / "dst2"))
+    srv2 = SyncWireServer(dst2, "tok-dst")
+    port2 = srv2.start()
+    try:
+        dest = HttpSyncDest(f"http://127.0.0.1:{port2}", "tok-dst")
+        stats = run_sync(LocalSyncSource(src.datastore), dest,
+                         job_id="push", state_root=str(tmp_path / "src"))
+        assert stats["snapshots_synced"] == 1
+        # pushing again is a no-op (remote has_snapshot short-cut)
+        stats2 = run_sync(LocalSyncSource(src.datastore), dest,
+                          job_id="push", state_root=str(tmp_path / "src"))
+        dest.close()
+        assert stats2["chunks_transferred"] == 0
+        assert stats2["snapshots_skipped"] == 1
+        assert_mirror_identical(src.datastore, dst2, ref, files)
+    finally:
+        srv2.stop()
+
+
+# --------------------------------------------------- job + scheduler
+
+
+class _FakeServer:
+    """The sync job layer's server surface without TLS/cryptography:
+    db + jobs + datastore + stats dicts."""
+
+    def __init__(self, tmp_path, jobs):
+        from pbs_plus_tpu.server.database import Database
+        self.db = Database(str(tmp_path / "state" / "db.sqlite"))
+        self.jobs = jobs
+        self.datastore = LocalStore(str(tmp_path / "ds"), P)
+        self.last_sync_stats = {}
+        self._gc_active = False
+
+
+def test_sync_job_end_to_end_through_jobs_queue(tmp_path):
+    from pbs_plus_tpu.server.jobs import JobsManager
+    from pbs_plus_tpu.server.sync_job import enqueue_sync
+
+    peer = LocalStore(str(tmp_path / "peer"), P)
+    files = {"a.bin": rng.integers(0, 256, 32 << 10,
+                                   dtype=np.uint8).tobytes()}
+    ref = make_snapshot(peer, files)
+
+    async def main():
+        server = _FakeServer(tmp_path, JobsManager(max_concurrent=2,
+                                                   max_queued=8))
+        server.db.upsert_sync_job(
+            "mirror", direction="pull", peer_path=str(tmp_path / "peer"))
+        row = server.db.get_sync_job("mirror")
+        assert enqueue_sync(server, row) is True
+        # double-enqueue dedups without a stale task row
+        assert enqueue_sync(server, row) is False
+        await server.jobs.wait("sync:mirror", timeout=60)
+        return server
+
+    server = asyncio.run(main())
+    row = server.db.get_sync_job("mirror")
+    assert row["last_status"] == "success"
+    report = json.loads(row["last_report"])
+    assert report["snapshots_synced"] == 1
+    assert server.last_sync_stats["mirror"]["snapshots_synced"] == 1
+    tasks = server.db.list_tasks(job_id="mirror")
+    assert tasks and tasks[0]["status"] == "success"
+    assert "sync complete" in tasks[0]["log"]
+    assert_mirror_identical(peer.datastore, server.datastore.datastore,
+                            ref, files)
+    server.db.close()
+
+
+def test_sync_job_failure_is_recorded(tmp_path):
+    from pbs_plus_tpu.server.jobs import JobsManager
+    from pbs_plus_tpu.server.sync_job import enqueue_sync
+
+    peer = LocalStore(str(tmp_path / "peer"), P)
+    make_snapshot(peer, {"a.bin": b"x" * 8192})
+
+    async def main():
+        server = _FakeServer(tmp_path, JobsManager(max_concurrent=2,
+                                                   max_queued=8))
+        server.db.upsert_sync_job(
+            "mirror", direction="pull", peer_path=str(tmp_path / "peer"))
+        row = server.db.get_sync_job("mirror")
+        with failpoints.armed("pbsstore.sync.transfer", "raise"):
+            assert enqueue_sync(server, row) is True
+            await server.jobs.wait("sync:mirror", timeout=60)
+        return server
+
+    server = asyncio.run(main())
+    row = server.db.get_sync_job("mirror")
+    assert row["last_status"] == "error"
+    assert "error" in json.loads(row["last_report"])
+    server.db.close()
+
+
+def test_scheduler_ticks_sync_jobs(tmp_path):
+    from pbs_plus_tpu.server.database import Database
+    from pbs_plus_tpu.server.jobs import JobsManager
+    from pbs_plus_tpu.server.scheduler import Scheduler
+
+    db = Database(str(tmp_path / "db.sqlite"))
+    db.upsert_sync_job("s1", direction="push",
+                       peer_path=str(tmp_path / "peer"),
+                       schedule="minutely")
+    db.upsert_sync_job("s2", direction="pull",
+                       peer_path=str(tmp_path / "peer2"))   # no schedule
+    db.upsert_sync_job("s3", direction="pull",
+                       peer_path=str(tmp_path / "peer3"),
+                       schedule="minutely", enabled=False)
+    fired = []
+
+    async def main():
+        async def enqueue_backup(row):
+            raise AssertionError("no backup jobs configured")
+
+        async def enqueue_sync(row):
+            fired.append(row["id"])
+
+        sched = Scheduler(db, JobsManager(max_concurrent=1),
+                          enqueue_backup=enqueue_backup,
+                          enqueue_sync=enqueue_sync)
+        await sched.tick()
+
+    asyncio.run(main())
+    assert fired == ["s1"]
+    db.close()
+
+
+def test_sync_job_row_validation(tmp_path):
+    from pbs_plus_tpu.server.database import Database
+    db = Database(str(tmp_path / "db.sqlite"))
+    with pytest.raises(ValueError):
+        db.upsert_sync_job("bad", direction="sideways",
+                           peer_path="/x")
+    with pytest.raises(ValueError):
+        db.upsert_sync_job("bad", direction="pull")     # no peer at all
+    with pytest.raises(ValueError):
+        db.upsert_sync_job("bad", direction="pull", peer_path="/x",
+                           remote_url="http://y")       # both peers
+    db.upsert_sync_job("ok", peer_path="/x", schedule="hourly")
+    assert db.get_sync_job("ok")["schedule"] == "hourly"
+    db.delete_sync_job("ok")
+    assert db.get_sync_job("ok") is None
+    db.close()
+
+
+# ------------------------------------------------------- state format
+
+
+def test_sync_state_roundtrip_and_corruption(tmp_path):
+    path = os.path.join(str(tmp_path), ".sync", "j", "state.json")
+    st = syncwire.SyncState.load(path)
+    assert not st.resuming
+    st.mark_in_progress("host/a/2026-01-01T00:00:00Z")
+    st.save()
+    st2 = syncwire.SyncState.load(path)
+    assert st2.resuming
+    st2.mark_done("host/a/2026-01-01T00:00:00Z", {"chunks_transferred": 3})
+    st2.save()
+    st3 = syncwire.SyncState.load(path)
+    assert not st3.resuming
+    assert "host/a/2026-01-01T00:00:00Z" in st3.data["done"]
+    # corrupt state degrades to a fresh start, never a crash
+    with open(path, "w") as f:
+        f.write("{broken json")
+    st4 = syncwire.SyncState.load(path)
+    assert st4.data["done"] == {}
+
+
+# --------------------------------------------- review-pass regressions
+
+
+def test_bad_delta_transfer_never_clobbers_existing_chunk(tmp_path):
+    """A failed delta verification must leave a pre-existing good chunk
+    untouched (the index can hold a by-design false negative for a
+    digest that IS on disk — re-transfer then races a corrupt payload
+    against the good file)."""
+    import hashlib
+
+    from pbs_plus_tpu.pxar import deltablob
+    from pbs_plus_tpu.pxar.datastore import ChunkStore
+    store = ChunkStore(str(tmp_path / "ds"))
+    base = rng.integers(0, 256, 16 << 10, dtype=np.uint8).tobytes()
+    good = rng.integers(0, 256, 16 << 10, dtype=np.uint8).tobytes()
+    db_, dg = (hashlib.sha256(base).digest(),
+               hashlib.sha256(good).digest())
+    store.insert(db_, base, verify=False)
+    store.insert(dg, good, verify=False)
+    store.index.discard(dg)                 # safe false negative
+    # a structurally-valid delta blob whose content does NOT reassemble
+    # to `good` (models a corrupt transfer): a near-dup of `base`
+    # encodes profitably, but its bytes are not dg's
+    near = bytearray(base)
+    near[0] ^= 0xFF
+    wrong = deltablob.encode(bytes(near), base, db_, depth=1)
+    assert wrong is not None
+    with pytest.raises(ValueError):
+        store.insert_raw(dg, wrong)
+    assert store.get(dg) == good            # still the original bytes
+
+
+def test_delta_payload_into_pbs_mirror_stored_as_datablob(tmp_path):
+    """PR 9 invariant holds across the wire: a pbs-format mirror never
+    holds delta blobs — the reassembled bytes land as a DataBlob a
+    stock PBS can decode."""
+    src = LocalStore(str(tmp_path / "src"), P, delta_tier=True)
+    gen0 = rng.integers(0, 256, 64 << 10, dtype=np.uint8).tobytes()
+    make_snapshot(src, {"a.bin": gen0})
+    ref1 = make_snapshot(src, {"a.bin": _near_dup(gen0)})
+    src_ds = src.datastore
+    deltas = [d for d in snapshot_digests(src_ds, ref1)
+              if is_delta(src_ds.chunks.get_raw(d))]
+    assert deltas
+    dst = Datastore(str(tmp_path / "dst"), pbs_format=True)
+    run_sync(LocalSyncSource(src_ds), LocalSyncDest(dst))
+    from pbs_plus_tpu.pxar.pbsformat import is_datablob
+    for d in deltas:
+        raw = dst.chunks.get_raw(d)
+        assert is_datablob(raw) and not is_delta(raw)
+        assert dst.chunks.get(d) == src_ds.chunks.get(d)
+    # no delta ever landed, so no closure marker either
+    assert not os.path.exists(os.path.join(str(tmp_path / "dst"),
+                                           ".delta-tier"))
+
+
+def test_stale_in_progress_clears_after_clean_run(tmp_path):
+    """A predecessor dying between publish and mark_done (or its
+    snapshot being pruned from the source) must not make every later
+    run count as a resume."""
+    src = LocalStore(str(tmp_path / "src"), P)
+    ref = make_snapshot(src, {"a.bin": b"z" * 16384})
+    dst = Datastore(str(tmp_path / "dst"))
+    run_sync(LocalSyncSource(src.datastore), LocalSyncDest(dst),
+             job_id="j", state_root=str(tmp_path / "dst"))
+    # forge the crash window: in_progress points at the published snap
+    sp = syncwire.state_path(str(tmp_path / "dst"), "j")
+    st = syncwire.SyncState.load(sp)
+    st.mark_in_progress(str(ref))
+    st.save()
+    stats = run_sync(LocalSyncSource(src.datastore), LocalSyncDest(dst),
+                     job_id="j", state_root=str(tmp_path / "dst"))
+    assert stats["resumed"] is True          # this run IS the resume
+    stats2 = run_sync(LocalSyncSource(src.datastore), LocalSyncDest(dst),
+                      job_id="j", state_root=str(tmp_path / "dst"))
+    assert stats2["resumed"] is False        # ...but only this once
+    # pruned-from-source variant: in_progress names a vanished ref
+    st = syncwire.SyncState.load(sp)
+    st.mark_in_progress("host/gone/2020-01-01T00:00:00Z")
+    st.save()
+    run_sync(LocalSyncSource(src.datastore), LocalSyncDest(dst),
+             job_id="j", state_root=str(tmp_path / "dst"))
+    assert not syncwire.SyncState.load(sp).resuming
+
+
+def test_http_wire_root_namespace_filter_stays_root(tmp_path):
+    """ns='' over the wire filters to the ROOT namespace only — the
+    blank query value must not widen the filter to all namespaces."""
+    src = LocalStore(str(tmp_path / "src"), P)
+    make_snapshot(src, {"a.bin": b"r" * 8192})
+    sess = src.start_session(backup_type="host", backup_id="n",
+                             namespace="tenant1")
+    sess.writer.write_entry(Entry(path="", kind=KIND_DIR))
+    sess.writer.write_entry_reader(Entry(path="f", kind=KIND_FILE),
+                                   io.BytesIO(b"n" * 8192))
+    sess.finish()
+    srv = SyncWireServer(src.datastore, "t")
+    port = srv.start()
+    try:
+        source = HttpSyncSource(f"http://127.0.0.1:{port}", "t")
+        root_only = source.list_snapshots(namespace="")
+        everything = source.list_snapshots(namespace=None)
+        source.close()
+        assert {r.namespace for r in root_only} == {""}
+        assert {r.namespace for r in everything} == {"", "tenant1"}
+    finally:
+        srv.stop()

@@ -22,6 +22,7 @@ from .cache_discipline import CacheDiscipline
 from .bounded_queue import BoundedQueueDiscipline
 from .index_discipline import IndexDiscipline
 from .delta_discipline import DeltaDiscipline
+from .sync_discipline import SyncDiscipline
 
 RULE_CLASSES = [
     NoSilentSwallow,
@@ -38,6 +39,7 @@ RULE_CLASSES = [
     BoundedQueueDiscipline,
     IndexDiscipline,
     DeltaDiscipline,
+    SyncDiscipline,
 ]
 
 

@@ -1,0 +1,72 @@
+"""sync-discipline — sync code negotiates membership in batches only.
+
+Invariant (pxar/syncwire.py + server/sync_job.py, docs/sync.md): the
+replication data plane decides what crosses the wire by probing the
+DESTINATION for whole digest batches — ``ChunkStore.probe_batch`` (one
+vectorized dedup-index pass) or ``ChunkStore.on_disk_many`` (the
+batched disk fallback for index-less stores).  Per-digest membership
+calls — ``has``/``contains``/``on_disk`` on a store or index, or
+filesystem probes (``os.path.exists``/``os.stat``) against chunk
+paths — pay one probe (and potentially one disk stat) per digest,
+exactly the cost the dedup index exists to eliminate, and at mirror
+scale they turn a one-round negotiation into millions of round trips.
+
+The rule flags, inside the sync modules only:
+
+- any call to a ``.has(...)`` / ``.contains(...)`` / ``.on_disk(...)``
+  attribute (the per-digest membership surface);
+- ``os.path.exists`` / ``os.stat`` / ``os.path.isfile`` / ``os.lstat``
+  whose argument mentions a chunk path marker (``.chunks`` /
+  ``._path(`` / ``chunk`` / ``digest``) — snapshot-dir and state-file
+  existence checks are not membership and stay legal.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+from ._util import call_name
+
+_SCOPES = ("pbs_plus_tpu/pxar/syncwire.py",
+           "pbs_plus_tpu/server/sync_job.py")
+_MEMBERSHIP_ATTRS = frozenset({"has", "contains", "on_disk"})
+_FS_PROBES = frozenset({
+    "os.path.exists", "os.path.lexists", "os.path.isfile",
+    "os.stat", "os.lstat",
+})
+_CHUNK_MARKERS = (".chunks", "._path(", "chunk", "digest")
+
+
+class SyncDiscipline(Rule):
+    name = "sync-discipline"
+    invariant = ("sync code negotiates chunk membership via batched "
+                 "probe_batch/on_disk_many calls — never per-digest "
+                 "has/contains/on_disk/exists loops")
+
+    def begin_file(self, ctx):
+        return ctx.path in _SCOPES
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute) and \
+                func.attr in _MEMBERSHIP_ATTRS:
+            ctx.report(self, node,
+                       f"per-digest `.{func.attr}(...)` membership call "
+                       "in sync code: one probe per digest turns the "
+                       "batched negotiation into per-chunk round trips "
+                       "— use ChunkStore.probe_batch / on_disk_many "
+                       "over the whole batch (docs/sync.md)")
+            return
+        if call_name(node) in _FS_PROBES and node.args:
+            try:
+                arg_src = ast.unparse(node.args[0])
+            except Exception:
+                return
+            low = arg_src.lower()
+            if any(m in low for m in _CHUNK_MARKERS):
+                ctx.report(self, node,
+                           f"`{call_name(node)}({arg_src})` probes chunk "
+                           "existence per digest in sync code — batch "
+                           "it through ChunkStore.probe_batch / "
+                           "on_disk_many (docs/sync.md)")
