@@ -199,7 +199,11 @@ class MuxConnection:
                  keepalive_s: float = 30.0,
                  write_deadline_s: float | None = None):
         self.reader = reader
-        self.writer = writer
+        # every frame write serializes on _wlock: two interleaved
+        # writer.write calls corrupt the mux framing for the whole
+        # connection (teardown is the one sanctioned exception — see
+        # the justified disables in _shutdown/close)
+        self.writer = writer                        # guarded-by: self._wlock
         self.is_client = is_client
         self._next_sid = 1 if is_client else 2
         self._streams: dict[int, MuxStream] = {}
@@ -398,7 +402,11 @@ class MuxConnection:
             if t is not asyncio.current_task():
                 t.cancel()
         try:
-            self.writer.close()
+            # teardown: closed=True above means no _send_frame will touch
+            # the transport again, and close() must not wait on _wlock (a
+            # writer blocked on a full socket may hold it past the
+            # deadline — the shed path would deadlock against itself)
+            self.writer.close()   # pbslint: disable=guarded-by
         except Exception as e:
             L.debug("transport close on dead conn: %s", e)
 
@@ -413,6 +421,8 @@ class MuxConnection:
                 except Exception as e:
                     L.debug("companion task died at close: %s", e)
         try:
-            await self.writer.wait_closed()
+            # teardown (see _shutdown): the conn is closed, companion
+            # tasks are awaited dead — nothing can race this wait
+            await self.writer.wait_closed()   # pbslint: disable=guarded-by
         except Exception as e:
             L.debug("transport wait_closed: %s", e)

@@ -11,6 +11,9 @@ async def main() -> None:
     from .operator import Operator, OperatorConfig
 
     async with aiohttp.ClientSession() as http:
+        # one-time startup read of the mounted serviceaccount token,
+        # before any request is served — the sanctioned startup case
+        # pbslint: disable=no-blocking-in-async-transitive
         kube = KubeClient.in_cluster(http)
         op = Operator(kube, OperatorConfig(
             server_url=os.environ["PBS_PLUS_SERVER_URL"],

@@ -47,7 +47,7 @@ _PREFETCH_QUEUE_CAP = 64        # advisory work only: shed, never queue deep
 # ONE prefetch pool per process, shared by every cache instance (a pool
 # per cache would leak 2 threads per open reader in a long-lived server)
 _pool_lock = threading.Lock()
-_pool: ThreadPoolExecutor | None = None
+_pool: ThreadPoolExecutor | None = None        # guarded-by: _pool_lock
 
 
 def _prefetch_pool() -> ThreadPoolExecutor:
@@ -69,15 +69,15 @@ class ChunkCache:
         self._lock = threading.Lock()
         # digest -> [data, prefetched_flag]; flag clears on first hit so
         # prefetch_used counts chunks a prefetch actually saved a load for
-        self._d: "OrderedDict[bytes, list]" = OrderedDict()
-        self._size = 0
+        self._d: "OrderedDict[bytes, list]" = OrderedDict()  # guarded-by: self._lock
+        self._size = 0                                 # guarded-by: self._lock
         self._flight = ThreadSingleFlight()
-        self._inflight_prefetch = 0
+        self._inflight_prefetch = 0                    # guarded-by: self._lock
         self.counters = {
             "hits": 0, "misses": 0, "evictions": 0,
             "prefetch_issued": 0, "prefetch_used": 0,
             "load_errors": 0,
-        }
+        }                                              # guarded-by: self._lock
 
     # -- core get ----------------------------------------------------------
     def get(self, store, digest: bytes, stats: dict | None = None) -> bytes:
@@ -290,7 +290,7 @@ class ReadaheadState:
 # -- process-shared cache ---------------------------------------------------
 
 _shared_lock = threading.Lock()
-_shared: ChunkCache | None = None
+_shared: ChunkCache | None = None              # guarded-by: _shared_lock
 
 
 def shared_cache() -> ChunkCache:

@@ -93,8 +93,9 @@ class IndexMetrics:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._c = dict.fromkeys(self._COUNTERS, 0)
-        self._indexes: "weakref.WeakSet[DedupIndex]" = weakref.WeakSet()
+        self._c = dict.fromkeys(self._COUNTERS, 0)     # guarded-by: self._lock
+        self._indexes: "weakref.WeakSet[DedupIndex]" = \
+            weakref.WeakSet()                          # guarded-by: self._lock
 
     def add(self, counter: str, n: int = 1) -> None:
         with self._lock:
@@ -133,10 +134,12 @@ class DedupIndex:
     def __init__(self, *, budget_mb: int = 64, seed: int = 0):
         from ..ops.cuckoo import CuckooIndex, buckets_for_bytes
         self._lock = threading.RLock()
-        self._cuckoo = CuckooIndex(
+        # the filter + exact set are ONE coherent unit under _lock: a
+        # probe against a half-swapped rebuild would answer wrongly
+        self._cuckoo = CuckooIndex(                 # guarded-by: self._lock
             n_buckets=buckets_for_bytes(max(1, int(budget_mb)) << 20),
             seed=seed)
-        self._datablob: set[bytes] = set()
+        self._datablob: set[bytes] = set()          # guarded-by: self._lock
         # boot state lives ON the index (not the owning store) so
         # stores SHARING one index — the server's per-job
         # chunker-override store — share one boot: whoever probes
@@ -169,22 +172,29 @@ class DedupIndex:
                 loader()
                 self._booted = True
 
-    # -- introspection -----------------------------------------------------
+    # -- introspection (the guarded-by sweep found all four of these
+    #    reading _cuckoo/_datablob lock-free while rebuild/load_snapshot
+    #    swap them out; _lock is an RLock, so re-entry from locked
+    #    callers stays cheap) ----------------------------------------------
     def __len__(self) -> int:
-        return len(self._cuckoo)
+        with self._lock:
+            return len(self._cuckoo)
 
     @property
     def n_buckets(self) -> int:
-        return self._cuckoo.n_buckets
+        with self._lock:
+            return self._cuckoo.n_buckets
 
     @property
     def table_bytes(self) -> int:
-        return self._cuckoo._table.nbytes
+        with self._lock:
+            return self._cuckoo._table.nbytes
 
     @property
     def resident_bytes(self) -> int:
-        return self.table_bytes + _SET_ENTRY_BYTES * (
-            len(self._cuckoo) + len(self._datablob))
+        with self._lock:
+            return self._cuckoo._table.nbytes + _SET_ENTRY_BYTES * (
+                len(self._cuckoo) + len(self._datablob))
 
     def digests(self) -> Iterator[bytes]:
         """Stable snapshot of the known digests (tests, persistence)."""

@@ -152,25 +152,34 @@ class ChunkStore:
         # and per-shard ownership (used only under the shard lock) is
         # what lets two sessions compress concurrently at all
         self._shard_cctx = [zstandard.ZstdCompressor(level=compression_level)
-                            for _ in range(self.n_shards)]
+                            for _ in range(self.n_shards)
+                            ]                  # guarded-by: self._shard_locks
         # reads happen concurrently (chunk-cache prefetch pool, parallel
         # verification workers) and a zstd decompressor is NOT
         # thread-safe — one per reading thread
         self._dctx_local = threading.local()
         # prefix dirs this process already created — skips the makedirs
-        # stat storm on the novel-insert hot path
-        self._made_dirs: set[str] = set()
+        # stat storm on the novel-insert hot path.  Shared across ALL
+        # shards (prefix dirs don't align with shard boundaries), so it
+        # needs its own lock: two inserts on different shards were
+        # mutating this set under different shard locks (the guarded-by
+        # sweep's catch — GIL-atomic in CPython today, but nothing in
+        # the store's thread_safe contract says so)
+        self._made_dirs_lock = threading.Lock()
+        self._made_dirs: set[str] = set()   # guarded-by: self._made_dirs_lock
         # legacy DataBlob memory for INDEX-LESS stores only: bounded,
         # evicts an arbitrary half at the cap (the old clear-everything
         # reset forgot every hot digest at once and re-ran the full
         # read+decompress upgrade probe for all of them).  With an index
         # attached this knowledge lives there, unbounded and exact.
-        self._datablob_seen: set[bytes] = set()
+        self._datablob_seen: set[bytes] = \
+            set()                           # guarded-by: self._datablob_lock
         self._datablob_seen_cap = 1 << 20
         # its own lock: inserts on DIFFERENT shards share this one set,
         # and the cap eviction iterates it — a per-shard lock alone
         # would let another shard's add() race the iteration
         self._datablob_lock = threading.Lock()
+        # (annotated below: _datablob_seen is only touched under it)
         index_explicit = index is not None
         if index is None:
             mb = (_conf.env().dedup_index_mb
@@ -202,7 +211,7 @@ class ChunkStore:
         # its chunk's; sweep: the victim's), a consistent order, and
         # never held across encode/IO-heavy work
         self._pin_lock = threading.Lock()
-        self._pinned_bases: dict[bytes, int] = {}
+        self._pinned_bases: dict[bytes, int] = {}   # guarded-by: self._pin_lock
         if delta_tier and blob_format != "pbs":
             from .similarityindex import SimilarityIndex
             self._sim = SimilarityIndex(
@@ -630,9 +639,15 @@ class ChunkStore:
     def _write_payload(self, p: str, payload: bytes) -> None:
         """tmp+rename an already-encoded on-disk payload into place."""
         d = os.path.dirname(p)
-        if d not in self._made_dirs:
+        with self._made_dirs_lock:
+            fresh = d not in self._made_dirs
+        if fresh:
+            # makedirs outside the lock (it can touch disk); exist_ok
+            # makes the lost race idempotent, and remembering after the
+            # fact only ever re-pays one makedirs
             os.makedirs(d, exist_ok=True)
-            self._made_dirs.add(d)
+            with self._made_dirs_lock:
+                self._made_dirs.add(d)
         tmp = f"{p}.tmp.{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "wb") as f:
             f.write(payload)

@@ -74,8 +74,9 @@ class SimilarityMetrics:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._c = dict.fromkeys(self._COUNTERS, 0)
-        self._indexes: "weakref.WeakSet[SimilarityIndex]" = weakref.WeakSet()
+        self._c = dict.fromkeys(self._COUNTERS, 0)     # guarded-by: self._lock
+        self._indexes: "weakref.WeakSet[SimilarityIndex]" = \
+            weakref.WeakSet()                          # guarded-by: self._lock
 
     def add(self, counter: str, n: int = 1) -> None:
         with self._lock:
@@ -134,15 +135,20 @@ class SimilarityIndex:
         self.max_entries = max(1, int(max_entries))
         self._lock = threading.RLock()
         # digest -> (sketch:int, depth:int); ordered for FIFO eviction
-        self._entries: "OrderedDict[bytes, tuple[int, int]]" = OrderedDict()
-        # (band, band_value) -> list of digests (capped)
-        self._bands: dict[tuple[int, int], list[bytes]] = {}
+        self._entries: "OrderedDict[bytes, tuple[int, int]]" = \
+            OrderedDict()                              # guarded-by: self._lock
+        # (band, band_value) -> list of digests (capped); must stay
+        # consistent with _entries — a band row pointing at a popped
+        # entry is a wasted candidate, the reverse is a lost base
+        self._bands: dict[tuple[int, int], list[bytes]] = \
+            {}                                         # guarded-by: self._lock
         # most recent insertions, scanned exactly on every probe
         # (module docstring: boundary-drift recall)
-        self._recent: "deque[bytes]" = deque(maxlen=_RECENT_WINDOW)
+        self._recent: "deque[bytes]" = \
+            deque(maxlen=_RECENT_WINDOW)               # guarded-by: self._lock
         # digest -> sketch precomputed by the batched presketch pass,
         # consumed by the per-chunk insert that follows
-        self._pending: dict[bytes, int] = {}
+        self._pending: dict[bytes, int] = {}           # guarded-by: self._lock
         METRICS.register(self)
 
     def __len__(self) -> int:

@@ -74,7 +74,14 @@ class JobsManager:
         self._queued = 0                      # enqueued, no exec slot yet
         self._tenant_running: dict[str, int] = {}
         self._active: dict[str, asyncio.Task] = {}
-        self._startup_mu = asyncio.Lock()      # reference: StartupMu
+        # reference: StartupMu.  Named into the lock-order vocabulary:
+        # callers acquire it through the `startup_mu` property, which
+        # the static resolver cannot see through — so every acquisition
+        # site carries the same `# pbslint: lock-order jobs.startup-mu`
+        # annotation (see server/store.py), and this declaration-site
+        # name keeps any direct `self._startup_mu` acquisition on the
+        # same graph node
+        self._startup_mu = asyncio.Lock()   # pbslint: lock-order jobs.startup-mu
         # per-key circuit breakers (keyed "agent:<target>" by the backup
         # path): a dead agent fails fast instead of burning the
         # scheduler's retry budget on every tick

@@ -586,7 +586,9 @@ class Server:
             from . import hooks
             while self._gc_active:         # never start mid-GC
                 await asyncio.sleep(0.5)
-            async with self.jobs.startup_mu:   # serialize session startups
+            # serialize session startups; property-reached lock, so the
+            # acquisition joins the static graph by its vocabulary name
+            async with self.jobs.startup_mu:   # pbslint: lock-order jobs.startup-mu
                 pass
             t0 = time.time()
             self.live_progress[row.id] = (t0, None)

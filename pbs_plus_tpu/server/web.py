@@ -1420,6 +1420,10 @@ def _build_agent_pyz(state_dir: str) -> str:
 async def start_web(server: "Server", *, host: str = "127.0.0.1",
                     port: int = 0, require_auth: bool = True,
                     ) -> tuple[web.AppRunner, int]:
+    # app construction loads the ticket key once, BEFORE the site
+    # accepts a single connection — the sanctioned startup-IO case of
+    # the blocking rule, not a per-request stall
+    # pbslint: disable=no-blocking-in-async-transitive
     app = build_app(server, require_auth=require_auth)
     runner = web.AppRunner(app)
     await runner.setup()

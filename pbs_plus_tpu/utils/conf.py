@@ -43,6 +43,49 @@ CA_ROTATION_GRACE_DAYS = 7
 CLIENT_RATE_LIMIT_PER_SEC = 10.0
 CLIENT_RATE_LIMIT_BURST = 20
 
+# --- env-var registry ------------------------------------------------------
+# The declaration of record for every PBS_PLUS_* environment knob the
+# product tree reads.  pbslint's whole-program `registry-consistency`
+# rule enforces closure in both directions: an env string referenced
+# anywhere under pbs_plus_tpu/ must be declared here AND documented in
+# docs/configuration.md, and every entry here must actually be read
+# somewhere.  Test/bench-only knobs (PBS_PLUS_FLEET, PBS_PLUS_SOAK,
+# PBS_PLUS_BENCH*) live outside the product tree and are documented in
+# the same table without being registered.
+ENV_VARS = {
+    "PBS_PLUS_DEBUG": "verbose debug logging (1/true/yes)",
+    "PBS_PLUS_HOSTNAME": "server identity override (default: uname)",
+    "PBS_PLUS_SERVER_URL": "server base URL handed to agents/operator",
+    "PBS_PLUS_STATE_DIR": "state directory (db, checkpoints, sync state)",
+    "PBS_PLUS_CERT_DIR": "certificate directory for the mTLS plane",
+    "PBS_PLUS_CHUNKER": "chunker kind: cpu | tpu",
+    "PBS_PLUS_CHUNKER_BACKEND": "CPU scan impl: scalar | vector",
+    "PBS_PLUS_SIDECAR_TIMEOUT": "dedup sidecar per-RPC deadline (s)",
+    "PBS_PLUS_CHECKPOINT_INTERVAL": "durable checkpoint cadence <N>c/<M>s",
+    "PBS_PLUS_CHUNK_CACHE_MB": "shared read-path chunk cache budget (MiB)",
+    "PBS_PLUS_CHUNK_READAHEAD": "chunks prefetched ahead of a scan",
+    "PBS_PLUS_DEDUP_INDEX_MB": "dedup-index cuckoo filter budget (MiB)",
+    "PBS_PLUS_STORE_SHARDS": "chunk store logical shard count",
+    "PBS_PLUS_DELTA_TIER": "enable the similarity-dedup delta tier",
+    "PBS_PLUS_DELTA_THRESHOLD": "max sketch Hamming distance for a base",
+    "PBS_PLUS_DELTA_MAX_CHAIN": "max delta-chain depth (base hops)",
+    "PBS_PLUS_AGENT_RATE": "per-client token bucket rate (req/s)",
+    "PBS_PLUS_AGENT_BURST": "per-client token bucket burst",
+    "PBS_PLUS_AGENT_OPEN_RATE": "global session-open rate (0 = off)",
+    "PBS_PLUS_AGENT_MAX_SESSIONS": "hard ceiling on registered sessions",
+    "PBS_PLUS_MUX_WRITE_DEADLINE": "mux slow-reader shed deadline (s)",
+    "PBS_PLUS_MAX_QUEUED_JOBS": "jobs-queue bound (QueueFullError past it)",
+    "PBS_PLUS_SYNC_BATCH": "digests per sync membership-negotiation batch",
+    "PBS_PLUS_FAILPOINTS": "arm failpoints at import (site=action@trig;…)",
+    "PBS_PLUS_LOCKWATCH": "runtime lock-order witness (utils/lockwatch.py)",
+    "PBS_PLUS_BOOTSTRAP_URL": "operator: agent bootstrap endpoint",
+    "PBS_PLUS_BOOTSTRAP_TOKEN": "operator: bootstrap bearer token",
+    "PBS_PLUS_AGENT_IMAGE": "operator: agent container image",
+    "PBS_PLUS_LEADER_ELECT": "operator: lease-based leader election (0=off)",
+    "PBS_PLUS_FEEDER_MESH": "models: multi-host feeder mesh (0=off)",
+    "PBS_PLUS_FEEDER_LINGER_S": "models: feeder linger before teardown (s)",
+}
+
 
 @dataclass(frozen=True)
 class Env:

@@ -14,12 +14,35 @@ The default pytest loop runs N=100; ``PBS_PLUS_FLEET=1`` raises the
 profile to the N=500 acceptance scale.
 """
 
+import contextlib
 import os
 
 from pbs_plus_tpu.server.fleetsim import (FleetConfig, run_fleet,
                                           synthetic_tree)
+from pbs_plus_tpu.utils import lockwatch
 
 N = 500 if os.environ.get("PBS_PLUS_FLEET") else 100
+
+
+@contextlib.contextmanager
+def _lock_witness():
+    """Runtime lock-order witness (docs/static-analysis.md "Lock
+    order"): every lock allocated during the run is wrapped, actual
+    acquisition edges are recorded, and the run must observe the same
+    no-cycle property the static pbslint pass proves — the dynamic
+    cross-check of the static graph.  On by default here (chaos is
+    exactly when ordering bugs interleave); PBS_PLUS_LOCKWATCH=0 opts
+    out, e.g. when profiling the sim itself."""
+    if os.environ.get(lockwatch.ENV_VAR, "1") == "0":
+        yield None
+        return
+    with lockwatch.watching() as watch:
+        yield watch
+    watch.assert_acyclic()
+    # the witness must have actually seen the data plane's locks, or
+    # the acyclicity assertion proves nothing
+    assert any("datastore.py" in a or "datastore.py" in b
+               for a, b in watch.edges()), watch.edges()
 
 
 def _cfg(**kw) -> FleetConfig:
@@ -55,7 +78,8 @@ def _snapshot_views(store, cns):
 
 def test_fleet_chaos_composition(tmp_path):
     cfg = _cfg(kill_fraction=0.10, kill_after_reads=2)
-    rep = run_fleet(str(tmp_path / "ds-chaos"), cfg)
+    with _lock_witness():
+        rep = run_fleet(str(tmp_path / "ds-chaos"), cfg)
     d = rep.to_dict()
 
     # -- the kill really happened at the configured scale ------------------
@@ -141,19 +165,21 @@ def test_fleet_chaos_gc_dedup_index_coherent(tmp_path):
 
     n = 20
     cfg = _cfg(n_agents=n, kill_fraction=0.10, kill_after_reads=2)
-    rep = run_fleet(str(tmp_path / "ds"), cfg)
-    assert rep.to_dict()["published"] == n, rep.failures
-    assert len(rep.killed) == max(1, int(n * cfg.kill_fraction))
+    with _lock_witness():
+        rep = run_fleet(str(tmp_path / "ds"), cfg)
+        assert rep.to_dict()["published"] == n, rep.failures
+        assert len(rep.killed) == max(1, int(n * cfg.kill_fraction))
 
-    store = LocalStore(str(tmp_path / "ds"),
-                       ChunkerParams(avg_size=cfg.chunk_avg),
-                       store_shards=8, dedup_index_mb=4)
-    ds = store.datastore
-    assert ds.chunks.index is not None
+        store = LocalStore(str(tmp_path / "ds"),
+                           ChunkerParams(avg_size=cfg.chunk_avg),
+                           store_shards=8, dedup_index_mb=4)
+        ds = store.datastore
+        assert ds.chunks.index is not None
 
-    # GC over the chaos-produced store: mark (shard-parallel touch_many)
-    # + sweep; keep-all policy, so only unreferenced debris may go
-    run_prune(ds, PrunePolicy(), gc=True, gc_grace_s=0)
+        # GC over the chaos-produced store: mark (shard-parallel
+        # touch_many) + sweep under the witness too — GC vs writer is
+        # where the shard/pin/index lock ordering actually interleaves
+        run_prune(ds, PrunePolicy(), gc=True, gc_grace_s=0)
 
     # filter <-> disk coherence, both directions
     disk = set(ds.chunks.iter_digests())

@@ -1,0 +1,175 @@
+"""utils/lockwatch.py battery: the runtime lock-order witness that
+cross-checks pbslint's static `lock-order` pass (docs/static-analysis.md
+"The runtime witness").  Edge recording, RLock reentrancy, cycle
+detection, the factory monkeypatch lifecycle, and Condition interplay."""
+
+import threading
+
+import pytest
+
+from pbs_plus_tpu.utils import lockwatch
+
+
+def test_nested_acquisition_records_edge():
+    w = lockwatch.LockWatch()
+    a = lockwatch.wrap(threading.Lock(), "A", w)
+    b = lockwatch.wrap(threading.Lock(), "B", w)
+    with a:
+        with b:
+            pass
+    assert w.edges() == {("A", "B"): 1}
+    assert w.find_cycle() is None
+    w.assert_acyclic()
+
+
+def test_opposite_orders_form_cycle():
+    w = lockwatch.LockWatch()
+    a = lockwatch.wrap(threading.Lock(), "A", w)
+    b = lockwatch.wrap(threading.Lock(), "B", w)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    cycle = w.find_cycle()
+    assert cycle is not None and set(cycle) == {"A", "B"}
+    with pytest.raises(AssertionError, match="lock-order cycle"):
+        w.assert_acyclic()
+
+
+def test_rlock_reentry_records_no_self_edge():
+    w = lockwatch.LockWatch()
+    r = lockwatch.wrap(threading.RLock(), "R", w, reentrant=True)
+    with r:
+        with r:                  # direct re-entry
+            pass
+    b = lockwatch.wrap(threading.Lock(), "B", w)
+    with r:
+        with b:
+            with r:              # re-entry with another lock between:
+                pass             # must NOT record B->R (cannot deadlock)
+    assert ("R", "R") not in w.edges()
+    assert ("B", "R") not in w.edges()
+    assert w.edges() == {("R", "B"): 1}
+
+
+def test_release_out_of_order_keeps_stack_honest():
+    w = lockwatch.LockWatch()
+    a = lockwatch.wrap(threading.Lock(), "A", w)
+    b = lockwatch.wrap(threading.Lock(), "B", w)
+    a.acquire()
+    b.acquire()
+    a.release()                  # released under b: not LIFO
+    c = lockwatch.wrap(threading.Lock(), "C", w)
+    with c:
+        pass
+    b.release()
+    assert ("A", "C") not in w.edges()
+    assert w.edges() == {("A", "B"): 1, ("B", "C"): 1}
+
+
+def test_edges_recorded_across_threads():
+    w = lockwatch.LockWatch()
+    a = lockwatch.wrap(threading.Lock(), "A", w)
+    b = lockwatch.wrap(threading.Lock(), "B", w)
+
+    def other():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=other, daemon=True)
+    t.start()
+    t.join()
+    with a:
+        with b:
+            pass
+    assert w.find_cycle() is not None
+
+
+def test_install_wraps_new_locks_and_uninstall_restores():
+    real = threading.Lock
+    with lockwatch.watching() as w:
+        lk = threading.Lock()
+        assert isinstance(lk, lockwatch._WatchedLock)
+        inner = lockwatch.wrap(threading.RLock(), "X", w, reentrant=True)
+        with lk:
+            with inner:
+                pass
+        # allocation-site naming: this test file, repo-relative
+        assert any("test_lockwatch.py" in aa or "test_lockwatch.py" in bb
+                   for aa, bb in w.edges())
+    assert threading.Lock is real
+    # locks created while watching keep working after uninstall
+    with lk:
+        pass
+
+
+def test_install_nests_and_joins_active_watch():
+    try:
+        w1 = lockwatch.install()
+        w2 = lockwatch.install()       # nested: joins, bumps the depth
+        assert w1 is w2
+        lockwatch.uninstall()          # inner release: still installed
+        assert threading.Lock is not lockwatch._REAL_LOCK
+    finally:
+        lockwatch.uninstall()
+    assert threading.Lock is lockwatch._REAL_LOCK
+    lockwatch.uninstall()              # over-release: harmless no-op
+    assert threading.Lock is lockwatch._REAL_LOCK
+
+
+def test_condition_over_watched_rlock():
+    """Condition.wait goes through _release_save/_acquire_restore; the
+    held stack must balance across the wait window."""
+    with lockwatch.watching() as w:
+        cv = threading.Condition()          # default RLock: wrapped
+        fired = []
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=5)
+                fired.append(True)
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        import time
+        time.sleep(0.05)
+        with cv:
+            cv.notify_all()
+        t.join(timeout=5)
+        assert fired == [True]
+        w.assert_acyclic()
+    # no thread believes it still holds anything
+    assert w._stack() == []
+
+
+def test_enabled_env_parse(monkeypatch):
+    monkeypatch.delenv(lockwatch.ENV_VAR, raising=False)
+    assert not lockwatch.enabled()
+    monkeypatch.setenv(lockwatch.ENV_VAR, "1")
+    assert lockwatch.enabled()
+    monkeypatch.setenv(lockwatch.ENV_VAR, "0")
+    assert not lockwatch.enabled()
+
+
+def test_nested_watching_keeps_outer_installed():
+    """An inner watching() block must not un-witness the rest of the
+    outer one (install nests; only the outermost uninstall restores)."""
+    with lockwatch.watching() as outer:
+        with lockwatch.watching() as inner:
+            assert inner is outer          # joins the active watch
+        lk = threading.Lock()              # allocated AFTER inner exit
+        assert isinstance(lk, lockwatch._WatchedLock)
+    assert threading.Lock is lockwatch._REAL_LOCK
+
+
+def test_install_rejects_conflicting_watch():
+    try:
+        lockwatch.install()
+        with pytest.raises(RuntimeError, match="different watch"):
+            lockwatch.install(lockwatch.LockWatch())
+    finally:
+        lockwatch.uninstall()
+    assert threading.Lock is lockwatch._REAL_LOCK

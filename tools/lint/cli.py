@@ -1,13 +1,25 @@
 """pbslint command line.
 
     python -m tools.lint [paths ...]          lint (default: pbs_plus_tpu)
-    python -m tools.lint --json               machine-readable output
+    python -m tools.lint --format json        machine-readable (alias --json)
+    python -m tools.lint --format sarif       SARIF 2.1.0 (CI annotations)
+    python -m tools.lint --changed-only       findings filtered to files
+                                              changed vs git HEAD (the
+                                              symbol graph stays whole-
+                                              program)
     python -m tools.lint --list-rules         show every rule + invariant
     python -m tools.lint --write-baseline     ratchet the baseline DOWN
     python -m tools.lint --write-baseline --force   seed/defer (reviewed!)
+    python -m tools.lint --prune-baseline     drop baseline entries whose
+                                              file no longer exists
 
-Exit codes: 0 clean (or fully baselined), 1 new violations or
-unparseable files, 2 usage/internal error.
+Per-file rules walk each AST once; the interprocedural rules
+(guarded-by, lock-order, no-blocking-in-async-transitive,
+registry-consistency) run over the whole-program symbol graph built by
+tools/lint/graph.py — cached by file content hash under build/pbslint/.
+
+Exit codes: 0 clean (or fully baselined), 1 new violations, unparseable
+files, or orphaned baseline entries, 2 usage/internal error.
 """
 
 from __future__ import annotations
@@ -15,13 +27,17 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from .baseline import Baseline
 from .core import REPO_ROOT, lint_paths
-from .rules import build_rules
+from .graph import build_program
+from .rules import (build_program_rules, build_rules, program_rule_names,
+                    rule_names)
 
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "lint_baseline.json")
+DEFAULT_ROOT = os.path.join(REPO_ROOT, "pbs_plus_tpu")
 
 
 def _resolve_paths(paths: list[str]) -> list[str]:
@@ -37,6 +53,57 @@ def _resolve_paths(paths: list[str]) -> list[str]:
     return out
 
 
+def _git_changed() -> "set[str] | None":
+    """Repo-relative posix paths changed vs HEAD (tracked diff +
+    untracked), or None when git state is unreadable."""
+    try:
+        diff = subprocess.run(
+            ["git", "-C", REPO_ROOT, "diff", "--name-only", "HEAD", "--"],
+            capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "-C", REPO_ROOT, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if diff.returncode != 0 or untracked.returncode != 0:
+        return None
+    return {ln.strip() for ln in
+            (diff.stdout + untracked.stdout).splitlines() if ln.strip()}
+
+
+def _sarif(new, errors) -> dict:
+    """Minimal SARIF 2.1.0: one run, one result per new violation."""
+    by_rule: dict[str, str] = {}
+    for v in new:
+        by_rule.setdefault(v.rule, v.message)
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "pbslint",
+                "informationUri":
+                    "docs/static-analysis.md",
+                "rules": [{"id": r} for r in sorted(by_rule)],
+            }},
+            "results": [{
+                "ruleId": v.rule,
+                "level": "error",
+                "message": {"text": v.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": v.path},
+                    "region": {"startLine": v.line},
+                }}],
+            } for v in new] + [{
+                "ruleId": "parse-error",
+                "level": "error",
+                "message": {"text": e},
+            } for e in errors],
+        }],
+    }
+
+
 def main(argv: "list[str] | None" = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.lint",
@@ -45,7 +112,14 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/dirs to lint (default: pbs_plus_tpu)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="JSON output")
+                    help="alias for --format json")
+    ap.add_argument("--format", default="text", dest="fmt",
+                    choices=("text", "json", "sarif"),
+                    help="output format (default: text)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="filter findings to files changed vs git HEAD "
+                         "(graph + per-file analysis still run whole-"
+                         "program)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline file (default: tools/lint_baseline.json)")
     ap.add_argument("--no-baseline", action="store_true",
@@ -55,25 +129,58 @@ def main(argv: "list[str] | None" = None) -> int:
                          "(refuses to grow any bucket unless --force)")
     ap.add_argument("--force", action="store_true",
                     help="allow --write-baseline to grow buckets")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="rewrite the baseline dropping entries whose "
+                         "file no longer exists (rename escape hatch)")
     ap.add_argument("--rules", default=None,
                     help="comma-separated subset of rules to run")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore the symbol-graph cache")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
+    if args.as_json:
+        args.fmt = "json"
 
     if args.list_rules:
         for r in build_rules():
-            print(f"{r.name:26s} {r.invariant}")
+            print(f"{r.name:34s} {r.invariant}")
+        for r in build_program_rules():
+            print(f"{r.name:34s} [whole-program] {r.invariant}")
         return 0
 
     try:
         only = set(args.rules.split(",")) if args.rules else None
         rules = build_rules(only)
+        program_rules = build_program_rules(only)
         paths = _resolve_paths(args.paths or ["pbs_plus_tpu"])
     except (ValueError, FileNotFoundError) as e:
         print(f"pbslint: {e}", file=sys.stderr)
         return 2
 
     result = lint_paths(paths, rules)
+
+    # -- whole-program pass ------------------------------------------------
+    if program_rules:
+        graph_paths = list(paths)
+        if os.path.isdir(DEFAULT_ROOT) and any(
+                os.path.abspath(p).startswith(DEFAULT_ROOT)
+                for p in paths):
+            # a subset under the product tree still links against the
+            # WHOLE tree — interprocedural facts don't respect path
+            # subsets; findings are filtered back to the request below
+            graph_paths = [DEFAULT_ROOT] + [
+                p for p in paths
+                if not os.path.abspath(p).startswith(DEFAULT_ROOT)]
+        program, graph_errors = build_program(
+            graph_paths, use_cache=not args.no_cache)
+        result.errors.extend(e for e in graph_errors
+                             if e not in result.errors)
+        in_scope = set(result.paths)
+        for rule in program_rules:
+            for v in rule.analyze(program):
+                if v.path in in_scope:
+                    result.violations.append(v)
+        result.violations.sort(key=lambda v: (v.path, v.line, v.rule))
 
     if args.write_baseline:
         if result.errors:
@@ -95,7 +202,8 @@ def main(argv: "list[str] | None" = None) -> int:
         # rules) are replaced — a subset run must not delete deferral
         # state for everything it never linted
         linted = set(result.paths)
-        active_rules = {r.name for r in rules}
+        active_rules = {r.name for r in rules} | \
+            {r.name for r in program_rules}
         merged = {k: n for k, n in old.entries.items()
                   if not (k.split("::", 1)[0] in linted
                           and k.split("::", 1)[1] in active_rules)}
@@ -125,32 +233,72 @@ def main(argv: "list[str] | None" = None) -> int:
         except (ValueError, json.JSONDecodeError) as e:
             print(f"pbslint: bad baseline: {e}", file=sys.stderr)
             return 2
-    diff = baseline.compare(result.violations)
 
-    if args.as_json:
+    # -- orphaned baseline entries (the rename gap) ------------------------
+    # a file rename silently orphans its path::rule buckets: the old
+    # path never lints again, so its deferrals linger forever and the
+    # renamed file starts from zero.  Fail loudly; --prune-baseline is
+    # the reviewed escape hatch.
+    orphans = sorted(k for k in baseline.entries
+                     if not os.path.exists(
+                         os.path.join(REPO_ROOT, k.split("::", 1)[0])))
+    if orphans and args.prune_baseline:
+        for k in orphans:
+            del baseline.entries[k]
+        baseline.save(args.baseline)
+        print(f"pbslint: pruned {len(orphans)} orphaned baseline "
+              f"bucket(s): {', '.join(orphans)}")
+        orphans = []
+
+    diff = baseline.compare(result.violations)
+    new = diff.new
+    changed: "set[str] | None" = None
+    if args.changed_only:
+        changed = _git_changed()
+        if changed is None:
+            print("pbslint: --changed-only needs a readable git repo",
+                  file=sys.stderr)
+            return 2
+        new = [v for v in new if v.path in changed]
+
+    ok = not new and not result.errors and not orphans
+
+    if args.fmt == "sarif":
+        print(json.dumps(_sarif(new, result.errors), indent=2))
+    elif args.fmt == "json":
         print(json.dumps({
             "files": result.files,
             "errors": result.errors,
             "violations": [vars(v) for v in result.violations],
-            "new": [vars(v) for v in diff.new],
+            "new": [vars(v) for v in new],
             "baselined": diff.baselined,
             "stale_baseline": diff.stale,
-            "ok": diff.ok and not result.errors,
+            "orphaned_baseline": orphans,
+            "changed_only": sorted(changed) if changed is not None
+            else None,
+            "ok": ok,
         }, indent=2))
     else:
         for err in result.errors:
             print(f"PARSE ERROR {err}")
-        for v in diff.new:
+        for v in new:
             print(v)
         n_total = len(result.violations)
+        scope = " (changed files only)" if args.changed_only else ""
         print(f"pbslint: {result.files} files, {n_total} violation(s): "
-              f"{len(diff.new)} new, {diff.baselined} baselined")
-        if diff.stale:
+              f"{len(new)} new{scope}, {diff.baselined} baselined")
+        if orphans:
+            print("pbslint: baseline entries reference files that no "
+                  "longer exist (renamed?) — re-home or "
+                  "`--prune-baseline`:")
+            for k in orphans:
+                print(f"  {k}")
+        if diff.stale and not args.changed_only:
             print("pbslint: baseline is stale (violations fixed — run "
                   "--write-baseline to ratchet down):")
             for k, n in sorted(diff.stale.items()):
                 print(f"  {k}: {n} fewer than baselined")
-    return 0 if diff.ok and not result.errors else 1
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

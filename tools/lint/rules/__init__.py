@@ -10,6 +10,10 @@ from __future__ import annotations
 
 from .swallow import NoSilentSwallow
 from .async_blocking import NoBlockingInAsync
+from .async_transitive import TransitiveBlockingInAsync
+from .guarded_by import GuardedBy
+from .lock_order import LockOrder
+from .registry_consistency import RegistryConsistency
 from .store_discipline import LockedStoreDiscipline
 from .jit_purity import JitPurity
 from .hostsync import NoHostSyncInHotLoop
@@ -43,10 +47,30 @@ RULE_CLASSES = [
 ]
 
 
+# whole-program rules: one analyze() over the linked symbol graph
+# (tools/lint/graph.py) instead of per-node callbacks
+PROGRAM_RULE_CLASSES = [
+    GuardedBy,
+    LockOrder,
+    TransitiveBlockingInAsync,
+    RegistryConsistency,
+]
+
+
 def build_rules(only: "set[str] | None" = None):
     rules = [cls() for cls in RULE_CLASSES]
     if only is not None:
-        unknown = only - {r.name for r in rules}
+        unknown = only - {r.name for r in rules} - set(program_rule_names())
+        if unknown:
+            raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+        rules = [r for r in rules if r.name in only]
+    return rules
+
+
+def build_program_rules(only: "set[str] | None" = None):
+    rules = [cls() for cls in PROGRAM_RULE_CLASSES]
+    if only is not None:
+        unknown = only - {r.name for r in rules} - set(rule_names())
         if unknown:
             raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
         rules = [r for r in rules if r.name in only]
@@ -55,3 +79,7 @@ def build_rules(only: "set[str] | None" = None):
 
 def rule_names() -> list[str]:
     return [cls.name for cls in RULE_CLASSES]
+
+
+def program_rule_names() -> list[str]:
+    return [cls.name for cls in PROGRAM_RULE_CLASSES]

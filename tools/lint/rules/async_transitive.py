@@ -1,0 +1,101 @@
+"""no-blocking-in-async-transitive — the event loop is stalled just as
+hard three frames down.
+
+Invariant: the per-file ``no-blocking-in-async`` rule sees a blocking
+primitive called DIRECTLY inside ``async def``; this pass lifts the
+check through the resolved call graph, so an async handler that calls a
+sync helper that calls a helper that calls ``time.sleep`` is flagged at
+the handler, with the full call chain in the message.  Only sync→sync
+edges propagate: an async callee is responsible for its own body (it
+gets its own finding), and a function reference passed to
+``asyncio.to_thread`` / ``run_in_executor`` never becomes a call edge
+(references aren't calls), so the sanctioned escape hatches are clean by
+construction.  Direct (depth-0) calls are left to the per-file rule —
+this one reports chains of length ≥ 1 exactly once per
+(handler, primitive) pair, at the first hop.
+"""
+
+from __future__ import annotations
+
+from ..graph import Program, ProgramRule
+from .async_blocking import _BLOCKING_CALLS, _FILE_IO_PREFIXES
+
+
+class TransitiveBlockingInAsync(ProgramRule):
+    name = "no-blocking-in-async-transitive"
+    invariant = ("async defs must not reach blocking primitives through "
+                 "any chain of sync calls in the resolved call graph")
+
+    def _direct_blocking(self, program: Program,
+                         fid: str) -> "list[tuple[str, int]]":
+        fn = program.funcs[fid]
+        path = fid.split("::")[0]
+        out = []
+        for name, line, _held in fn["calls"]:
+            if name in _BLOCKING_CALLS:
+                out.append((name, line))
+            elif name == "open" and path.startswith(_FILE_IO_PREFIXES):
+                out.append(("open", line))
+        return out
+
+    def analyze(self, program: Program):
+        out = []
+        # Block*(f) over SYNC functions: primitives reachable from f
+        # through sync calls (including f's own direct ones)
+        block: dict[str, set] = {}
+        for fid, fn in program.funcs.items():
+            if fn["is_async"]:
+                continue
+            block[fid] = {p for p, _ in self._direct_blocking(program, fid)}
+        changed = True
+        while changed:
+            changed = False
+            for fid in block:
+                mine = block[fid]
+                before = len(mine)
+                for callee, _line, _held in program.calls.get(fid, ()):
+                    mine |= block.get(callee, set())
+                if len(mine) != before:
+                    changed = True
+
+        for fid, fn in program.funcs.items():
+            if not fn["is_async"]:
+                continue
+            path = fid.split("::")[0]
+            reported: set[str] = set()
+            for callee, line, _held in program.calls.get(fid, ()):
+                prims = block.get(callee, set())
+                if not prims:
+                    continue
+                for prim in sorted(prims):
+                    if prim in reported:
+                        continue
+                    reported.add(prim)
+                    chain = self._chain(program, block, callee, prim)
+                    program.report(
+                        out, self, path, line,
+                        f"async `{fid.split('::')[1]}` reaches blocking "
+                        f"`{prim}` via "
+                        + " -> ".join(c.split("::")[1] for c in chain)
+                        + f" -> {prim}; route through asyncio.to_thread "
+                          "or an async equivalent at the boundary")
+        return out
+
+    def _chain(self, program: Program, block: dict, start: str,
+               prim: str) -> "list[str]":
+        """Shortest sync call chain from ``start`` to a direct call of
+        ``prim`` (BFS over edges that still carry the primitive)."""
+        from collections import deque
+        q = deque([(start, [start])])
+        seen = {start}
+        while q:
+            fid, path = q.popleft()
+            if any(p == prim
+                   for p, _ in self._direct_blocking(program, fid)):
+                return path
+            for callee, _line, _held in program.calls.get(fid, ()):
+                if callee in seen or prim not in block.get(callee, set()):
+                    continue
+                seen.add(callee)
+                q.append((callee, path + [callee]))
+        return [start]

@@ -1,0 +1,178 @@
+"""guarded-by — declared lock discipline for shared mutable state.
+
+Invariant: an attribute annotated ``# guarded-by: self._lock`` on its
+declaring assignment (or a module global annotated ``# guarded-by:
+_lock``) is only ever read or written (a) lexically inside a ``with``
+acquiring that lock, (b) inside a method reachable ONLY from such
+blocks (the whole-program call graph proves every resolved call site
+holds the lock, transitively), or (c) inside the declaring class's
+``__init__`` / at module top level — construction happens-before
+publication.  Everything else is a data race the annotation exists to
+make a lint failure instead of a reviewer's catch.
+
+Lock identity is CANONICAL, not textual: a held ``self._lock`` in some
+other class does not satisfy a guard declared against this class's
+``self._lock`` — both sides resolve through the program's lock
+namespace (``path::Class._attr`` / ``path::_global``, or the
+``# pbslint: lock-order`` vocabulary name) before comparison.  The lock
+expression matches after stripping subscripts, so ``# guarded-by:
+self._shard_locks`` is satisfied by any ``with self._shard_locks[i]:``
+— the discipline is "some shard lock held", the class-level abstraction
+the lock-order pass uses too.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..graph import Program, ProgramRule
+
+
+def _norm(expr: str) -> str:
+    return re.sub(r"\[.*\]", "", expr)
+
+
+class GuardedBy(ProgramRule):
+    name = "guarded-by"
+    invariant = ("attributes declared `# guarded-by: <lock>` are only "
+                 "touched under that lock (lexically, or in methods the "
+                 "call graph proves are only reached with it held)")
+
+    def analyze(self, program: Program):
+        out = []
+        # the only-reached-guarded fixpoint depends only on the lock
+        # identity — memoize across the annotation sweep
+        self._safe_cache: dict = {}
+        for s in program.files.values():
+            for cls_name, cls in s.classes.items():
+                for attr, lock in cls["guarded"].items():
+                    self._check_class_attr(program, s, cls_name, attr,
+                                           _norm(lock), out)
+            for gname, lock in s.module_guarded.items():
+                self._check_global(program, s, gname, _norm(lock), out)
+        return out
+
+    # -- lock identity -----------------------------------------------------
+    def _lock_id(self, program: Program, s, cls_name: "str | None",
+                 lock_raw: str) -> "str | None":
+        """Canonical identity of the annotation's lock expression,
+        resolved in the declaring context (class attr chain or module
+        lock global); None when unresolvable."""
+        qual = f"{cls_name}.__guard__" if cls_name else "__guard__"
+        resolved = program.canon_lock(s, qual, lock_raw)
+        return resolved[0] if resolved else None
+
+    def _satisfied(self, program: Program, holder_s, holder_qual: str,
+                   held, lock_raw: str, lock_id: "str | None",
+                   declaring_path: str) -> bool:
+        """Does a held-entry list satisfy the guard?  Canonical
+        comparison when the lock resolves; else a raw structural match
+        confined to the declaring file (cross-file text coincidence is
+        exactly the false negative to avoid)."""
+        for entry in held:
+            raw, vocab = entry[0], entry[1]
+            if lock_id is not None:
+                if vocab and vocab == lock_id:
+                    return True
+                if raw:
+                    c = program.canon_lock(holder_s, holder_qual, raw)
+                    if c is not None and c[0] == lock_id:
+                        return True
+            elif raw and holder_s.path == declaring_path and \
+                    _norm(raw) == lock_raw:
+                return True
+        return False
+
+    # -- class attributes --------------------------------------------------
+    def _check_class_attr(self, program: Program, s, cls_name: str,
+                          attr: str, lock: str, out) -> None:
+        lock_id = self._lock_id(program, s, cls_name, lock)
+        unguarded_methods = {}  # fid -> first unguarded access (line, kind)
+        for qual, fn in s.functions.items():
+            if (fn["cls"] or qual.split(".")[0]) != cls_name:
+                continue
+            if qual.split(".")[-1] == "__init__":
+                continue            # happens-before publication
+            for kind, bucket in (("read", "reads"), ("write", "writes")):
+                for name, line, held in fn[bucket]:
+                    if name != attr:
+                        continue
+                    if not self._satisfied(program, s, qual, held, lock,
+                                           lock_id, s.path):
+                        unguarded_methods.setdefault(
+                            f"{s.path}::{qual}", (line, kind))
+        if not unguarded_methods:
+            return
+        safe = self._only_reached_guarded(
+            program, lock, lock_id, s.path) & set(unguarded_methods)
+        for fid, (line, kind) in sorted(unguarded_methods.items()):
+            if fid in safe:
+                continue
+            program.report(
+                out, self, s.path, line,
+                f"{kind} of `self.{attr}` (guarded-by {lock}) outside "
+                f"`with {lock}` — and `{fid.split('::')[1]}` is not "
+                f"provably reached only from holders of {lock}")
+
+    def _only_reached_guarded(self, program: Program, lock: str,
+                              lock_id: "str | None",
+                              declaring_path: str) -> "set[str]":
+        """Every function that only ever runs with the lock held: it has
+        at least one resolved call site and every site either lexically
+        holds the lock or sits in a safe caller.  A function with NO
+        resolved call sites is an entry point — never safe (its real
+        callers are unknown).  Optimistic fixpoint: start with
+        everything safe, demote until stable.  Memoized per lock."""
+        key = lock_id or f"{declaring_path}::{lock}"
+        cached = self._safe_cache.get(key)
+        if cached is not None:
+            return cached
+        safe: set[str] = set(program.funcs)
+        changed = True
+        while changed:
+            changed = False
+            for fid in list(safe):
+                sites = program.callers.get(fid, [])
+                ok = bool(sites)
+                for caller, _line, held in sites:
+                    cs = program.func_file[caller]
+                    cqual = caller.split("::")[1]
+                    if self._satisfied(program, cs, cqual, held, lock,
+                                       lock_id, declaring_path):
+                        continue
+                    if caller in safe and caller != fid:
+                        continue
+                    ok = False
+                    break
+                if not ok:
+                    safe.discard(fid)
+                    changed = True
+        self._safe_cache[key] = safe
+        return safe
+
+    # -- module globals ----------------------------------------------------
+    def _check_global(self, program: Program, s, gname: str,
+                      lock: str, out) -> None:
+        lock_id = self._lock_id(program, s, None, lock)
+        unguarded = {}
+        for qual, fn in s.functions.items():
+            for kind, bucket in (("read", "greads"), ("write", "gwrites")):
+                for name, line, held in fn[bucket]:
+                    if name != gname:
+                        continue
+                    if not self._satisfied(program, s, qual, held, lock,
+                                           lock_id, s.path):
+                        unguarded.setdefault(
+                            f"{s.path}::{qual}", (line, kind))
+        if not unguarded:
+            return
+        safe = self._only_reached_guarded(
+            program, lock, lock_id, s.path) & set(unguarded)
+        for fid, (line, kind) in sorted(unguarded.items()):
+            if fid in safe:
+                continue
+            program.report(
+                out, self, s.path, line,
+                f"{kind} of module global `{gname}` (guarded-by {lock}) "
+                f"outside `with {lock}` in `{fid.split('::')[1]}`, which "
+                f"is not provably reached only from holders of {lock}")

@@ -1,0 +1,165 @@
+"""lock-order — the static lock-acquisition graph must be acyclic.
+
+Invariant: whenever lock B is acquired while lock A is held — lexically
+nested ``with`` blocks, or A held across a call whose callee
+(transitively, through the resolved call graph) acquires B — the edge
+A→B joins the program-wide acquisition graph.  Any cycle in that graph
+is a deadlock waiting for the right interleaving and fails the lint,
+with the full cycle and one witness site per edge in the message.
+
+Lock identity is class-level (``pxar/datastore.py::ChunkStore._pin_lock``)
+— two instances of one class share the discipline, and a per-shard lock
+list collapses to its attribute (so nesting two shard locks is itself a
+cycle: the discipline is "never nest shard locks").  A reentrant lock
+(``threading.RLock``) may self-nest; a plain lock acquiring itself is
+reported as a one-node cycle.
+
+Locks the resolver cannot name (an attribute on a non-self object, a
+parameter) participate only when annotated: ``# pbslint: lock-order
+<name>`` on the ``with`` line names that acquisition; the same comment
+on a lock's declaring assignment renames it everywhere (useful to unify
+one shared object exposed through two classes).
+"""
+
+from __future__ import annotations
+
+from ..graph import Program, ProgramRule
+
+
+class LockOrder(ProgramRule):
+    name = "lock-order"
+    invariant = ("the whole-program lock acquisition graph (lock held "
+                 "while acquiring another, resolved through the call "
+                 "graph) contains no cycle")
+
+    def analyze(self, program: Program):
+        out = []
+        # 1. canonicalize every acquisition event per function
+        #    acq[fid] = [(canon, kind, line, [held canon...])]
+        acq: dict[str, list] = {}
+        for s in program.files.values():
+            for qual, fn in s.functions.items():
+                fid = f"{s.path}::{qual}"
+                events = []
+                for raw, line, held, vocab in fn["acquires"]:
+                    canon = self._canon(program, s, qual, raw, vocab)
+                    if canon is None:
+                        continue
+                    held_c = self._canon_held(program, s, qual, held)
+                    events.append((canon[0], canon[1], line, held_c))
+                if events:
+                    acq[fid] = events
+
+        # 2. reachable-acquisition fixpoint: Acq*(f) = locks f may
+        #    acquire directly or via any resolved callee
+        reach: dict[str, set] = {
+            fid: {e[0] for e in events} for fid, events in acq.items()}
+        for fid in program.funcs:
+            reach.setdefault(fid, set())
+        changed = True
+        while changed:
+            changed = False
+            for fid, callees in program.calls.items():
+                mine = reach[fid]
+                before = len(mine)
+                for callee, _line, _held in callees:
+                    mine |= reach.get(callee, set())
+                if len(mine) != before:
+                    changed = True
+
+        # 3. edges: (a) lexical nesting, (b) held-across-call into Acq*
+        edges: dict[tuple, tuple] = {}      # (A, B) -> witness (path,line)
+        kinds: dict[str, str] = {}
+        for fid, events in acq.items():
+            path = fid.split("::")[0]
+            for canon, kind, line, held in events:
+                kinds[canon] = kind
+                for h in held:
+                    edges.setdefault((h, canon), (path, line))
+        for fid, callees in program.calls.items():
+            path = fid.split("::")[0]
+            for callee, line, held in callees:
+                if not held:
+                    continue
+                s = program.func_file[fid]
+                qual = fid.split("::")[1]
+                held_c = self._canon_held(program, s, qual, held)
+                for target in reach.get(callee, ()):
+                    for h in held_c:
+                        edges.setdefault((h, target), (path, line))
+
+        # 4. self-edges (plain locks only) and cycles
+        graph: dict[str, set] = {}
+        for (a, b), (path, line) in sorted(edges.items()):
+            if a == b:
+                if kinds.get(a) == "rlock":
+                    continue                    # reentrant: legal
+                program.report(
+                    out, self, path, line,
+                    f"non-reentrant lock {a} acquired while already "
+                    "held (self-deadlock; use RLock only if re-entry "
+                    "is genuinely intended)")
+                continue
+            graph.setdefault(a, set()).add(b)
+        cycle = self._find_cycle(graph)
+        if cycle is not None:
+            arrows = " -> ".join(cycle + [cycle[0]])
+            sites = "; ".join(
+                "{}->{} at {}:{}".format(
+                    cycle[i], cycle[(i + 1) % len(cycle)],
+                    *edges[(cycle[i], cycle[(i + 1) % len(cycle)])])
+                for i in range(len(cycle)))
+            path, line = edges[(cycle[0], cycle[1 % len(cycle)])]
+            program.report(
+                out, self, path, line,
+                f"lock-order cycle: {arrows} ({sites}) — pick one "
+                "canonical order (docs/data-plane.md \"Lock order\") "
+                "and restructure the odd edge out")
+        return out
+
+    def _canon(self, program: Program, s, qual: str, raw: str,
+               vocab: "str | None"):
+        if vocab:
+            return vocab, "lock"
+        if not raw:
+            return None
+        return program.canon_lock(s, qual, raw)
+
+    def _canon_held(self, program: Program, s, qual: str,
+                    held) -> "list[str]":
+        """Canonical names for a held-entry list of [raw, vocab] pairs
+        (vocab wins; unresolvable raws drop out)."""
+        out = []
+        for raw, vocab in held:
+            c = self._canon(program, s, qual, raw, vocab)
+            if c is not None:
+                out.append(c[0])
+        return out
+
+    @staticmethod
+    def _find_cycle(graph: "dict[str, set]") -> "list[str] | None":
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in
+                 set(graph) | {m for vs in graph.values() for m in vs}}
+        stack: list[str] = []
+
+        def dfs(n: str) -> "list[str] | None":
+            color[n] = GRAY
+            stack.append(n)
+            for m in sorted(graph.get(n, ())):
+                if color[m] == GRAY:
+                    return stack[stack.index(m):]
+                if color[m] == WHITE:
+                    found = dfs(m)
+                    if found is not None:
+                        return found
+            stack.pop()
+            color[n] = BLACK
+            return None
+
+        for n in sorted(color):
+            if color[n] == WHITE:
+                found = dfs(n)
+                if found is not None:
+                    return found
+        return None
