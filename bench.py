@@ -193,6 +193,79 @@ def _pipeline_bench(mib: int = 256) -> dict:
     }
 
 
+def _observability_bench(mib: int = 48) -> dict:
+    """Tracing overhead bench (ISSUE 12, docs/observability.md): the
+    always-on span layer must be invisible next to real work.  Reports
+    the disarmed span open/close cost (no subscriber), the
+    histogram-record fast path, and the tracing-on vs tracing-off
+    pipelined ingest throughput ratio (gated ≥ 0.97 in
+    tests/test_bench_harness.py — the failpoints disarmed-hit bound
+    applied to measurement)."""
+    import numpy as np
+    from pbs_plus_tpu.chunker import ChunkerParams
+    from pbs_plus_tpu.pxar.pipeline import PipelinedStream
+    from pbs_plus_tpu.utils import trace
+
+    def best_ns(fn, n: int, reps: int = 5) -> float:
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn(n)
+            best = min(best, time.perf_counter() - t0)
+        return best / n * 1e9
+
+    def span_loop(n: int) -> None:
+        for _ in range(n):
+            with trace.span("job"):
+                pass
+
+    def span_hist_loop(n: int) -> None:
+        for _ in range(n):
+            with trace.span("job.execute", kind="bench"):
+                pass
+
+    def record_loop(n: int) -> None:
+        for _ in range(n):
+            trace.record("mux.write_frame", 1e-6)
+
+    span_ns = best_ns(span_loop, 20_000)
+    span_hist_ns = best_ns(span_hist_loop, 20_000)
+    record_ns = best_ns(record_loop, 50_000)
+
+    # tracing-on vs tracing-off pipelined ingest (identical data, fresh
+    # null store each run; best-of-3 per mode to shave scheduler noise)
+    params = ChunkerParams(avg_size=256 << 10)
+    data = np.random.default_rng(12).integers(
+        0, 256, mib << 20, dtype=np.uint8).tobytes()
+    block = 8 << 20
+    workers = max(1, min(4, os.cpu_count() or 1))
+
+    def ingest_once() -> float:
+        s = PipelinedStream(_NullStore(), params, workers=workers)
+        t0 = time.perf_counter()
+        for i in range(0, len(data), block):
+            s.write(data[i:i + block])
+        s.finish()
+        return mib / (time.perf_counter() - t0)
+
+    # best-of-3 per mode, interleaved: both modes see the same thermal/
+    # scheduler conditions, so the ratio reflects tracing, not drift
+    on = off = 0.0
+    for _ in range(3):
+        with trace.disabled():
+            off = max(off, ingest_once())
+        on = max(on, ingest_once())
+    return {
+        "span_overhead_ns": round(span_ns, 1),
+        "span_hist_overhead_ns": round(span_hist_ns, 1),
+        "hist_record_ns": round(record_ns, 1),
+        "ingest_on_mib_s": round(on, 1),
+        "ingest_off_mib_s": round(off, 1),
+        "on_vs_off": round(on / off, 4) if off else 0.0,
+        "ring_capacity": trace._ring.maxlen,
+    }
+
+
 def _resume_bench(mib: int = 64) -> dict | None:
     """Crash-at-50% resume benchmark (docs/data-plane.md "Checkpointed
     resumable backups"): back a tree up with per-file checkpointing,
@@ -1058,6 +1131,13 @@ def main() -> None:
         sync = None
     if sync is not None:
         result["detail"]["sync"] = sync
+    try:
+        obs = _observability_bench()
+    except Exception as e:
+        sys.stderr.write(f"[bench] observability bench unavailable: {e}\n")
+        obs = None
+    if obs is not None:
+        result["detail"]["observability"] = obs
     result["machine"] = _machine_context()
     print(json.dumps(result))
 

@@ -13,7 +13,7 @@ import struct
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Optional
 
-from ..utils import codec
+from ..utils import codec, trace
 from .mux import MuxConnection, MuxError, MuxStream
 
 STATUS_OK = 200
@@ -92,10 +92,15 @@ class Session:
                    headers: dict[str, str] | None = None,
                    timeout: float | None = 30.0) -> Response:
         """One stream per RPC; raises CallError on non-2xx."""
+        # trace context rides the call metadata (headers) so handler-side
+        # work parents under the caller's span across the mux
+        # (docs/observability.md "Propagation")
+        hdrs = trace.headers_out(headers)
+
         async def _do() -> Response:
             st = await self.conn.open_stream()
             try:
-                await st.write(Request(method, payload, headers or {}).encode())
+                await st.write(Request(method, payload, hdrs).encode())
                 resp = Response.from_wire(await read_envelope(st))
                 if not resp.ok:
                     raise CallError(resp)
@@ -114,11 +119,12 @@ class Session:
         Returns (response, bytes_received).  (Reference: CallBinaryWithMeta
         reading into caller buffers, internal/arpc/call.go:176-199.)"""
         from .binary_stream import receive_data_into
+        hdrs = trace.headers_out(headers)
 
         async def _do() -> tuple[Response, int]:
             st = await self.conn.open_stream()
             try:
-                await st.write(Request(method, payload, headers or {}).encode())
+                await st.write(Request(method, payload, hdrs).encode())
                 resp = Response.from_wire(await read_envelope(st))
                 if resp.status != STATUS_RAW_STREAM:
                     if not resp.ok:
@@ -140,10 +146,11 @@ class Session:
                        ) -> tuple[Response, MuxStream]:
         """Raw-stream upgrade keeping the stream open for caller-driven IO
         (used by the remote-restore protocol's content streams)."""
+        hdrs = trace.headers_out(headers)
         st = await self.conn.open_stream()
         try:
             async def _handshake() -> Response:
-                await st.write(Request(method, payload, headers or {}).encode())
+                await st.write(Request(method, payload, hdrs).encode())
                 resp = Response.from_wire(await read_envelope(st))
                 if resp.status != STATUS_RAW_STREAM:
                     raise CallError(resp)

@@ -18,7 +18,7 @@ import struct
 import time
 from typing import Optional
 
-from ..utils import conf, failpoints
+from ..utils import conf, failpoints, trace
 from ..utils.log import L
 
 _HDR = struct.Struct("<BII")
@@ -243,7 +243,16 @@ class MuxConnection:
         if self.closed:
             raise MuxError("connection closed")
         shed = False
+        # histogram-only timing (trace.record, no ring span): frames are
+        # the hottest traced site, and the tail of this histogram is
+        # where slow readers show up before the shed fires.  The clock
+        # starts INSIDE the write lock so a sample is this frame's
+        # write+drain, not the queue of predecessors serialized ahead
+        # of it (that queue depth is exactly what the tail would
+        # otherwise multiply into).
+        dur = 0.0
         async with self._wlock:
+            t0 = time.perf_counter()
             try:
                 # drop/corrupt here injects a transport-death / bitflip at
                 # the frame layer; ConnectionResetError takes the same
@@ -267,6 +276,7 @@ class MuxConnection:
                         shed = True
                 else:
                     await self.writer.drain()
+                dur = time.perf_counter() - t0
             except (ConnectionError, OSError) as e:
                 await self._shutdown(f"write failed: {e}")
                 raise MuxError(f"connection write failed: {e}") from e
@@ -278,6 +288,7 @@ class MuxConnection:
             raise MuxError(
                 "connection shed: write blocked past deadline "
                 f"({self._write_deadline_s:g}s)")
+        trace.record("mux.write_frame", dur)
 
     async def _read_loop(self) -> None:
         try:

@@ -10,6 +10,7 @@ import asyncio
 import inspect
 from typing import Any, Awaitable, Callable
 
+from ..utils import trace
 from ..utils.log import L
 from .call import (
     RawStreamHandler, Request, Response, STATUS_ERROR, STATUS_NOT_FOUND,
@@ -74,30 +75,36 @@ class Router:
                 await st.write(Response(
                     STATUS_NOT_FOUND, f"unknown method {req.method!r}").encode())
                 return
-            try:
-                result = fn(req, context)
-                if inspect.isawaitable(result):
-                    result = await result
-            except HandlerError as e:
-                await st.write(Response(e.status, str(e)).encode())
-                return
-            except Exception as e:          # panic containment
-                L.exception("handler %s crashed", req.method)
-                await st.write(Response(
-                    STATUS_ERROR, f"{type(e).__name__}: {e}").encode())
-                return
-            if isinstance(result, RawStreamHandler):
-                await st.write(Response(STATUS_RAW_STREAM,
-                                        data=result.data).encode())
-                await st.write(_READY)
-                ack = await st.readexactly(1)
-                if ack != _ACK:
-                    raise MuxError("raw-stream ack mismatch")
-                await result.fn(st)
-            elif isinstance(result, Response):
-                await st.write(result.encode())
-            else:
-                await st.write(Response(data=result).encode())
+            # re-attach the caller's trace context from the call
+            # metadata: handler-side spans (including a remote peer's —
+            # agent work under a server job) parent under the caller
+            tctx = trace.parse_header(req.headers.get(trace.TRACE_HEADER))
+            with trace.attached(tctx), \
+                    trace.span("rpc.serve", method=req.method):
+                try:
+                    result = fn(req, context)
+                    if inspect.isawaitable(result):
+                        result = await result
+                except HandlerError as e:
+                    await st.write(Response(e.status, str(e)).encode())
+                    return
+                except Exception as e:          # panic containment
+                    L.exception("handler %s crashed", req.method)
+                    await st.write(Response(
+                        STATUS_ERROR, f"{type(e).__name__}: {e}").encode())
+                    return
+                if isinstance(result, RawStreamHandler):
+                    await st.write(Response(STATUS_RAW_STREAM,
+                                            data=result.data).encode())
+                    await st.write(_READY)
+                    ack = await st.readexactly(1)
+                    if ack != _ACK:
+                        raise MuxError("raw-stream ack mismatch")
+                    await result.fn(st)
+                elif isinstance(result, Response):
+                    await st.write(result.encode())
+                else:
+                    await st.write(Response(data=result).encode())
         except (MuxError, ConnectionError):
             pass                            # stream/conn died mid-RPC
         except asyncio.CancelledError:

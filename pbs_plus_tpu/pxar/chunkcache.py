@@ -38,6 +38,7 @@ from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterable
 
+from ..utils import trace
 from ..utils.log import L
 from ..utils.singleflight import ThreadSingleFlight
 
@@ -121,12 +122,18 @@ class ChunkCache:
                 self._d.move_to_end(digest)
                 return ent[0]
         try:
-            getter = getattr(store, "get_resolved", None)
-            if getter is None:
-                data = store.get(digest)     # verifies sha256 == digest
-            else:
-                data = getter(digest,
-                              self._base_resolver(store, _chain + (digest,)))
+            # the cache-miss span: disk read + decompress + verify (a
+            # hit never gets here, so the histogram is pure miss cost)
+            with trace.span("chunkcache.fetch",
+                            digest=digest.hex()[:16],
+                            prefetch=prefetched):
+                getter = getattr(store, "get_resolved", None)
+                if getter is None:
+                    data = store.get(digest)   # verifies sha256 == digest
+                else:
+                    data = getter(
+                        digest,
+                        self._base_resolver(store, _chain + (digest,)))
         except BaseException:
             with self._lock:
                 self.counters["load_errors"] += 1

@@ -52,7 +52,7 @@ import weakref
 from concurrent.futures import ThreadPoolExecutor
 
 from ..chunker import ChunkerParams
-from ..utils import failpoints
+from ..utils import failpoints, trace
 from ..utils.log import L
 from .transfer import (
     _HASH_BATCH_BYTES, _HASH_BATCH_COUNT, BatchHasher, ChunkerFactory,
@@ -206,6 +206,12 @@ class PipelinedStream(_ChunkedStream):
         self._closed = False
         self._finished = False
         self._finish_ok = False     # set only by a successful finish()
+        # the stream opens under the job's trace context (start_session
+        # runs trace-wrapped); pool workers and the committer attach it
+        # so their stage spans parent under the job — the thread-pool
+        # propagation seam (docs/observability.md).  Captured BEFORE the
+        # committer starts: it reads this immediately.
+        self._tctx = trace.capture()
         self._committer = threading.Thread(
             target=self._commit_loop, name="pipeline-commit", daemon=True)
         self._committer.start()
@@ -264,13 +270,24 @@ class PipelinedStream(_ChunkedStream):
         # committer, which must drain queues and wake the caller
         failpoints.hit("pipeline.hash")
         d = hashlib.sha256(chunk).digest()
-        METRICS.add("hash", len(chunk), time.perf_counter() - t0, 1)
+        dt = time.perf_counter() - t0
+        METRICS.add("hash", len(chunk), dt, 1)
+        if trace.enabled():
+            # inherited stage accumulator (flushed as ONE aggregate span
+            # at sync/finish); concurrent += from N workers may lose an
+            # update — observability aggregate, like _hash_inflight
+            self._sha_ns += int(dt * 1e9)
+            self._sha_chunks += 1
         self._hash_inflight -= 1
         return d
 
     def _hash_batch(self, chunks: list, nbytes: int) -> list:
         t0 = time.perf_counter()
-        out = self._hasher(chunks)
+        # pool-thread span, attached to the stream's captured context:
+        # batch hashing shows up per dispatch under the job trace
+        with trace.attached(self._tctx), \
+                trace.span("ingest.sha", chunks=len(chunks)):
+            out = self._hasher(chunks)
         METRICS.add("hash", nbytes, time.perf_counter() - t0, len(chunks))
         self._hash_inflight -= len(chunks)
         return out
@@ -309,6 +326,7 @@ class PipelinedStream(_ChunkedStream):
         self._commit_q.put(("drain", done))
         done.wait()
         self._check_failed()
+        self._emit_stage_spans()
 
     def finish(self) -> list[tuple[int, bytes]]:
         if self._finished:
@@ -329,6 +347,7 @@ class PipelinedStream(_ChunkedStream):
         if self._exc is not None:
             raise self._exc
         self._finish_ok = True
+        self._emit_stage_spans()
         return self.records
 
     def close(self) -> None:
@@ -346,6 +365,12 @@ class PipelinedStream(_ChunkedStream):
 
     # -- committer thread --------------------------------------------------
     def _commit_loop(self) -> None:
+        # committer-side batched probe/presketch spans parent under the
+        # stream's job trace (the second thread seam of this stream)
+        with trace.attached(self._tctx):
+            self._commit_loop_body()
+
+    def _commit_loop_body(self) -> None:
         try:
             while True:
                 slot = self._commit_q.get()

@@ -60,7 +60,7 @@ import time
 import urllib.parse
 from typing import Iterable, Sequence
 
-from ..utils import conf, failpoints, validate
+from ..utils import conf, failpoints, trace, validate
 from ..utils.log import L
 from .datastore import Datastore, DynamicIndex, SnapshotRef, \
     parse_snapshot_ref
@@ -378,7 +378,8 @@ def _probe(dest, digests: Sequence[bytes], stats: dict) -> list[bool]:
     """One membership batch against the destination — the single
     ``pbsstore.sync.probe`` site plus the probe accounting."""
     failpoints.hit("pbsstore.sync.probe")
-    present = dest.probe_chunks(digests)
+    with trace.span("sync.negotiate", chunks=len(digests)):
+        present = dest.probe_chunks(digests)
     if len(present) != len(digests):
         raise SyncError("destination answered a probe batch with the "
                         f"wrong arity ({len(present)}/{len(digests)})")
@@ -457,15 +458,17 @@ def _mirror_one(source, dest, ref: SnapshotRef, batch: int,
             extra_present = _probe(dest, extra, stats)
             missing = [d for d, ok in zip(extra, extra_present)
                        if not ok] + missing
-        payloads = source.fetch_chunks(missing)
-        pairs: list[tuple[bytes, bytes]] = []
-        for digest, payload in zip(missing, payloads):
-            # the one wire-fault site: raise/drop model transport death,
-            # corrupt flips a payload byte that the destination's
-            # verification MUST catch (no torn chunks)
-            payload = failpoints.hit("pbsstore.sync.transfer", payload)
-            pairs.append((digest, payload))
-        dest.insert_chunks(_transfer_order(pairs))
+        with trace.span("sync.transfer", chunks=len(missing)):
+            payloads = source.fetch_chunks(missing)
+            pairs: list[tuple[bytes, bytes]] = []
+            for digest, payload in zip(missing, payloads):
+                # the one wire-fault site: raise/drop model transport
+                # death, corrupt flips a payload byte that the
+                # destination's verification MUST catch (no torn chunks)
+                payload = failpoints.hit("pbsstore.sync.transfer",
+                                         payload)
+                pairs.append((digest, payload))
+            dest.insert_chunks(_transfer_order(pairs))
         nbytes = sum(len(p) for _, p in pairs)
         snap_wire += nbytes
         snap_transferred += len(pairs)
@@ -616,6 +619,16 @@ class SyncWireServer:
                 if not self._authed():
                     return self._json(401, {"error": "unauthorized"})
                 ep = path[len(WIRE_PREFIX):]
+                # attach the puller/pusher's trace context from the
+                # request header (handler threads have none of their
+                # own) — this server's spans join the caller's trace
+                tctx = trace.parse_header(
+                    self.headers.get(trace.TRACE_HEADER))
+                with trace.attached(tctx), \
+                        trace.span("sync.serve", endpoint=ep):
+                    return self._serve(method, ep, params)
+
+            def _serve(self, method: str, ep: str, params) -> None:
                 try:
                     if method == "GET" and ep == "/snapshots":
                         ns = params.get("ns")
@@ -726,8 +739,11 @@ class _WireClient:
         path = WIRE_PREFIX + ep
         if params:
             path += "?" + urllib.parse.urlencode(params)
-        headers = {"Authorization": f"Bearer {self._token}",
-                   "Content-Length": str(len(body))}
+        # trace context crosses the wire as an HTTP header, so the
+        # peer's serve spans parent under this sync job's trace
+        headers = trace.headers_out(
+            {"Authorization": f"Bearer {self._token}",
+             "Content-Length": str(len(body))})
         with self._lock:
             for attempt in (0, 1):
                 conn = self._connect()

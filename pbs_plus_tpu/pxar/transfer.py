@@ -35,6 +35,7 @@ from typing import Callable, Iterator
 
 from ..chunker import ChunkerParams, CpuChunker
 from ..chunker import spec as _spec
+from ..utils import trace
 from ..utils.log import L
 from .datastore import ChunkStore, Datastore, DynamicIndex, SnapshotRef
 from .format import Entry, KIND_DIR, KIND_FILE, decode_entries
@@ -178,6 +179,18 @@ class _ChunkedStream:
         self._hasher = batch_hasher
         self._pending: list[tuple[int, bytes]] = []  # (record idx, chunk)
         self._pending_bytes = 0
+        # per-stream ingest-stage accumulators (ns): the per-chunk hot
+        # path pays two perf_counter_ns calls, and sync()/finish() emit
+        # ONE aggregate span per stage (docs/observability.md "Ingest
+        # stages") — batch-dispatched stages (sha/probe/presketch on the
+        # batch-hasher path) get real per-dispatch spans instead.
+        # Pipelined hash workers += these concurrently; a lost update
+        # only shaves an observability aggregate (same contract as
+        # pipeline._hash_inflight).
+        self._cdc_ns = 0
+        self._cdc_bytes = 0
+        self._sha_ns = 0
+        self._sha_chunks = 0
 
     def write(self, data: bytes) -> None:
         if not data:
@@ -185,7 +198,13 @@ class _ChunkedStream:
         self._buf.append(data)
         self.offset += len(data)
         self.stats.bytes_streamed += len(data)
-        cuts = self._chunker.feed(data)
+        if trace.enabled():
+            t0 = time.perf_counter_ns()
+            cuts = self._chunker.feed(data)
+            self._cdc_ns += time.perf_counter_ns() - t0
+            self._cdc_bytes += len(data)
+        else:
+            cuts = self._chunker.feed(data)
         self._emit(cuts)
 
     def _emit(self, run_relative_cuts: list[int]) -> None:
@@ -199,7 +218,13 @@ class _ChunkedStream:
         chunk = self._buf.take(n)      # memoryview when seam-free
         self._buf_base = end
         if self._hasher is None:
-            digest = hashlib.sha256(chunk).digest()
+            if trace.enabled():
+                t0 = time.perf_counter_ns()
+                digest = hashlib.sha256(chunk).digest()
+                self._sha_ns += time.perf_counter_ns() - t0
+                self._sha_chunks += 1
+            else:
+                digest = hashlib.sha256(chunk).digest()
             self._insert(digest, chunk)
             self.records.append((end, digest))
         else:
@@ -223,7 +248,8 @@ class _ChunkedStream:
         probe = getattr(self.store, "probe_batch", None)
         if probe is None:
             return None
-        return probe(digests)
+        with trace.span("ingest.probe", chunks=len(digests)):
+            return probe(digests)
 
     def _insert_probed(self, digest: bytes, chunk: bytes,
                        known: "bool | None") -> None:
@@ -247,13 +273,15 @@ class _ChunkedStream:
         same batches — accounting stays bit-identical."""
         pres = getattr(self.store, "presketch_batch", None)
         if pres is not None:
-            pres(digests, chunks, known)
+            with trace.span("ingest.presketch", chunks=len(digests)):
+                pres(digests, chunks, known)
 
     def _flush_hashes(self) -> None:
         if not self._pending:
             return
         assert self._hasher is not None
-        digests = self._hasher([c for _, c in self._pending])
+        with trace.span("ingest.sha", chunks=len(self._pending)):
+            digests = self._hasher([c for _, c in self._pending])
         known = self._probe_known(digests)
         self._presketch(digests, [c for _, c in self._pending], known)
         for i, ((idx, chunk), digest) in enumerate(zip(self._pending,
@@ -288,10 +316,30 @@ class _ChunkedStream:
         self.stats.bytes_reffed += size
         self.store.touch(digest)
 
+    def _emit_stage_spans(self) -> None:
+        """Flush the per-chunk stage accumulators as ONE aggregate span
+        each (attrs carry the chunk count) — the sequential writer's
+        per-stage visibility without a span on every 4 KiB chunk."""
+        if self._cdc_ns:
+            # delta accounting like the sha counter: a checkpointed
+            # stream emits one span per sync, each covering only the
+            # bytes scanned since the last emit (bytes/dur_s stays a
+            # true per-window rate)
+            trace.emit("ingest.cdc", self._cdc_ns / 1e9,
+                       bytes=self._cdc_bytes, aggregated=True)
+            self._cdc_ns = 0
+            self._cdc_bytes = 0
+        if self._sha_ns:
+            trace.emit("ingest.sha", self._sha_ns / 1e9,
+                       chunks=self._sha_chunks, aggregated=True)
+            self._sha_ns = 0
+            self._sha_chunks = 0
+
     def finish(self) -> list[tuple[int, bytes]]:
         if self._buf:
             self.flush_chunker()
         self._flush_hashes()
+        self._emit_stage_spans()
         return self.records
 
     def sync(self) -> None:
@@ -303,6 +351,7 @@ class _ChunkedStream:
         if self._buf:
             self.flush_chunker()
         self._flush_hashes()
+        self._emit_stage_spans()
 
 
 class SessionWriter:

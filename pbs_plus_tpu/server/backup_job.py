@@ -40,7 +40,7 @@ from ..pxar.format import (
     Entry, KIND_BLOCKDEV, KIND_DEVICE, KIND_DIR, KIND_FIFO, KIND_FILE,
     KIND_HARDLINK, KIND_SOCKET, KIND_SYMLINK,
 )
-from ..utils import failpoints
+from ..utils import failpoints, trace
 from ..utils.log import L
 from ..utils.resilience import CircuitBreaker, with_retry
 from . import checkpoint, database
@@ -268,6 +268,9 @@ class RemoteTreeBackup:
         )
 
     async def run(self) -> BackupResult:
+        # hand the job's trace context to the writer thread: ingest
+        # stage spans emitted there parent under the job span
+        self._tctx = trace.capture()
         writer_thread = threading.Thread(
             target=self._writer_loop, name="backup-writer", daemon=True)
         writer_thread.start()
@@ -461,6 +464,12 @@ class RemoteTreeBackup:
                 drain_q(item[2]._q)
 
     def _writer_loop(self) -> None:
+        # fresh thread: attach the job's trace context so the writer's
+        # ingest-stage spans/emits parent under the job span
+        with trace.attached(getattr(self, "_tctx", None)):
+            self._writer_loop_body()
+
+    def _writer_loop_body(self) -> None:
         w = self.session.writer
         current = None
         try:
@@ -598,14 +607,16 @@ async def run_local_backup(row: database.BackupJobRow, *, db, store,
         snaps = SnapshotManager()
         snap = snaps.create(src)
         try:
-            resume_ctx = checkpoint.open_resume(
-                store, backup_type="host", backup_id=backup_id,
-                namespace=row.namespace or "")
-            kw = {"previous_reader": resume_ctx[0]} if resume_ctx else {}
-            session = store.start_session(
-                backup_type="host", backup_id=backup_id,
-                namespace=row.namespace or None,
-                pipeline_workers=row.pipeline_workers, **kw)
+            with trace.span("backup.session_open"):
+                resume_ctx = checkpoint.open_resume(
+                    store, backup_type="host", backup_id=backup_id,
+                    namespace=row.namespace or "")
+                kw = {"previous_reader": resume_ctx[0]} if resume_ctx \
+                    else {}
+                session = store.start_session(
+                    backup_type="host", backup_id=backup_id,
+                    namespace=row.namespace or None,
+                    pipeline_workers=row.pipeline_workers, **kw)
             try:
                 if resume_ctx is not None:
                     session.resume_plan = resume_ctx[1]
@@ -622,7 +633,8 @@ async def run_local_backup(row: database.BackupJobRow, *, db, store,
                 extra = {"job": row.id, "errors": result.errors[:100]}
                 if resume_ctx is not None:
                     extra["resume"] = resume_ctx[1].summary()
-                result.manifest = session.finish(extra)
+                with trace.span("backup.publish"):
+                    result.manifest = session.finish(extra)
                 result.snapshot = str(session.ref)
                 # the published snapshot supersedes the group's
                 # checkpoints — reap them now instead of waiting for
@@ -638,7 +650,8 @@ async def run_local_backup(row: database.BackupJobRow, *, db, store,
         finally:
             snaps.cleanup(snap)
 
-    await asyncio.get_running_loop().run_in_executor(None, run_sync)
+    await asyncio.get_running_loop().run_in_executor(
+        None, trace.wrap(run_sync))
     return result
 
 
@@ -722,26 +735,34 @@ async def run_backup_job(row: database.BackupJobRow, *,
             timeout=120)
         log.info("agent accepted backup (snapshot=%s)",
                  resp.data.get("snapshot_method"))
-        job_sess_info = await agents.wait_session(client_id, timeout=60)
-        fs = AgentFSClient(Session(job_sess_info.conn))
-
-        # checkpoint resume (datastore-backed stores only): a valid
-        # checkpoint from a crashed or retried run becomes the writer's
-        # `previous`, and its plan fast-skips committed unchanged files
         loop = asyncio.get_running_loop()
-        resume_ctx = await loop.run_in_executor(
-            None, lambda: checkpoint.open_resume(
-                store, backup_type="host",
-                backup_id=row.backup_id or row.target,
-                namespace=row.namespace or ""))
-        session_kw = {"previous_reader": resume_ctx[0]} if resume_ctx else {}
-        # start_session can do network I/O (PBSStore: TLS connect, session
-        # establish, previous-index downloads) — keep it off the event loop
-        session = await loop.run_in_executor(
-            None, lambda: store.start_session(
-                backup_type="host", backup_id=row.backup_id or row.target,
-                namespace=row.namespace or None,
-                pipeline_workers=row.pipeline_workers, **session_kw))
+        with trace.span("backup.session_open"):
+            job_sess_info = await agents.wait_session(client_id, timeout=60)
+            fs = AgentFSClient(Session(job_sess_info.conn))
+
+            # checkpoint resume (datastore-backed stores only): a valid
+            # checkpoint from a crashed or retried run becomes the
+            # writer's `previous`, and its plan fast-skips committed
+            # unchanged files.  Executor offloads are trace.wrap-ped so
+            # spans opened on the worker thread (ingest stage emits,
+            # store work) stay parented under this job's trace.
+            resume_ctx = await loop.run_in_executor(
+                None, trace.wrap(lambda: checkpoint.open_resume(
+                    store, backup_type="host",
+                    backup_id=row.backup_id or row.target,
+                    namespace=row.namespace or "")))
+            session_kw = ({"previous_reader": resume_ctx[0]}
+                          if resume_ctx else {})
+            # start_session can do network I/O (PBSStore: TLS connect,
+            # session establish, previous-index downloads) — keep it off
+            # the event loop
+            session = await loop.run_in_executor(
+                None, trace.wrap(lambda: store.start_session(
+                    backup_type="host",
+                    backup_id=row.backup_id or row.target,
+                    namespace=row.namespace or None,
+                    pipeline_workers=row.pipeline_workers,
+                    **session_kw)))
         try:
             if resume_ctx is not None:
                 session.resume_plan = resume_ctx[1]
@@ -787,8 +808,12 @@ async def run_backup_job(row: database.BackupJobRow, *,
             extra = {"job": row.id, "errors": pump.result.errors[:100]}
             if resume_ctx is not None:
                 extra["resume"] = resume_ctx[1].summary()
+
+            def _publish():
+                with trace.span("backup.publish"):
+                    return session.finish(extra)
             manifest = await loop.run_in_executor(
-                None, session.finish, extra)
+                None, trace.wrap(_publish))
             if getattr(store, "datastore", None) is not None:
                 # published snapshot supersedes the group's checkpoints
                 await loop.run_in_executor(

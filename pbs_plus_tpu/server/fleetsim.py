@@ -40,8 +40,9 @@ from ..arpc.router import HandlerError
 from ..arpc.transport import HDR_LOOPBACK_CN, HandshakeError
 from ..chunker import ChunkerParams
 from ..pxar.backupproxy import LocalStore
+from ..utils import trace
 from ..utils.log import L
-from . import checkpoint
+from . import checkpoint, metrics
 from .backup_job import RemoteTreeBackup
 from .jobs import Job, JobsManager
 
@@ -255,6 +256,10 @@ class SimAgent:
                     write_deadline_s=self.write_deadline_s)
                 if not self.connect_latency_s:
                     self.connect_latency_s = time.perf_counter() - t0
+                    # the contended control dial feeds the shared
+                    # session-open histogram (phase=connect); the
+                    # report's percentiles derive from its buckets
+                    trace.record("session.open", self.connect_latency_s)
                 self._conns.append(conn)
                 return conn
             except HandshakeError as e:
@@ -410,19 +415,22 @@ class FleetServer:
         try:
             await control_sess.call(
                 "backup", {"job_id": job_id, "source": "/"}, timeout=120)
-            job_sess = await self.agents.wait_session(client_id, timeout=60)
-            fs = AgentFSClient(Session(job_sess.conn))
             loop = asyncio.get_running_loop()
-            resume_ctx = None
-            if self.cfg.checkpoint_interval:
-                resume_ctx = await loop.run_in_executor(
-                    None, lambda: checkpoint.open_resume(
-                        self.store, backup_type="host", backup_id=cn))
-            session_kw = {"previous_reader": resume_ctx[0]} \
-                if resume_ctx else {}
-            session = await loop.run_in_executor(
-                None, lambda: self.store.start_session(
-                    backup_type="host", backup_id=cn, **session_kw))
+            with trace.span("backup.session_open"):
+                job_sess = await self.agents.wait_session(client_id,
+                                                          timeout=60)
+                fs = AgentFSClient(Session(job_sess.conn))
+                resume_ctx = None
+                if self.cfg.checkpoint_interval:
+                    resume_ctx = await loop.run_in_executor(
+                        None, trace.wrap(lambda: checkpoint.open_resume(
+                            self.store, backup_type="host",
+                            backup_id=cn)))
+                session_kw = {"previous_reader": resume_ctx[0]} \
+                    if resume_ctx else {}
+                session = await loop.run_in_executor(
+                    None, trace.wrap(lambda: self.store.start_session(
+                        backup_type="host", backup_id=cn, **session_kw)))
             try:
                 if resume_ctx is not None:
                     session.resume_plan = resume_ctx[1]
@@ -452,8 +460,11 @@ class FleetServer:
                         pump_task.cancel()
                         await asyncio.gather(pump_task,
                                              return_exceptions=True)
+                def _publish():
+                    with trace.span("backup.publish"):
+                        return session.finish({"job": job_id})
                 manifest = await loop.run_in_executor(
-                    None, session.finish, {"job": job_id})
+                    None, trace.wrap(_publish))
                 if self.cfg.checkpoint_interval:
                     await loop.run_in_executor(
                         None, lambda: checkpoint.clear(
@@ -489,8 +500,6 @@ class FleetReport:
     resumed: int = 0
     requeued: int = 0
     wall_s: float = 0.0
-    enq_to_pub_s: list = field(default_factory=list)
-    session_open_s: list = field(default_factory=list)
     admission: dict = field(default_factory=dict)
     connect_rejects: int = 0
     mux_server: dict = field(default_factory=dict)
@@ -514,13 +523,17 @@ class FleetReport:
     sync_chunks: int = 0
     sync_wire_bytes: int = 0
     sync_failures: dict = field(default_factory=dict)  # job_id → error
+    # per-histogram snapshot taken at soak start: the report's
+    # percentiles are bucket-diff quantiles of the PROCESS-SHARED
+    # /metrics histograms (ISSUE 12 — one quantile implementation,
+    # server/metrics.py, replacing the old ad-hoc sorted-list math)
+    hist_baseline: dict = field(default_factory=dict)
 
-    @staticmethod
-    def _pct(xs: list, q: float) -> float:
-        if not xs:
-            return 0.0
-        ys = sorted(xs)
-        return ys[min(len(ys) - 1, int(round(q * (len(ys) - 1))))]
+    def _pct(self, hist_name: str, q: float,
+             labels: "dict | None" = None) -> float:
+        h = metrics.HISTOGRAMS[hist_name]
+        return h.quantile(q, labels=labels,
+                          since=self.hist_baseline.get(hist_name))
 
     def to_dict(self) -> dict:
         frames = self.mux_server.get("frames_tx", 0) + \
@@ -534,13 +547,17 @@ class FleetReport:
             "requeued": self.requeued,
             "wall_s": round(self.wall_s, 3),
             "enqueue_to_publish_p50_s": round(
-                self._pct(self.enq_to_pub_s, 0.50), 4),
+                self._pct("pbs_plus_job_enqueue_to_publish_seconds",
+                          0.50, {"kind": "backup"}), 4),
             "enqueue_to_publish_p99_s": round(
-                self._pct(self.enq_to_pub_s, 0.99), 4),
+                self._pct("pbs_plus_job_enqueue_to_publish_seconds",
+                          0.99, {"kind": "backup"}), 4),
             "session_open_p50_s": round(
-                self._pct(self.session_open_s, 0.50), 5),
+                self._pct("pbs_plus_session_open_seconds",
+                          0.50, {"phase": "connect"}), 5),
             "session_open_p99_s": round(
-                self._pct(self.session_open_s, 0.99), 5),
+                self._pct("pbs_plus_session_open_seconds",
+                          0.99, {"phase": "connect"}), 5),
             "admission": dict(self.admission),
             "admission_rejected": sum(
                 v for k, v in self.admission.items() if k != "admitted"),
@@ -578,6 +595,11 @@ async def run_fleet_async(datastore_dir: str,
     import random
     rng = random.Random(cfg.seed)
     report = FleetReport(cfg=cfg, queue_bound=cfg.max_queued)
+    # snapshot the shared latency histograms so the report's percentiles
+    # cover THIS soak only (bucket diff), not the process's whole life
+    for _hname in ("pbs_plus_job_enqueue_to_publish_seconds",
+                   "pbs_plus_session_open_seconds"):
+        report.hist_baseline[_hname] = metrics.HISTOGRAMS[_hname].snapshot()
     server = FleetServer(datastore_dir, cfg)
     port = await server.start()
     doomed = set()
@@ -645,8 +667,6 @@ async def run_fleet_async(datastore_dir: str,
     sampler_task = asyncio.create_task(sampler(), name="fleet-sampler")
 
     # -- enqueue one backup per agent --------------------------------------
-    enqueue_ts: dict[str, float] = {}
-
     def submit(cn: str, idx: int, job_id: str) -> None:
         tenant = f"tenant-{idx % max(1, cfg.tenants)}"
         breaker = server.jobs.breaker(
@@ -660,15 +680,12 @@ async def run_fleet_async(datastore_dir: str,
             report.refs[cn] = res["ref"]
             if res["resumed"]:
                 report.resumed += 1
-            report.enq_to_pub_s.append(
-                time.perf_counter() - enqueue_ts[cn])
             report.failures.pop(cn, None)
 
         async def on_error(exc: BaseException):
             report.failed += 1
             report.failures[cn] = f"{type(exc).__name__}: {exc}"
 
-        enqueue_ts[cn] = time.perf_counter()
         server.jobs.enqueue(Job(id=f"backup:{cn}", kind="backup",
                                 tenant=tenant, execute=execute,
                                 on_error=on_error))
@@ -683,10 +700,10 @@ async def run_fleet_async(datastore_dir: str,
 
         async def execute():
             res = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: run_sync(
+                None, trace.wrap(lambda: run_sync(
                     LocalSyncSource(server.store.datastore),
                     LocalSyncDest(mirror_ds),
-                    job_id=job_id, state_root=mirror_dir))
+                    job_id=job_id, state_root=mirror_dir)))
             report.sync_completed += 1
             report.sync_chunks += res["chunks_transferred"]
             report.sync_wire_bytes += res["bytes_wire"]
@@ -746,7 +763,6 @@ async def run_fleet_async(datastore_dir: str,
     stop_sampling.set()
     await sampler_task
 
-    report.session_open_s = [a.connect_latency_s for a in agents.values()]
     report.connect_rejects = sum(a.connect_rejects
                                  for a in agents.values())
     report.admission = server.agents.admission_stats()

@@ -25,7 +25,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Optional
 
-from ..utils import conf, failpoints
+from ..utils import conf, failpoints, trace
 from ..utils.log import L
 from ..utils.resilience import CircuitBreaker
 
@@ -55,6 +55,9 @@ class Job:
     on_success: Optional[AsyncFn] = None
     on_error: Optional[Callable[[BaseException], Awaitable[None]]] = None
     cleanup: Optional[AsyncFn] = None
+    # set by enqueue(): the enqueue-to-grant / enqueue-to-publish
+    # latency origin (docs/observability.md)
+    enqueued_at: float = 0.0
 
 
 class JobsManager:
@@ -111,6 +114,7 @@ class JobsManager:
             raise QueueFullError(
                 f"jobs queue full ({self._queued}/{self.max_queued} "
                 f"queued); rejecting {job.id!r}")
+        job.enqueued_at = time.perf_counter()
         task = asyncio.create_task(self._run(job), name=f"job:{job.id}")
         self._active[job.id] = task
         self._queued += 1
@@ -281,45 +285,69 @@ class JobsManager:
                 dequeued = True
                 self._queued -= 1
 
-        try:
-            if job.pre_exec is not None:
-                # before the execution slot: target mounts while queued
-                await job.pre_exec()
-            await self._acquire_slot(job)
-            got_slot = True
-            _dequeue()
-            self._tenant_running[job.tenant] = \
-                self._tenant_running.get(job.tenant, 0) + 1
-            await failpoints.ahit("server.job.execute")
-            if job.execute is not None:
-                await job.execute()
-        except asyncio.CancelledError as e:
-            failed = e
-            log.warning("job cancelled")
-        except BaseException as e:
-            failed = e
-            log.exception("job failed")
-        finally:
-            if got_slot:
-                self._release_slot(job)
-            _dequeue()
+        # the trace root: everything the job does — slot wait, execute,
+        # agent-side RPC work (via call metadata), hooks — nests under
+        # this span (docs/observability.md "Span vocabulary")
+        with trace.span("job", job_id=job.id, kind=job.kind,
+                        tenant=job.tenant):
             try:
-                if failed is None:
-                    self.stats["completed"] += 1
-                    if job.on_success is not None:
-                        await job.on_success()
-                else:
-                    self.stats["failed"] += 1
-                    if job.on_error is not None:
-                        await job.on_error(failed)
-            except Exception:
-                log.exception("job completion hook failed")
-            try:
-                if job.cleanup is not None:
-                    await job.cleanup()
-            except Exception:
-                log.exception("job cleanup failed")
-            self._active.pop(job.id, None)
+                if job.pre_exec is not None:
+                    # before the execution slot: target mounts while queued
+                    await job.pre_exec()
+                with trace.span("job.queue_wait", kind=job.kind):
+                    await self._acquire_slot(job)
+                got_slot = True
+                _dequeue()
+                if job.enqueued_at:
+                    # the histogram's contract is enqueue→grant: measured
+                    # from the enqueue timestamp, so task-scheduling
+                    # delay and pre_exec (a 30s mount waits BEFORE the
+                    # slot) are included — the queue_wait span above
+                    # times only the slot acquisition itself
+                    trace.record("job.enqueue_to_grant",
+                                 time.perf_counter() - job.enqueued_at,
+                                 kind=job.kind)
+                self._tenant_running[job.tenant] = \
+                    self._tenant_running.get(job.tenant, 0) + 1
+                await failpoints.ahit("server.job.execute")
+                if job.execute is not None:
+                    with trace.span("job.execute", kind=job.kind):
+                        await job.execute()
+            except asyncio.CancelledError as e:
+                failed = e
+                log.warning("job cancelled")
+            except BaseException as e:
+                failed = e
+                log.exception("job failed")
+            finally:
+                if got_slot:
+                    self._release_slot(job)
+                _dequeue()
+                try:
+                    if failed is None:
+                        self.stats["completed"] += 1
+                        if job.enqueued_at:
+                            # whole-path latency — the fleet report's
+                            # enqueue-to-publish percentiles derive from
+                            # this histogram's bucket counts
+                            trace.record(
+                                "job.enqueue_to_publish",
+                                time.perf_counter() - job.enqueued_at,
+                                kind=job.kind)
+                        if job.on_success is not None:
+                            await job.on_success()
+                    else:
+                        self.stats["failed"] += 1
+                        if job.on_error is not None:
+                            await job.on_error(failed)
+                except Exception:
+                    log.exception("job completion hook failed")
+                try:
+                    if job.cleanup is not None:
+                        await job.cleanup()
+                except Exception:
+                    log.exception("job cleanup failed")
+                self._active.pop(job.id, None)
 
     @property
     def startup_mu(self) -> asyncio.Lock:

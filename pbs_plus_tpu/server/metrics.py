@@ -7,8 +7,10 @@ Text exposition format rendered directly (no client library needed).
 
 from __future__ import annotations
 
+import bisect
 import json
 import os
+import threading
 import time
 from typing import TYPE_CHECKING
 
@@ -22,6 +24,186 @@ _DATASTORE_SCAN_TTL = 15.0      # cache the chunk-dir walk between scrapes
 
 def _esc(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+# -- histograms (ISSUE 12, docs/observability.md) ---------------------------
+#
+# Fixed log-spaced buckets (1-2.5-5 ladder, 1 µs .. 10 s) shared by every
+# latency histogram: span closes in utils/trace.py observe into these,
+# and render() exposes the Prometheus histogram triple
+# (`<name>_bucket{le=...}` / `<name>_sum` / `<name>_count`) so p50/p99
+# are derivable by any scraper.  Fixed buckets keep observe() O(log B)
+# with zero allocation; the ladder spans mux frame writes (µs) to whole
+# job executions (s).
+
+HIST_BUCKETS = (
+    1e-6, 2.5e-6, 5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+    1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Histogram:
+    """Fixed-bucket latency histogram with optional label children.
+
+    A child is one (counts, sum, count) triple keyed by its sorted
+    label items; the unlabeled histogram is the ``()`` child.  One lock
+    per histogram: observe() holds it for two increments and a list
+    index — uncontended nanoseconds, far under the traced work."""
+
+    __slots__ = ("name", "help", "buckets", "_children", "_lock")
+
+    def __init__(self, name: str, help_: str,
+                 buckets: "tuple[float, ...]" = HIST_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = buckets
+        self._lock = threading.Lock()
+        # label-items tuple -> [counts per bucket (+inf last), sum, count]
+        self._children: dict = {}       # guarded-by: self._lock
+
+    @staticmethod
+    def _key(labels: "dict | None") -> tuple:
+        return tuple(sorted(labels.items())) if labels else ()
+
+    def observe(self, seconds: float, labels: "dict | None" = None) -> None:
+        key = self._key(labels)
+        i = bisect.bisect_left(self.buckets, seconds)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = \
+                    [[0] * (len(self.buckets) + 1), 0.0, 0]
+            child[0][i] += 1
+            child[1] += seconds
+            child[2] += 1
+
+    def snapshot(self) -> dict:
+        """{label_key: {"counts": [...], "sum": s, "count": n}} — the
+        diffable view (FleetReport quantiles subtract a prior snapshot
+        so a process-global histogram yields per-run percentiles)."""
+        with self._lock:
+            return {k: {"counts": list(c[0]), "sum": c[1], "count": c[2]}
+                    for k, c in self._children.items()}
+
+    def quantile(self, q: float, labels: "dict | None" = None,
+                 since: "dict | None" = None) -> float:
+        """q-quantile estimate from bucket counts (``since`` = a prior
+        ``snapshot()`` to diff against).  THE quantile implementation —
+        FleetReport and every report path derive percentiles here
+        (property-tested against sorted-sample truth in
+        tests/test_trace.py)."""
+        key = self._key(labels)
+        with self._lock:
+            child = self._children.get(key)
+            counts = list(child[0]) if child is not None else None
+        if counts is None:
+            return 0.0
+        if since is not None and key in since:
+            prior = since[key]["counts"]
+            counts = [a - b for a, b in zip(counts, prior)]
+        return quantile_from_counts(self.buckets, counts, q)
+
+
+def quantile_from_counts(buckets: "tuple[float, ...]", counts: list,
+                         q: float) -> float:
+    """Linear-interpolated quantile from per-bucket counts (last bucket
+    = +Inf, reported as the last finite edge — log buckets make the
+    estimate's error one bucket width, which the exposition shares)."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    q = min(1.0, max(0.0, q))
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if cum + c >= rank:
+            lo = buckets[i - 1] if i > 0 else 0.0
+            hi = buckets[i] if i < len(buckets) else buckets[-1]
+            frac = (rank - cum) / c
+            return lo + (hi - lo) * max(0.0, min(1.0, frac))
+        cum += c
+    return buckets[-1]
+
+
+_hist_lock = threading.Lock()
+HISTOGRAMS: dict[str, Histogram] = {}          # guarded-by: _hist_lock
+
+
+def histogram(name: str, help_: str) -> Histogram:
+    """Register (idempotent) and return the named histogram.  Names are
+    literal and documented in docs/metrics.md — the registry-consistency
+    rule checks this call's first argument like it checks gauge()."""
+    with _hist_lock:
+        h = HISTOGRAMS.get(name)
+        if h is None:
+            h = HISTOGRAMS[name] = Histogram(name, help_)
+        return h
+
+
+def observe_histogram(name: str, seconds: float,
+                      labels: "dict | None" = None) -> None:
+    """Span-close feed (utils/trace.py).  Unknown names raise: the
+    span→histogram mapping is a closed registry, and a typo must fail a
+    test, not silently drop observations."""
+    # lock-free read on the hot path: the registry is append-only and
+    # fully populated by the module-level declarations below — a lookup
+    # can never observe a partially-built entry
+    HISTOGRAMS[name].observe(seconds, labels)   # pbslint: disable=guarded-by
+
+
+def render_histograms() -> str:
+    """Prometheus exposition of every registered histogram
+    (``_bucket``/``_sum``/``_count``), cumulative le-counts per child."""
+    lines: list[str] = []
+    with _hist_lock:
+        hists = list(HISTOGRAMS.values())
+    for h in hists:
+        lines.append(f"# HELP {h.name} {h.help}")
+        lines.append(f"# TYPE {h.name} histogram")
+        for key, child in sorted(h.snapshot().items()):
+            base = list(key)
+            cum = 0
+            for edge, c in zip(h.buckets, child["counts"]):
+                cum += c
+                lbl = ",".join(
+                    f'{k}="{_esc(str(v))}"'
+                    for k, v in base + [("le", f"{edge:g}")])
+                lines.append(f"{h.name}_bucket{{{lbl}}} {cum}")
+            cum += child["counts"][-1]
+            lbl = ",".join(f'{k}="{_esc(str(v))}"'
+                           for k, v in base + [("le", "+Inf")])
+            lines.append(f"{h.name}_bucket{{{lbl}}} {cum}")
+            plain = ",".join(f'{k}="{_esc(str(v))}"' for k, v in base)
+            suffix = f"{{{plain}}}" if plain else ""
+            lines.append(f"{h.name}_sum{suffix} {child['sum']}")
+            lines.append(f"{h.name}_count{suffix} {child['count']}")
+    return "\n".join(lines)
+
+
+# the data-plane latency histograms (fed by utils/trace.py span closes;
+# vocabulary in docs/observability.md, rows in docs/metrics.md)
+histogram("pbs_plus_job_enqueue_to_grant_seconds",
+          "Enqueue to execution-slot grant (incl. pre-exec), by kind")
+histogram("pbs_plus_job_grant_to_publish_seconds",
+          "Job execution: slot grant to completion, by kind")
+histogram("pbs_plus_job_enqueue_to_publish_seconds",
+          "Whole job latency: enqueue to successful completion, by kind")
+histogram("pbs_plus_session_open_seconds",
+          "Session establishment: fleetsim's contended agent dial "
+          "(phase=connect, soak-fed) and the backup job-session open "
+          "(phase=job)")
+histogram("pbs_plus_ingest_stage_seconds",
+          "Batched ingest dispatch per stage (cdc/sha/probe/presketch)")
+histogram("pbs_plus_chunk_cache_fetch_seconds",
+          "Chunk-cache miss loads (disk read + decompress + verify)")
+histogram("pbs_plus_sync_batch_seconds",
+          "Sync membership negotiation and chunk transfer, per batch")
+histogram("pbs_plus_mux_frame_write_seconds",
+          "Mux frame write incl. transport drain (slow readers surface "
+          "in the tail)")
 
 
 class MetricsRegistry:
@@ -522,4 +704,8 @@ class MetricsRegistry:
         gauge("pbs_plus_db_bytes", "SQLite database size",
               [({}, float(s.db.file_size()))])
         gauge("pbs_plus_scrape_timestamp", "Scrape time", [({}, time.time())])
+        # -- latency histograms (utils/trace.py span closes; ISSUE 12) ------
+        hist_block = render_histograms()
+        if hist_block:
+            lines.append(hist_block)
         return "\n".join(lines) + "\n"

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 
+from ..utils import trace
 from ..utils.log import L
 from . import database
 
@@ -87,8 +88,10 @@ def enqueue_sync(server, row: dict) -> bool:
     async def execute():
         while getattr(server, "_gc_active", False):   # never write mid-GC
             await asyncio.sleep(0.5)
+        # trace.wrap: the sync engine's negotiate/transfer spans on the
+        # executor thread parent under this job's span
         report = await asyncio.get_running_loop().run_in_executor(
-            None, lambda: run_sync_job(server, row))
+            None, trace.wrap(lambda: run_sync_job(server, row)))
         server.last_sync_stats[sid] = report
         server.db.record_sync_result(sid, database.STATUS_SUCCESS, report)
         server.db.append_task_log(

@@ -42,7 +42,7 @@ from typing import TYPE_CHECKING
 
 from aiohttp import web
 
-from ..utils import fsio
+from ..utils import fsio, trace
 from ..utils.log import L
 from ..utils.singleflight import SingleFlight
 from . import database
@@ -113,6 +113,19 @@ class RateLimiter:
             return False
         self._buckets[key] = (tokens - 1.0, now)
         return True
+
+
+def traces_payload(n: "str | int | None" = None,
+                   trace_id: "str | None" = None) -> list:
+    """The traces endpoint's answer, split out so the span ring contract
+    is testable without standing up the TLS/web stack."""
+    try:
+        limit = min(int(n), 10_000) if n is not None else 256
+    except (TypeError, ValueError):
+        limit = 256
+    if limit <= 0:
+        return []
+    return trace.recent(limit, trace_id=trace_id or None)
 
 
 def build_app(server: "Server", *, require_auth: bool = True) -> web.Application:
@@ -558,6 +571,13 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
             "tasks": len(asyncio.all_tasks()),
         })
 
+    async def traces(request):
+        """The trace ring (docs/observability.md): closed spans, oldest
+        first.  ``?trace=<id>`` filters to one trace, ``?n=`` bounds the
+        answer (default 256 — the ring itself is the hard cap)."""
+        return web.json_response({"data": traces_payload(
+            request.query.get("n"), request.query.get("trace"))})
+
     _profile_lock = asyncio.Lock()
 
     async def debug_profile(request):
@@ -769,6 +789,7 @@ def build_app(server: "Server", *, require_auth: bool = True) -> web.Application
     app.router.add_get("/api2/json/d2d/snapshot-zip", snapshot_zip)
     app.router.add_get("/plus/debug/tasks", debug_tasks)
     app.router.add_get("/plus/debug/stats", debug_stats)
+    app.router.add_get("/api2/json/d2d/traces", traces)
     app.router.add_post("/plus/debug/profile", debug_profile)
     app.router.add_post("/api2/json/d2d/mount", mount_create)
     app.router.add_get("/api2/json/d2d/mount", mount_list)

@@ -26,7 +26,7 @@ import grpc
 
 from ..chunker import observe
 from ..chunker.spec import ChunkerParams
-from ..utils import codec, conf, failpoints
+from ..utils import codec, conf, failpoints, trace
 from ..utils.log import L
 from ..utils.resilience import CircuitBreaker, retry_sync
 
@@ -64,8 +64,9 @@ class SidecarClient:
 
         def once() -> dict:
             failpoints.hit("sidecar.call")
-            return codec.decode_map(fn(codec.encode(req),
-                                       timeout=self.timeout_s))
+            with trace.span("sidecar.call", method=method):
+                return codec.decode_map(fn(codec.encode(req),
+                                           timeout=self.timeout_s))
 
         def guarded() -> dict:
             return self.breaker.call_sync(once)

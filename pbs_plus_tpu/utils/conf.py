@@ -77,6 +77,7 @@ ENV_VARS = {
     "PBS_PLUS_MAX_QUEUED_JOBS": "jobs-queue bound (QueueFullError past it)",
     "PBS_PLUS_SYNC_BATCH": "digests per sync membership-negotiation batch",
     "PBS_PLUS_FAILPOINTS": "arm failpoints at import (site=action@trig;…)",
+    "PBS_PLUS_TRACE_RING": "trace ring capacity (closed spans retained)",
     "PBS_PLUS_LOCKWATCH": "runtime lock-order witness (utils/lockwatch.py)",
     "PBS_PLUS_BOOTSTRAP_URL": "operator: agent bootstrap endpoint",
     "PBS_PLUS_BOOTSTRAP_TOKEN": "operator: bootstrap bearer token",

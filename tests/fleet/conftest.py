@@ -1,0 +1,21 @@
+"""Fleet-suite chaos dump: a failed soak/chaos test appends the trace
+ring's last spans to its pytest report (ISSUE 12 satellite) — CI
+failures arrive with the job traces that led up to the assert, not just
+the assert message."""
+
+import pytest
+
+from pbs_plus_tpu.utils import trace
+
+_DUMP_SPANS = 50
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when == "call" and rep.failed:
+        text = trace.dump_text(_DUMP_SPANS)
+        if text:
+            rep.sections.append(
+                (f"last {_DUMP_SPANS} spans (trace ring)", text))

@@ -15,12 +15,68 @@ import os
 
 import pytest
 
+from pbs_plus_tpu.server import metrics
 from pbs_plus_tpu.server.fleetsim import FleetConfig, run_fleet
+from pbs_plus_tpu.utils import trace
 
 FULL = bool(os.environ.get("PBS_PLUS_FLEET"))
 
 
+def _assert_traced(rep, n_agents: int, d: dict) -> None:
+    """ISSUE 12 acceptance over the soak: (a) the report's percentiles
+    derive from the shared /metrics histograms, (b) at least one
+    complete job trace nests enqueue→grant→session-open→per-stage
+    ingest→publish with agent-side spans parented via mux metadata."""
+    # (a) /metrics exports the histograms the report derived from
+    expo = metrics.render_histograms()
+    assert 'pbs_plus_job_enqueue_to_publish_seconds_bucket{' in expo
+    assert 'pbs_plus_session_open_seconds_bucket{' in expo
+    h = metrics.HISTOGRAMS["pbs_plus_job_enqueue_to_publish_seconds"]
+    key = (("kind", "backup"),)
+    now = h.snapshot()[key]
+    base = rep.hist_baseline[
+        "pbs_plus_job_enqueue_to_publish_seconds"].get(key, {"count": 0})
+    # every published backup fed exactly one observation this soak
+    assert now["count"] - base["count"] == d["published"]
+
+    # (b) one complete, correctly-nested job trace in the ring
+    by_trace: dict = {}
+    for r in trace.recent():
+        by_trace.setdefault(r["trace"], {})[r["span"]] = r
+    want = {"job", "job.queue_wait", "job.execute", "backup.session_open",
+            "backup.publish", "ingest.cdc", "ingest.sha"}
+    complete = 0
+    for spans in by_trace.values():
+        names = {s["name"] for s in spans.values()}
+        if not want <= names:
+            continue
+        agent_side = [s for s in spans.values()
+                      if s["name"] == "rpc.serve"
+                      and s.get("attrs", {}).get("method",
+                                                 "").startswith("agentfs.")]
+        if not agent_side:
+            continue
+        root = next(s for s in spans.values() if s["name"] == "job")
+        assert root["parent"] == ""
+        for s in spans.values():
+            if s["name"] in ("job.queue_wait", "job.execute"):
+                assert s["parent"] == root["span"]
+        execute = next(s for s in spans.values()
+                       if s["name"] == "job.execute")
+        for s in spans.values():
+            if s["name"] in ("backup.session_open", "backup.publish"):
+                assert s["parent"] == execute["span"]
+        # agent-side agentfs serves parent under the server-side job
+        # trace — the context crossed the mux in the call metadata
+        for s in agent_side:
+            assert s["parent"] in spans
+        complete += 1
+    assert complete >= 1, (
+        f"no complete job trace among {len(by_trace)} traces in the ring")
+
+
 def _soak(tmp_path, n_agents: int) -> dict:
+    trace.clear()       # ring assertions below cover THIS soak only
     cfg = FleetConfig(n_agents=n_agents, tenants=8, max_concurrent=8,
                       max_queued=2 * n_agents)
     rep = run_fleet(str(tmp_path / "ds"), cfg)
@@ -30,10 +86,12 @@ def _soak(tmp_path, n_agents: int) -> dict:
     assert d["published"] == n_agents, rep.failures
     assert not rep.failures
 
-    # latency percentiles are measured and ordered
+    # latency percentiles are measured and ordered — derived from the
+    # shared /metrics histograms (bucket-diff quantiles, ISSUE 12; the
+    # per-job completion count is pinned against the histogram in
+    # _assert_traced, not a duplicate sample list)
     assert 0 < d["enqueue_to_publish_p50_s"] <= d["enqueue_to_publish_p99_s"]
     assert 0 < d["session_open_p50_s"] <= d["session_open_p99_s"]
-    assert len(rep.enq_to_pub_s) == n_agents
 
     # bounded queues held their bounds throughout (sampler witness +
     # mux-internal counters: no flow violations, no SYN sheds needed)
@@ -50,6 +108,8 @@ def _soak(tmp_path, n_agents: int) -> dict:
     # mux throughput measured over real frames
     assert d["mux_frames_total"] > 10 * n_agents
     assert d["mux_frames_per_s"] > 0
+
+    _assert_traced(rep, n_agents, d)
     return d
 
 

@@ -372,3 +372,27 @@ def test_bench_sync():
     # an unchanged group re-syncs with zero transfer, zero wire bytes
     assert res["resync_chunks"] == 0
     assert res["resync_wire_bytes"] == 0
+
+
+def test_bench_observability():
+    """Tracing overhead benchmark (bench._observability_bench →
+    detail.observability in the bench JSON) with the ISSUE 12 gates:
+    span open/close < 5 µs disarmed (no subscriber), histogram record
+    well under the span cost, and tracing-on pipelined ingest ≥ 0.97x
+    tracing-off — always-on tracing must be invisible next to real
+    work."""
+    import bench
+
+    res = bench._observability_bench(mib=48 if FULL else 16)
+    print(f"\n  observability: span {res['span_overhead_ns']:7.0f} ns"
+          f" | span+hist {res['span_hist_overhead_ns']:7.0f} ns"
+          f" | record {res['hist_record_ns']:6.0f} ns"
+          f" | ingest on/off {res['on_vs_off']:.4f}"
+          f" ({res['ingest_on_mib_s']}/{res['ingest_off_mib_s']} MiB/s)")
+    # the disarmed-span bound (the failpoints <5µs discipline)
+    assert res["span_overhead_ns"] < 5000, res
+    # a histogram-feeding close stays the same order of magnitude
+    assert res["span_hist_overhead_ns"] < 10000, res
+    assert res["hist_record_ns"] < 5000, res
+    # always-on tracing costs < 3% of pipelined ingest throughput
+    assert res["on_vs_off"] >= 0.97, res

@@ -40,7 +40,7 @@ def test_registry_has_expected_rules():
         "thread-hygiene", "resource-ctx", "mutable-default",
         "failpoint-discipline", "cache-discipline",
         "bounded-queue-discipline", "index-discipline",
-        "delta-discipline", "sync-discipline",
+        "delta-discipline", "sync-discipline", "span-discipline",
     }
     assert set(program_rule_names()) == {
         "guarded-by", "lock-order",
@@ -1888,3 +1888,147 @@ def test_lock_order_startup_mu_vocab_site_enters_graph():
     vocabs = [a[3] for fn in s.functions.values()
               for a in fn["acquires"]]
     assert "jobs.startup-mu" in vocabs
+
+
+# ------------------------------------------------- span-discipline
+
+
+def test_span_discipline_bare_span_call_flagged():
+    v = run_lint("""
+        from pbs_plus_tpu.utils import trace
+
+        def f():
+            sp = trace.span("job")
+            sp.__enter__()
+    """, rules={"span-discipline"})
+    assert names(v) == ["span-discipline"]
+    assert "with" in v[0].message
+
+
+def test_span_discipline_nonliteral_names_flagged():
+    v = run_lint("""
+        from pbs_plus_tpu.utils import trace
+
+        def f(name):
+            with trace.span(name):
+                pass
+            trace.record("mux." + "write_frame", 1e-6)
+    """, rules={"span-discipline"})
+    assert names(v) == ["span-discipline", "span-discipline"]
+    assert all("literal" in x.message for x in v)
+
+
+def test_span_discipline_with_and_oneshot_usage_clean():
+    # names come from the real docs/observability.md catalog
+    v = run_lint("""
+        from pbs_plus_tpu.utils import trace
+
+        def f(ctx):
+            with trace.span("job", kind="backup"):
+                with trace.attached(ctx), trace.span("ingest.sha",
+                                                     chunks=3):
+                    pass
+            trace.emit("ingest.cdc", 0.25, aggregated=True)
+            trace.record("mux.write_frame", 1e-6)
+    """, rules={"span-discipline"})
+    assert v == []
+
+
+def test_span_discipline_undocumented_name_flagged():
+    v = run_lint("""
+        from pbs_plus_tpu.utils import trace
+
+        def f():
+            with trace.span("no.such.span"):
+                pass
+    """, rules={"span-discipline"})
+    assert names(v) == ["span-discipline"]
+    assert "observability.md" in v[0].message
+
+
+def test_span_discipline_trace_module_itself_exempt():
+    v = run_lint("""
+        import trace
+
+        def helper(name):
+            return trace.span(name)
+    """, path="pbs_plus_tpu/utils/trace.py", rules={"span-discipline"})
+    assert v == []
+
+
+# ----------------------------------- registry-consistency: spans/hists
+
+
+def _span_tree(registry, documented, user_src):
+    trace_src = ("SPANS = {\n"
+                 + "".join(f'    "{n}": None,\n' for n in registry)
+                 + "}\n")
+    rows = "\n".join(f"| `{n}` | x |" for n in documented)
+    return {
+        "pbs_plus_tpu/utils/trace.py": trace_src,
+        "docs/observability.md": f"# spans\n\n| Span | Meaning |\n"
+                                 f"|---|---|\n{rows}\n",
+        "pbs_plus_tpu/user.py": user_src,
+    }
+
+
+def test_registry_span_literal_not_declared_flagged(tmp_path):
+    v = _analyze(tmp_path, _span_tree(
+        ["known.span"], ["known.span"], """
+        from pbs_plus_tpu.utils import trace
+
+        def f():
+            with trace.span("known.span"):
+                trace.record("mystery.span", 1.0)
+    """), "registry-consistency")
+    assert [x.rule for x in v] == ["registry-consistency"]
+    assert "mystery.span" in v[0].message
+    assert v[0].path == "pbs_plus_tpu/user.py"
+
+
+def test_registry_span_orphan_declaration_flagged(tmp_path):
+    v = _analyze(tmp_path, _span_tree(
+        ["known.span", "dead.span"], ["known.span", "dead.span"], """
+        from pbs_plus_tpu.utils import trace
+
+        def f():
+            with trace.span("known.span"):
+                pass
+    """), "registry-consistency")
+    assert [x.rule for x in v] == ["registry-consistency"]
+    assert "dead.span" in v[0].message and "no trace.span" in v[0].message
+
+
+def test_registry_span_doc_sync_both_directions(tmp_path):
+    v = _analyze(tmp_path, _span_tree(
+        ["known.span", "undoc.span"], ["known.span", "ghost.span"], """
+        from pbs_plus_tpu.utils import trace
+
+        def f():
+            with trace.span("known.span"):
+                pass
+            trace.emit("undoc.span", 0.1)
+    """), "registry-consistency")
+    msgs = sorted(x.message for x in v)
+    assert len(v) == 2
+    assert any("undoc.span" in m and "missing from" in m for m in msgs)
+    assert any("ghost.span" in m and "does not declare" in m for m in msgs)
+
+
+def test_registry_histograms_join_the_metric_check(tmp_path):
+    files = {
+        "pbs_plus_tpu/server/metrics.py": """
+            def render(gauge, histogram):
+                gauge("pbs_plus_g", "h", [({}, 1.0)])
+                histogram("pbs_plus_h_doc", "h")
+                histogram("pbs_plus_h_nodoc", "h")
+                histogram("pbs_plus_g", "h")
+        """,
+        "docs/metrics.md": ("| `pbs_plus_g` | x |\n"
+                            "| `pbs_plus_h_doc` | x |\n"),
+    }
+    v = _analyze(tmp_path, files, "registry-consistency")
+    msgs = sorted(x.message for x in v)
+    assert len(v) == 2, msgs
+    assert any("pbs_plus_h_nodoc" in m and "metrics.md" in m for m in msgs)
+    assert any("pbs_plus_g" in m and "registered twice" in m for m in msgs)

@@ -46,7 +46,7 @@ from dataclasses import dataclass, field
 
 from .core import REPO_ROOT, Violation, iter_py_files
 
-CACHE_VERSION = 4
+CACHE_VERSION = 5
 CACHE_PATH = os.path.join(REPO_ROOT, "build", "pbslint",
                           "graph-cache.json")
 
@@ -116,6 +116,12 @@ class FileSummary:
     env_registry: list = field(default_factory=list)    # ENV_VARS keys
     env_registry_line: int = 0
     gauges: list = field(default_factory=list)  # [name|None, line, empty?]
+    # histogram("name", ...) registrations in server/metrics.py
+    hists: list = field(default_factory=list)           # [name|None, line]
+    # trace.span/emit/record call sites: [name|None, line, api]
+    span_literals: list = field(default_factory=list)
+    span_registry: list = field(default_factory=list)   # trace.SPANS keys
+    span_registry_line: int = 0
     suppress: dict = field(default_factory=dict)        # line -> [rules]
     file_suppress: list = field(default_factory=list)
 
@@ -123,17 +129,20 @@ class FileSummary:
         return {k: getattr(self, k) for k in (
             "path", "module", "imports", "from_imports", "classes",
             "functions", "module_guarded", "module_locks", "env_literals",
-            "env_registry", "env_registry_line", "gauges", "suppress",
-            "file_suppress")}
+            "env_registry", "env_registry_line", "gauges", "hists",
+            "span_literals", "span_registry", "span_registry_line",
+            "suppress", "file_suppress")}
 
     @classmethod
     def from_dict(cls, d: dict) -> "FileSummary":
         s = cls(path=d["path"], module=d["module"])
         for k in ("imports", "from_imports", "classes", "functions",
                   "module_guarded", "module_locks", "env_literals",
-                  "env_registry", "gauges", "file_suppress"):
+                  "env_registry", "gauges", "hists", "span_literals",
+                  "span_registry", "file_suppress"):
             setattr(s, k, d[k])
         s.env_registry_line = d.get("env_registry_line", 0)
+        s.span_registry_line = d.get("span_registry_line", 0)
         # JSON stringifies int keys
         s.suppress = {int(k): v for k, v in d["suppress"].items()}
         return s
@@ -352,6 +361,15 @@ class _Extractor(ast.NodeVisitor):
                     self._registry_span = (
                         node.lineno,
                         node.value.end_lineno or node.lineno)
+                if isinstance(t, ast.Name) and t.id == "SPANS" and \
+                        isinstance(node.value, ast.Dict) and \
+                        self.s.path.endswith("utils/trace.py"):
+                    # the span-name registry (registry-consistency)
+                    self.s.span_registry = [
+                        k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)]
+                    self.s.span_registry_line = node.lineno
         for t in node.targets:
             self._note_target(t, node.value, node.lineno, node.end_lineno)
         self._record_stores(node.targets)
@@ -410,6 +428,20 @@ class _Extractor(ast.NodeVisitor):
                      and isinstance(node.args[2], ast.List)
                      and not node.args[2].elts)
             self.s.gauges.append([lit, node.lineno, empty])
+        if name == "histogram" and node.args and \
+                self.s.path.endswith("server/metrics.py"):
+            first = node.args[0]
+            lit = first.value if isinstance(first, ast.Constant) and \
+                isinstance(first.value, str) else None
+            self.s.hists.append([lit, node.lineno])
+        if name is not None and "." in name:
+            recv, _, api = name.rpartition(".")
+            if api in ("span", "emit", "record") and \
+                    recv.lstrip("_") == "trace" and node.args:
+                first = node.args[0]
+                lit = first.value if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str) else None
+                self.s.span_literals.append([lit, node.lineno, api])
         self.generic_visit(node)
 
     def visit_Constant(self, node: ast.Constant) -> None:

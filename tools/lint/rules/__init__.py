@@ -26,6 +26,7 @@ from .cache_discipline import CacheDiscipline
 from .bounded_queue import BoundedQueueDiscipline
 from .index_discipline import IndexDiscipline
 from .delta_discipline import DeltaDiscipline
+from .span_discipline import SpanDiscipline
 from .sync_discipline import SyncDiscipline
 
 RULE_CLASSES = [
@@ -44,6 +45,7 @@ RULE_CLASSES = [
     IndexDiscipline,
     DeltaDiscipline,
     SyncDiscipline,
+    SpanDiscipline,
 ]
 
 

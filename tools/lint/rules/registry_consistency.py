@@ -14,8 +14,18 @@ Metrics: every gauge registered in ``server/metrics.py`` must use a
 literal, globally-unique ``pbs_plus_*`` name, carry a non-empty sample
 source, and appear in the ``docs/metrics.md`` table — and every
 ``pbs_plus_*`` row in that table must correspond to a registered gauge.
+``histogram(...)`` registrations (ISSUE 12) join the same closed set:
+literal, unique across gauges+histograms, documented.
 Test/bench-only knobs (``PBS_PLUS_FLEET``, ``PBS_PLUS_BENCH*``, ...)
 live outside the product tree and are exempt by construction.
+
+Spans: every ``trace.span/emit/record`` literal in the product tree
+must be a key of ``utils/trace.py``'s ``SPANS`` registry, every
+registry key must be used at some call site, and both directions must
+agree with the ``docs/observability.md`` span table — the
+failpoint-catalog discipline applied to measurement points (the
+per-file ``span-discipline`` rule handles non-literal names and bare
+``span()`` calls).
 """
 
 from __future__ import annotations
@@ -27,11 +37,16 @@ from ..graph import Program, ProgramRule
 
 CONF_SUFFIX = "utils/conf.py"
 METRICS_SUFFIX = "server/metrics.py"
+TRACE_SUFFIX = "utils/trace.py"
 PRODUCT_PREFIX = "pbs_plus_tpu/"
 ENV_DOC = os.path.join("docs", "configuration.md")
 METRICS_DOC = os.path.join("docs", "metrics.md")
+SPAN_DOC = os.path.join("docs", "observability.md")
 
 _METRIC_ROW_RE = re.compile(r"^\|\s*`(pbs_plus_[a-z0-9_]+)`")
+# span-table rows: backticked lowercase dotted-or-plain names that are
+# NOT metric names (`job`, `ingest.sha`, ...) in the first column
+_SPAN_ROW_RE = re.compile(r"^\|\s*`([a-z][a-z0-9_.]*)`")
 # exact backticked occurrences only: a plain-text substring must not
 # count (PBS_PLUS_CHUNKER would otherwise ride on _CHUNKER_BACKEND's row)
 _ENV_DOC_RE = re.compile(r"`(PBS_PLUS_[A-Z0-9_]+)`")
@@ -63,6 +78,11 @@ class RegistryConsistency(ProgramRule):
                         and s.path.startswith(PRODUCT_PREFIX)), None)
         if metrics is not None:
             self._check_metrics(program, metrics, out)
+        tracer = next((s for s in program.files.values()
+                       if s.path.endswith(TRACE_SUFFIX)
+                       and s.path.startswith(PRODUCT_PREFIX)), None)
+        if tracer is not None:
+            self._check_spans(program, tracer, out)
         return out
 
     # -- env ---------------------------------------------------------------
@@ -123,6 +143,29 @@ class RegistryConsistency(ProgramRule):
                 if m:
                     doc_names.add(m.group(1))
         seen: dict[str, int] = {}
+        for name, line in metrics.hists:
+            if name is None:
+                program.report(
+                    out, self, metrics.path, line,
+                    "histogram registered with a non-literal name — "
+                    "metric names must be string literals so the "
+                    "registry stays greppable and documentable")
+                continue
+            if not name.startswith("pbs_plus_"):
+                program.report(
+                    out, self, metrics.path, line,
+                    f"metric `{name}` must carry the pbs_plus_ prefix")
+            if name in seen:
+                program.report(
+                    out, self, metrics.path, line,
+                    f"metric `{name}` registered twice (first at line "
+                    f"{seen[name]}) — names must be unique")
+            seen.setdefault(name, line)
+            if doc is not None and name not in doc_names:
+                program.report(
+                    out, self, metrics.path, line,
+                    f"metric `{name}` is missing from the "
+                    "docs/metrics.md table")
         for name, line, empty in metrics.gauges:
             if name is None:
                 program.report(
@@ -163,3 +206,58 @@ class RegistryConsistency(ProgramRule):
                     out, self, metrics.path, 1,
                     f"docs/metrics.md documents `{name}` but no such "
                     "gauge is registered in server/metrics.py")
+
+    # -- spans ---------------------------------------------------------------
+    def _check_spans(self, program: Program, tracer, out) -> None:
+        registry = set(tracer.span_registry)
+        reg_line = tracer.span_registry_line or 1
+        if not registry:
+            program.report(
+                out, self, tracer.path, reg_line,
+                "no SPANS registry found in utils/trace.py — declare "
+                "every span name there (docs/observability.md)")
+            return
+        doc = self._doc_text(program, SPAN_DOC)
+        doc_names: set[str] = set()
+        if doc is not None:
+            for line in doc.splitlines():
+                m = _SPAN_ROW_RE.match(line.strip())
+                if m and not m.group(1).startswith("pbs_plus_"):
+                    doc_names.add(m.group(1))
+        referenced: set[str] = set()
+        for s in program.files.values():
+            if not s.path.startswith(PRODUCT_PREFIX):
+                continue
+            for name, line, _api in s.span_literals:
+                if name is None:
+                    continue        # span-discipline owns non-literals
+                referenced.add(name)
+                if name not in registry:
+                    program.report(
+                        out, self, s.path, line,
+                        f"span name `{name}` is not declared in "
+                        "utils/trace.py SPANS — add it (with its "
+                        "histogram feed) and document it in "
+                        "docs/observability.md")
+        if doc is None:
+            program.report(
+                out, self, tracer.path, reg_line,
+                "docs/observability.md is missing — the SPANS registry "
+                "must be documented there")
+        for name in sorted(registry - referenced):
+            program.report(
+                out, self, tracer.path, reg_line,
+                f"SPANS declares `{name}` but no trace.span/emit/record "
+                "site in the product tree uses it — remove the entry or "
+                "instrument the site")
+        if doc is not None:
+            for name in sorted(registry - doc_names):
+                program.report(
+                    out, self, tracer.path, reg_line,
+                    f"SPANS entry `{name}` is missing from the "
+                    "docs/observability.md span table")
+            for name in sorted(doc_names - registry):
+                program.report(
+                    out, self, tracer.path, reg_line,
+                    f"docs/observability.md documents span `{name}` but "
+                    "utils/trace.py SPANS does not declare it")
