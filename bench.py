@@ -266,6 +266,193 @@ def _observability_bench(mib: int = 48) -> dict:
     }
 
 
+def _ingest_fusion_bench(mib_per_session: float = 1.0,
+                         session_counts: tuple = (1, 8, 32)) -> dict:
+    """Fused cross-session ingest vs per-session staged (ISSUE 13 /
+    ROADMAP item 2, docs/data-plane.md "Fused ingest"): batched-stage
+    dispatches per flushed chunk at N concurrent sessions — the
+    fleetsim data-plane shape, N writer threads over ONE shared
+    dedup-indexed store.  "Dispatch" = one entry into a batched stage
+    implementation (CDC scan / SHA-256 / index probe / presketch — the
+    pack/dispatch/unpack boundary).  The staged baseline counts every
+    per-session stage call via wrappers; the fused path reads the
+    ops.ingest + ingestbatch counters.  Cuts and digests are asserted
+    bit-identical in-run, per session.  The ≥3x dispatch reduction at
+    N=32 is gated in tests/test_bench_harness.py; N=1 is reported
+    honestly (fusion trades per-flush stage deferral for the bounded
+    flush deadline, so a lone session pays MORE stage dispatches)."""
+    import hashlib
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+    from pbs_plus_tpu.chunker import ChunkerParams, CpuChunker
+    from pbs_plus_tpu.ops import ingest as ingest_ops
+    from pbs_plus_tpu.pxar import ingestbatch
+    from pbs_plus_tpu.pxar.datastore import ChunkStore
+    from pbs_plus_tpu.pxar.ingestbackend import IngestCapabilities
+    from pbs_plus_tpu.pxar.similarityindex import SimilarityIndex
+    from pbs_plus_tpu.pxar.transfer import _ChunkedStream
+
+    params = ChunkerParams(avg_size=16 << 10)
+    feed = 128 << 10
+    rng = np.random.default_rng(13)
+
+    class _CountingChunker(CpuChunker):
+        calls = 0
+
+        def _scan(self, data, prefix, global_offset):
+            type(self).calls += 1
+            return super()._scan(data, prefix, global_offset)
+
+    class _CountingStore:
+        """Counting proxy over the shared store: probe/presketch
+        dispatch counters + declared capabilities passthrough."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.probe_calls = 0
+            self.presketch_calls = 0
+
+        def ingest_capabilities(self):
+            return self._inner.ingest_capabilities()
+
+        def probe_batch(self, digests):
+            self.probe_calls += 1
+            return self._inner.probe_batch(digests)
+
+        def presketch_batch(self, digests, chunks, known):
+            self.presketch_calls += 1
+            return self._inner.presketch_batch(digests, chunks, known)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    sha_calls = [0]
+
+    def counting_hasher(chunks):
+        sha_calls[0] += 1
+        return [hashlib.sha256(c).digest() for c in chunks]
+
+    def payloads_for(n):
+        return [rng.integers(0, 256, int(mib_per_session * (1 << 20)),
+                             dtype=np.uint8).tobytes() for _ in range(n)]
+
+    per_n = {}
+    for n in session_counts:
+        payloads = payloads_for(n)
+        total_bytes = sum(len(p) for p in payloads)
+
+        # -- staged baseline: N sessions, each its own 4-stage ladder --
+        tmp1 = tempfile.mkdtemp(prefix="pbs-ingest-staged-")
+        tmp2 = tempfile.mkdtemp(prefix="pbs-ingest-fused-")
+        try:
+            inner1 = ChunkStore(tmp1)
+            inner1.similarity = SimilarityIndex()
+            store1 = _CountingStore(inner1)
+            assert store1.ingest_capabilities() == IngestCapabilities(
+                probe=True, presketch=True)
+            _CountingChunker.calls = 0
+            sha_calls[0] = 0
+            staged_records = []
+            t0 = time.perf_counter()
+            for p in payloads:
+                st = _ChunkedStream(store1, params,
+                                    chunker_factory=_CountingChunker,
+                                    batch_hasher=counting_hasher)
+                for i in range(0, len(p), feed):
+                    st.write(p[i:i + feed])
+                staged_records.append(st.finish())
+            dt_staged = time.perf_counter() - t0
+            staged_dispatches = (_CountingChunker.calls + sha_calls[0]
+                                 + store1.probe_calls
+                                 + store1.presketch_calls)
+            chunks_total = sum(len(r) for r in staged_records)
+
+            # -- fused: same payloads, N writer threads, one collector --
+            inner2 = ChunkStore(tmp2)
+            inner2.similarity = SimilarityIndex()
+            coll = ingestbatch.IngestCollector(inner2, max_wait=0.05)
+            ops_base = dict(ingest_ops.stats)
+            ib_base = ingestbatch.metrics_snapshot()
+            fused_records: list = [None] * n
+            errors: list = []
+
+            def run(k):
+                try:
+                    fu = ingestbatch.FusedIngestStream(inner2, params,
+                                                       coll)
+                    p = payloads[k]
+                    for i in range(0, len(p), feed):
+                        fu.write(p[i:i + feed])
+                    fused_records[k] = fu.finish()
+                except BaseException as e:     # surfaced after join
+                    errors.append(e)
+
+            threads = [threading.Thread(target=run, args=(k,))
+                       for k in range(n)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt_fused = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            ib_now = ingestbatch.metrics_snapshot()
+            fused_dispatches = (
+                ingest_ops.stats["scan_dispatches"]
+                - ops_base["scan_dispatches"]
+                + ingest_ops.stats["sha_dispatches"]
+                - ops_base["sha_dispatches"]
+                + ib_now["probe_dispatches"] - ib_base["probe_dispatches"]
+                + ib_now["presketch_dispatches"]
+                - ib_base["presketch_dispatches"])
+            packed = ib_now["bytes_packed"] - ib_base["bytes_packed"]
+            padding = ib_now["padding_bytes"] - ib_base["padding_bytes"]
+            flushes = ib_now["flushes"] - ib_base["flushes"]
+            sessions_packed = (ib_now["sessions_packed"]
+                               - ib_base["sessions_packed"])
+
+            parity = fused_records == staged_records
+            assert parity, "fused vs staged cut/digest divergence"
+            staged_dpc = staged_dispatches / chunks_total
+            fused_dpc = fused_dispatches / chunks_total
+            per_n[str(n)] = {
+                "chunks": chunks_total,
+                "staged_dispatches": staged_dispatches,
+                "fused_dispatches": fused_dispatches,
+                "staged_dispatches_per_chunk": round(staged_dpc, 5),
+                "fused_dispatches_per_chunk": round(fused_dpc, 5),
+                "dispatch_reduction": round(staged_dpc / fused_dpc, 2)
+                if fused_dpc else 0.0,
+                "flushes": flushes,
+                "mean_sessions_per_flush": round(sessions_packed
+                                                 / flushes, 2)
+                if flushes else 0.0,
+                "occupancy": round(packed / (packed + padding), 4)
+                if packed + padding else 0.0,
+                "staged_mib_s": round(total_bytes / (1 << 20)
+                                      / dt_staged, 1),
+                "fused_mib_s": round(total_bytes / (1 << 20)
+                                     / dt_fused, 1),
+                "parity": parity,
+            }
+        finally:
+            shutil.rmtree(tmp1, ignore_errors=True)
+            shutil.rmtree(tmp2, ignore_errors=True)
+
+    top = str(max(session_counts))
+    return {
+        "mib_per_session": mib_per_session,
+        "per_n": per_n,
+        "dispatch_reduction_at_max_n": per_n[top]["dispatch_reduction"],
+        "occupancy_at_max_n": per_n[top]["occupancy"],
+        "parity": all(v["parity"] for v in per_n.values()),
+    }
+
+
 def _resume_bench(mib: int = 64) -> dict | None:
     """Crash-at-50% resume benchmark (docs/data-plane.md "Checkpointed
     resumable backups"): back a tree up with per-file checkpointing,
@@ -1138,6 +1325,13 @@ def main() -> None:
         obs = None
     if obs is not None:
         result["detail"]["observability"] = obs
+    try:
+        ing = _ingest_fusion_bench()
+    except Exception as e:
+        sys.stderr.write(f"[bench] ingest fusion bench unavailable: {e}\n")
+        ing = None
+    if ing is not None:
+        result["detail"]["ingest"] = ing
     result["machine"] = _machine_context()
     print(json.dumps(result))
 

@@ -71,16 +71,31 @@ class BackupSession:
             pipeline_workers=(getattr(store, "pipeline_workers", 0)
                               if pipeline_workers is None
                               else pipeline_workers),
+            # cross-session fused ingest: one collector per chunk store
+            # = one batching domain shared by every concurrent session
+            # (pxar/ingestbatch.py; PBS_PLUS_FUSED_INGEST)
+            ingest_collector=store.ingest_collector(),
             # PBS layout ⇒ stock pxar v2 entries so PBS tools can decode
             # the archive content too, not just serve its chunks/indexes
             entry_codec="pxar2" if store.datastore.pbs_format else "tpxar",
         )
-        store.datastore.ensure_group_dir(ref)   # ns chain (PBS chown 34)
-        self._final_dir = store.datastore.snapshot_dir(ref)
-        # unique staging dir: concurrent same-second sessions must never
-        # share (or rmtree) each other's in-progress state
-        self._tmp_dir = f"{self._final_dir}.tmp.{os.getpid()}.{id(self):x}"
-        os.makedirs(self._tmp_dir)
+        try:
+            store.datastore.ensure_group_dir(ref)   # ns chain (PBS chown 34)
+            self._final_dir = store.datastore.snapshot_dir(ref)
+            # unique staging dir: concurrent same-second sessions must
+            # never share (or rmtree) each other's in-progress state
+            self._tmp_dir = f"{self._final_dir}.tmp.{os.getpid()}." \
+                            f"{id(self):x}"
+            os.makedirs(self._tmp_dir)
+        except BaseException:
+            # the writer may hold pipeline threads and a fused-ingest
+            # collector registration (process-lifetime) — a failed
+            # session open must release both, not leak them
+            try:
+                self.writer.close()
+            except Exception as e:
+                L.debug("writer close during failed session open: %s", e)
+            raise
         self._done = False
 
     @property
@@ -187,7 +202,8 @@ class LocalStore:
                  dedup_index_mb: "int | None" = None,
                  delta_tier: "bool | None" = None,
                  delta_threshold: "int | None" = None,
-                 delta_max_chain: "int | None" = None):
+                 delta_max_chain: "int | None" = None,
+                 fused_ingest: "bool | None" = None):
         self.datastore = Datastore(base_dir, pbs_format=pbs_format,
                                    store_shards=store_shards,
                                    dedup_index_mb=dedup_index_mb,
@@ -200,6 +216,18 @@ class LocalStore:
         # >=1 pipelines each session's payload stream (pxar/pipeline.py);
         # 0 keeps the sequential writer (cut/digest output is identical)
         self.pipeline_workers = pipeline_workers
+        if fused_ingest is None:
+            from ..utils import conf as _conf
+            fused_ingest = _conf.env().fused_ingest
+        self.fused_ingest = bool(fused_ingest)
+
+    def ingest_collector(self):
+        """The store-wide cross-session fused-ingest collector, or None
+        when the fused path is disabled (pxar/ingestbatch.py)."""
+        if not self.fused_ingest:
+            return None
+        from .ingestbatch import collector_for
+        return collector_for(self.datastore.chunks)
 
     def start_session(self, *, backup_type: str, backup_id: str,
                       backup_time: float | None = None,

@@ -313,6 +313,15 @@ class ChunkStore:
             return None
         return self.index.probe_batch(digests)
 
+    def ingest_capabilities(self):
+        """Declared batched-ingest surface (pxar/ingestbackend.py): the
+        answer tracks the LIVE index/similarity attachments, so a store
+        that gains a shared similarity index after construction starts
+        presketching on the next flush."""
+        from .ingestbackend import IngestCapabilities
+        return IngestCapabilities(probe=self.index is not None,
+                                  presketch=self._sim is not None)
+
     def on_disk_many(self, digests: "list[bytes]") -> "list[bool]":
         """Batched disk-TRUE existence (``on_disk`` over a whole batch
         in ONE call).  The sync engine's sanctioned membership fallback
@@ -539,7 +548,10 @@ class ChunkStore:
         sim = self._sim
         data_b = data if isinstance(data, bytes) else bytes(data)
         sketch = sim.take_sketch(digest, data_b)
-        cand = sim.candidate(sketch, exclude=digest)
+        # candidate selection consumes the batched preselect computed by
+        # presketch (one vectorized Hamming pass per hash batch) and
+        # falls back to a live pool walk for inline writers
+        cand = sim.take_candidate(digest, sketch, exclude=digest)
         if cand is None:
             sim.add(digest, sketch, 0)
             return False

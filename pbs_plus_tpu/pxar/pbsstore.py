@@ -389,6 +389,13 @@ class PBSChunkSink:
     def touch(self, digest: bytes) -> None:
         pass                            # server-side GC owns chunk liveness
 
+    def ingest_capabilities(self):
+        """Declared batched-ingest surface (pxar/ingestbackend.py):
+        membership lives server-side behind ``known`` — no batched
+        probe or presketch exists on the push wire."""
+        from .ingestbackend import NO_CAPABILITIES
+        return NO_CAPABILITIES
+
 
 class PBSReaderSource:
     """ChunkStore-shaped ``.get(digest)`` over a PBS *reader* session —

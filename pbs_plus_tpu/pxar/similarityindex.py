@@ -149,6 +149,11 @@ class SimilarityIndex:
         # digest -> sketch precomputed by the batched presketch pass,
         # consumed by the per-chunk insert that follows
         self._pending: dict[bytes, int] = {}           # guarded-by: self._lock
+        # digest -> (pool digests, distances, pool set) precomputed by
+        # the batched candidate preselect (one locked pass + one
+        # vectorized popcount per hash batch — the delta-ENCODE half of
+        # the fused ingest batch, ISSUE 13); consumed by take_candidate
+        self._pending_cand: dict = {}                  # guarded-by: self._lock
         METRICS.register(self)
 
     def __len__(self) -> int:
@@ -176,11 +181,145 @@ class SimilarityIndex:
         with self._lock:
             for (d, _c), s in zip(todo, sketches):
                 self._pending[d] = int(s)
+            # batched delta-candidate preselect rides the same locked
+            # pass: one vectorized Hamming computation for the whole
+            # batch instead of a per-chunk pool walk at insert time
+            self._precandidate_locked([d for d, _ in todo],
+                                      [int(s) for s in sketches])
             # writers abandon pending sketches when an insert races a
-            # dedup hit; cap the stash so it can never grow unbounded
+            # dedup hit; cap the stashes so they can never grow unbounded
             while len(self._pending) > 4096:
                 self._pending.pop(next(iter(self._pending)))
+            while len(self._pending_cand) > 4096:
+                self._pending_cand.pop(next(iter(self._pending_cand)))
         return len(todo)
+
+    def _precandidate_locked(self, digests: "list[bytes]",
+                             sketches: "list[int]") -> None:
+        """Stash each novel chunk's candidate pool + exact Hamming
+        distances (caller holds the lock).  The pool is gathered in
+        ``candidate()``'s iteration order (band buckets, then the
+        recency window) and distances for ALL pool members of ALL batch
+        chunks are computed in one ``np.bitwise_count`` pass; entries
+        are immutable after ``add``, so stashed distances stay valid
+        for the entries that remain live at consume time."""
+        pools: "list[list[tuple[bytes, int]]]" = []
+        for d, sk in zip(digests, sketches):
+            seen: set = set()
+            pool: "list[tuple[bytes, int]]" = []
+            for key in self._band_keys(sk):
+                for cd in self._bands.get(key, ()):
+                    if cd == d or cd in seen:
+                        continue
+                    seen.add(cd)
+                    ent = self._entries.get(cd)
+                    if ent is not None:
+                        pool.append((cd, ent[0]))
+            for cd in self._recent:
+                if cd == d or cd in seen:
+                    continue
+                seen.add(cd)
+                ent = self._entries.get(cd)
+                if ent is not None:
+                    pool.append((cd, ent[0]))
+            pools.append(pool)
+        flat = sum(len(p) for p in pools)
+        if flat:
+            a = np.fromiter(
+                (sk for sk, pool in zip(sketches, pools)
+                 for _ in pool), dtype=np.uint64, count=flat)
+            b = np.fromiter(
+                (s for pool in pools for _, s in pool),
+                dtype=np.uint64, count=flat)
+            dists = np.bitwise_count(a ^ b).astype(np.int64)
+        else:
+            dists = np.empty(0, dtype=np.int64)
+        k = 0
+        for d, pool in zip(digests, pools):
+            n = len(pool)
+            self._pending_cand[d] = (
+                [cd for cd, _ in pool], dists[k:k + n],
+                {cd for cd, _ in pool})
+            k += n
+
+    def take_candidate(self, digest: bytes, sketch: int, *,
+                       exclude: bytes = b"") -> "tuple[bytes, int] | None":
+        """``candidate()`` with the batched preselect consumed: stashed
+        pool distances are reused (the vectorized popcount paid once per
+        batch), then the LIVE band buckets and recency window are
+        re-walked for anything the stash predates — so the pool examined
+        is always a superset of what a live ``candidate()`` walk would
+        see, including bases inserted earlier in the same hash batch
+        (even ones already rotated out of the recency window: their band
+        rows are live).  Depth/liveness are re-read live.  Falls back to
+        a full ``candidate()`` walk when no stash exists (inline/
+        per-chunk writers)."""
+        with self._lock:
+            stash = self._pending_cand.pop(digest, None)
+        if stash is None:
+            return self.candidate(sketch, exclude=exclude)
+        pool, dists, pool_set = stash
+        METRICS.add("probes")
+        best: "tuple[int, bytes, int] | None" = None
+        rejected_depth = False
+        examined = 0
+        with self._lock:
+            for cd, dist in zip(pool, dists):
+                if cd == exclude:
+                    continue
+                ent = self._entries.get(cd)
+                if ent is None:
+                    continue
+                examined += 1
+                dist = int(dist)
+                if dist > self.threshold:
+                    continue
+                if ent[1] + 1 > self.max_chain:
+                    rejected_depth = True
+                    continue
+                if best is None or dist < best[0]:
+                    best = (dist, cd, ent[1])
+            # post-stash adds: everything candidate() would see live —
+            # this chunk's band buckets plus the recency window —
+            # distance-checked inline for members the stash predates
+            # (typically zero, a handful during an active batch).
+            # Walked in candidate()'s own deterministic order (bands,
+            # then recent); on exact distance ties the stashed pool
+            # still wins over a post-stash add — the one residual
+            # tie-break divergence vs a fully-live walk.
+            fresh_seen: set = set()
+            fresh: "list[bytes]" = []
+            for key in self._band_keys(sketch):
+                for cd in self._bands.get(key, ()):
+                    if cd not in fresh_seen:
+                        fresh_seen.add(cd)
+                        fresh.append(cd)
+            for cd in self._recent:
+                if cd not in fresh_seen:
+                    fresh_seen.add(cd)
+                    fresh.append(cd)
+            for cd in fresh:
+                if cd == digest or cd == exclude or cd in pool_set:
+                    continue
+                ent = self._entries.get(cd)
+                if ent is None:
+                    continue
+                examined += 1
+                dist = int(bin(ent[0] ^ sketch).count("1"))
+                if dist > self.threshold:
+                    continue
+                if ent[1] + 1 > self.max_chain:
+                    rejected_depth = True
+                    continue
+                if best is None or dist < best[0]:
+                    best = (dist, cd, ent[1])
+        if examined:
+            METRICS.add("candidates", examined)
+        if rejected_depth and best is None:
+            METRICS.add("chain_rejects")
+        if best is None:
+            return None
+        return best[1], best[2]
 
     def take_sketch(self, digest: bytes, chunk: bytes) -> int:
         """The sketch for one chunk: precomputed by ``presketch`` when
@@ -258,9 +397,11 @@ class SimilarityIndex:
             ent = self._entries.pop(digest, None)
             if ent is None:
                 self._pending.pop(digest, None)
+                self._pending_cand.pop(digest, None)
                 return False
             self._unband(digest, ent[0])
             self._pending.pop(digest, None)
+            self._pending_cand.pop(digest, None)
             try:
                 self._recent.remove(digest)
             except ValueError:

@@ -374,6 +374,33 @@ def test_bench_sync():
     assert res["resync_wire_bytes"] == 0
 
 
+def test_bench_ingest_fusion():
+    """Fused cross-session ingest benchmark (bench._ingest_fusion_bench
+    → detail.ingest) with the ISSUE 13 acceptance gates: at N=32
+    concurrent sessions, batched-stage dispatches per flushed chunk
+    drop ≥3x fused vs per-session staged, cuts/digests bit-identical
+    in-run at every N, and ragged packing occupancy ≥0.9."""
+    import bench
+
+    res = bench._ingest_fusion_bench(
+        mib_per_session=1.0 if FULL else 0.5,
+        session_counts=(1, 8, 32))
+    print()
+    for n, row in res["per_n"].items():
+        print(f"  ingest fusion N={n:>2}: staged "
+              f"{row['staged_dispatches_per_chunk']:.4f} disp/chunk | "
+              f"fused {row['fused_dispatches_per_chunk']:.4f} "
+              f"({row['dispatch_reduction']}x) | "
+              f"{row['mean_sessions_per_flush']} sessions/flush | "
+              f"occupancy {row['occupancy']}")
+    assert res["parity"] is True
+    assert res["dispatch_reduction_at_max_n"] >= 3.0, res
+    assert res["occupancy_at_max_n"] >= 0.9, res
+    # the packing actually happened: mean sessions per flush at N=32
+    # must be well past a per-session dispatch pattern
+    assert res["per_n"]["32"]["mean_sessions_per_flush"] >= 4.0, res
+
+
 def test_bench_observability():
     """Tracing overhead benchmark (bench._observability_bench →
     detail.observability in the bench JSON) with the ISSUE 12 gates:

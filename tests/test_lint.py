@@ -41,6 +41,7 @@ def test_registry_has_expected_rules():
         "failpoint-discipline", "cache-discipline",
         "bounded-queue-discipline", "index-discipline",
         "delta-discipline", "sync-discipline", "span-discipline",
+        "ingest-discipline",
     }
     assert set(program_rule_names()) == {
         "guarded-by", "lock-order",
@@ -202,6 +203,65 @@ def test_sync_discipline_out_of_scope_clean():
         def has(self, digest):
             return self.index.contains(digest)
     """, path="pbs_plus_tpu/pxar/datastore.py", rules=["sync-discipline"])
+    assert v == []
+
+
+# ------------------------------------------------- ingest-discipline
+
+
+def test_ingest_discipline_flags_getattr_duck_typing():
+    v = run_lint("""
+        def probe_known(self, digests):
+            probe = getattr(self.store, "probe_batch", None)
+            if probe is None:
+                return None
+            return probe(digests)
+    """, path="pbs_plus_tpu/pxar/transfer.py", rules=["ingest-discipline"])
+    assert names(v) == ["ingest-discipline"]
+    assert "DECLARED capability" in v[0].message
+
+
+def test_ingest_discipline_flags_per_stage_store_call():
+    v = run_lint("""
+        def flush(self, digests, chunks):
+            known = self.store.probe_batch(digests)
+            self.store.presketch_batch(digests, chunks, known)
+    """, path="pbs_plus_tpu/pxar/pipeline.py", rules=["ingest-discipline"])
+    assert names(v) == ["ingest-discipline", "ingest-discipline"]
+    assert "per-stage store call" in v[0].message
+
+
+def test_ingest_discipline_flags_direct_fingerprint_kernel():
+    v = run_lint("""
+        from pbs_plus_tpu.ops.sha256 import sha256_chunks
+
+        def flush(self, chunks):
+            return sha256_chunks(chunks)
+    """, path="pbs_plus_tpu/pxar/transfer.py", rules=["ingest-discipline"])
+    assert names(v) == ["ingest-discipline"]
+    assert "batch_hasher" in v[0].message
+
+
+def test_ingest_discipline_declared_backend_clean():
+    v = run_lint("""
+        def flush(self, digests, chunks):
+            backend = self._ingest
+            known = None
+            if backend.capabilities.probe:
+                known = backend.probe_batch(digests)
+            if backend.capabilities.presketch:
+                backend.presketch_batch(digests, chunks, known)
+            return known
+    """, path="pbs_plus_tpu/pxar/transfer.py", rules=["ingest-discipline"])
+    assert v == []
+
+
+def test_ingest_discipline_scoped_to_stream_modules():
+    # the collector and the sync plane legitimately call probe_batch
+    v = run_lint("""
+        def negotiate(self, digests):
+            return self.store.probe_batch(digests)
+    """, path="pbs_plus_tpu/pxar/syncwire.py", rules=["ingest-discipline"])
     assert v == []
 
 

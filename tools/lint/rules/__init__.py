@@ -26,6 +26,7 @@ from .cache_discipline import CacheDiscipline
 from .bounded_queue import BoundedQueueDiscipline
 from .index_discipline import IndexDiscipline
 from .delta_discipline import DeltaDiscipline
+from .ingest_discipline import IngestDiscipline
 from .span_discipline import SpanDiscipline
 from .sync_discipline import SyncDiscipline
 
@@ -46,6 +47,7 @@ RULE_CLASSES = [
     DeltaDiscipline,
     SyncDiscipline,
     SpanDiscipline,
+    IngestDiscipline,
 ]
 
 
