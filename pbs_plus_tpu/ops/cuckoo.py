@@ -111,6 +111,13 @@ class CuckooIndex:
         self._dirty = True
         self._known: set[bytes] = set()       # authoritative
         self._rng = np.random.default_rng(seed)
+        # filter-only mode (the spillable exact tier, pxar/digestlog.py):
+        # membership truth lives OUTSIDE this object, `_known` stays
+        # empty, `_n_fp` counts resident fingerprints for the growth
+        # trigger, and growth rebuilds stream every live digest back
+        # from the attached source instead of an in-RAM set
+        self._n_fp = 0
+        self._digest_source = None
 
     # -- host authoritative ----------------------------------------------
     def __len__(self) -> int:
@@ -179,13 +186,14 @@ class CuckooIndex:
         an upload."""
         return lookup_host(self._table, digests)
 
-    def _insert_fp(self, fp0: int, fp1: int, b1: int, b2: int) -> None:
+    def _insert_fp(self, fp0: int, fp1: int, b1: int, b2: int,
+                   *, grow: bool = True) -> bool:
         for b in (b1, b2):
             row = self._table[b]
             for s in range(SLOTS):
                 if row[s, 0] == 0 and row[s, 1] == 0:
                     row[s] = (fp0, fp1)
-                    return
+                    return True
         # eviction chain
         b = b1
         cur = np.array([fp0, fp1], dtype=np.uint32)
@@ -201,17 +209,90 @@ class CuckooIndex:
             for s2 in range(SLOTS):
                 if row[s2, 0] == 0 and row[s2, 1] == 0:
                     row[s2] = cur
-                    return
+                    return True
+        if not grow:
+            # mid-rebuild overflow: the rebuild loop doubles and retries
+            # from a fresh source pass (the displaced fingerprint is
+            # re-placed there — its digest is in the source)
+            return False
         self._grow()
-        # re-place the displaced fingerprint after growth
-        mask = self.n_buckets - 1
-        # cannot recover its true b1 (bidx lost) — rebuild covers all knowns,
-        # so nothing else to do: _grow() reinserted every known digest
-        _ = mask
+        # nothing left to re-place: _grow()'s rebuild covered every
+        # digest (the in-RAM set, or the attached source — callers add
+        # the digest to the source BEFORE inserting its fingerprint)
+        return True
 
     def _grow(self) -> None:
         self.n_buckets *= 2
         self._rebuild_bulk()
+
+    # -- filter-only surface (spillable exact tier) ------------------------
+    def attach_digest_source(self, source) -> None:
+        """Enter filter-only mode: ``source()`` must yield every LIVE
+        digest (pxar/digestlog.py's merged view) — growth rebuilds
+        stream it instead of an in-RAM ``_known`` set."""
+        self._digest_source = source
+
+    def maybe_contains(self, digest: bytes) -> bool:
+        """Scalar filter lookup (maybe-present; the caller confirms a
+        positive against the exact tier before any dedup skip)."""
+        fp0, fp1, b1, b2 = self._fp_bucket(digest)
+        for b in (b1, b2):
+            row = self._table[b]
+            for s in range(SLOTS):
+                if row[s, 0] == fp0 and row[s, 1] == fp1:
+                    return True
+        return False
+
+    def insert_fp(self, digest: bytes) -> None:
+        """Insert ONE fingerprint (filter-only mode; caller already
+        recorded the digest in the exact tier, so a growth rebuild
+        finds it in the source)."""
+        self._n_fp += 1
+        if self._n_fp > self.n_buckets * SLOTS * 0.85:
+            self._grow()
+        else:
+            fp0, fp1, b1, b2 = self._fp_bucket(digest)
+            self._insert_fp(fp0, fp1, b1, b2)
+        self._dirty = True
+
+    def insert_fp_many(self, digests: "list[bytes]") -> None:
+        """Bulk fingerprint insert (filter-only mode): group-wise free
+        slot placement, eviction chains only for the overflow tail —
+        the ``insert_many`` machinery without the membership set."""
+        if not digests:
+            return
+        self._n_fp += len(digests)
+        grew = False
+        while self._n_fp > self.n_buckets * SLOTS * 0.85:
+            self.n_buckets *= 2
+            grew = True
+        if grew or self._table.shape[0] != self.n_buckets:
+            self._rebuild_bulk()       # source already holds the batch
+        else:
+            arr = np.frombuffer(b"".join(digests),
+                                dtype=np.uint8).reshape(-1, 32)
+            nb = self.n_buckets
+            for i in self._place_bulk(arr):
+                fp0, fp1, b1, b2 = self._fp_bucket(digests[int(i)])
+                self._insert_fp(fp0, fp1, b1, b2)
+                if self.n_buckets != nb:
+                    break              # the growth rebuild placed the rest
+        self._dirty = True
+
+    def discard_fp(self, digest: bytes) -> None:
+        """Zero the fingerprint slot (filter-only mode).  A twin digest
+        sharing the fp+bucket pair degrades to a safe false negative,
+        exactly like ``discard``."""
+        self._n_fp = max(0, self._n_fp - 1)
+        fp0, fp1, b1, b2 = self._fp_bucket(digest)
+        for b in (b1, b2):
+            row = self._table[b]
+            for s in range(SLOTS):
+                if row[s, 0] == fp0 and row[s, 1] == fp1:
+                    row[s] = (0, 0)
+                    self._dirty = True
+                    return
+        self._dirty = True
 
     def insert_many(self, digests: list[bytes]) -> int:
         """Bulk insert, vectorized: one numpy pass computes every
@@ -292,20 +373,44 @@ class CuckooIndex:
         return np.flatnonzero(remaining)
 
     def _rebuild_bulk(self) -> None:
-        """Zero the mirror at the current ``n_buckets`` and re-place every
-        known digest with the vectorized path (bulk twin of ``_grow``)."""
-        self._table = np.zeros((self.n_buckets, SLOTS, 2), dtype=np.uint32)
-        known = list(self._known)
-        if not known:
-            return
+        """Zero the mirror at the current ``n_buckets`` and re-place
+        every known digest with the vectorized path (bulk twin of
+        ``_grow``).  In filter-only mode the digests stream from the
+        attached source in bounded batches — 10⁹ fingerprints rebuild
+        without ever materializing the digest set in RAM.  A placement
+        overflow mid-rebuild doubles the table and retries from a fresh
+        source pass (no nested-grow recursion)."""
+        while True:
+            self._table = np.zeros((self.n_buckets, SLOTS, 2),
+                                   dtype=np.uint32)
+            if self._place_all():
+                return
+            self.n_buckets *= 2
+
+    def _place_all(self) -> bool:
+        if self._known or self._digest_source is None:
+            src = iter(self._known)
+        else:
+            src = self._digest_source()
+            self._n_fp = 0             # recounted as the stream places
+        batch: list[bytes] = []
+        for d in src:
+            batch.append(d)
+            if len(batch) == (1 << 19):
+                if not self._place_batch(batch):
+                    return False
+                batch.clear()
+        return self._place_batch(batch) if batch else True
+
+    def _place_batch(self, known: "list[bytes]") -> bool:
+        if self._digest_source is not None and not self._known:
+            self._n_fp += len(known)
         arr = np.frombuffer(b"".join(known), dtype=np.uint8).reshape(-1, 32)
-        nb = self.n_buckets
         for i in self._place_bulk(arr):
             fp0, fp1, b1, b2 = self._fp_bucket(known[int(i)])
-            self._insert_fp(fp0, fp1, b1, b2)
-            if self.n_buckets != nb:
-                # a nested grow already re-placed every known digest
-                break
+            if not self._insert_fp(fp0, fp1, b1, b2, grow=False):
+                return False
+        return True
 
     # -- device probe -----------------------------------------------------
     def device_table(self) -> jax.Array:

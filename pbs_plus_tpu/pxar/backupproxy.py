@@ -200,6 +200,7 @@ class LocalStore:
                  pipeline_workers: int = 0,
                  store_shards: "int | None" = None,
                  dedup_index_mb: "int | None" = None,
+                 dedup_resident_mb: "int | None" = None,
                  delta_tier: "bool | None" = None,
                  delta_threshold: "int | None" = None,
                  delta_max_chain: "int | None" = None,
@@ -207,6 +208,7 @@ class LocalStore:
         self.datastore = Datastore(base_dir, pbs_format=pbs_format,
                                    store_shards=store_shards,
                                    dedup_index_mb=dedup_index_mb,
+                                   dedup_resident_mb=dedup_resident_mb,
                                    delta_tier=delta_tier,
                                    delta_threshold=delta_threshold,
                                    delta_max_chain=delta_max_chain)

@@ -47,6 +47,18 @@ digests and re-ran the probe for all hot digests.
 Conf: ``PBS_PLUS_DEDUP_INDEX_MB`` (utils/conf.py; 0 disables the
 index) sizes the initial filter table; the filter still grows under
 load-factor pressure, and the resident-bytes gauge reports actuals.
+
+Spillable exact tier (ISSUE 14): with a ``spill_dir`` the confirm set
+no longer lives in RAM — a bounded memtable (``resident_mb``, the
+``PBS_PLUS_DEDUP_RESIDENT_MB`` knob) spills to immutable sorted
+segments under ``<store>/.chunkindex/segments/`` (pxar/digestlog.py),
+so the resident cost is the filter table + memtable + fence pointers
+regardless of chunk count.  The probe discipline is unchanged: a
+filter NEGATIVE never touches the log (all-novel backups stay
+disk-free), a positive pays one fence-guided ``pread``; the
+``.chunkindex`` snapshot becomes a thin consume-once manifest over the
+live segments.  ``PBS_PLUS_DEDUP_RESIDENT_MB=0`` keeps the PR 8
+all-RAM confirm set.
 """
 
 from __future__ import annotations
@@ -59,6 +71,10 @@ import weakref
 from typing import Iterable, Iterator, Sequence
 
 import numpy as np
+
+from .digestlog import FLAG_DATABLOB as _DATABLOB
+from .digestlog import FLAG_TOMBSTONE as _TOMB
+from .digestlog import MAN_MAGIC as _MAN_MAGIC
 
 SNAP_MAGIC = b"TPXI"
 SNAP_VERSION = 1
@@ -131,7 +147,17 @@ class DedupIndex:
     authoritative, so answers are EXACT — the filter's job is making
     the batched no-answer cheap and device-dispatchable."""
 
-    def __init__(self, *, budget_mb: int = 64, seed: int = 0):
+    def __init__(self, *, budget_mb: int = 64, seed: int = 0,
+                 spill_dir: "str | None" = None,
+                 resident_mb: int = 256):
+        """``spill_dir`` (the store's ``.chunkindex`` dir) activates the
+        SPILLABLE exact-confirm tier (ISSUE 14, pxar/digestlog.py): the
+        confirm set lives in a bounded memtable (``resident_mb``, the
+        PBS_PLUS_DEDUP_RESIDENT_MB knob) backed by immutable sorted
+        on-disk segments, so resident cost stops scaling with the chunk
+        count.  Without it the exact set stays fully in RAM (the PR 8
+        behavior — bare indexes in tests, and the
+        PBS_PLUS_DEDUP_RESIDENT_MB=0 escape hatch)."""
         from ..ops.cuckoo import CuckooIndex, buckets_for_bytes
         self._lock = threading.RLock()
         # the filter + exact set are ONE coherent unit under _lock: a
@@ -140,6 +166,19 @@ class DedupIndex:
             n_buckets=buckets_for_bytes(max(1, int(budget_mb)) << 20),
             seed=seed)
         self._datablob: set[bytes] = set()          # guarded-by: self._lock
+        # bound once at construction, never reassigned — the log's own
+        # contents are mutated only under self._lock (plus its internal
+        # lock against the background compactor)
+        self._log = None
+        if spill_dir is not None:
+            from .digestlog import DigestLog
+            self._log = DigestLog(
+                os.path.join(spill_dir, "segments"),
+                budget_bytes=max(1, int(resident_mb)) << 20)
+            # growth rebuilds stream the live digests back from the log
+            # (mutation order contract: the log learns a digest BEFORE
+            # its fingerprint lands, so a rebuild can never lose one)
+            self._cuckoo.attach_digest_source(self._log.iter_live_digests)
         # boot state lives ON the index (not the owning store) so
         # stores SHARING one index — the server's per-job
         # chunker-override store — share one boot: whoever probes
@@ -176,8 +215,22 @@ class DedupIndex:
     #    reading _cuckoo/_datablob lock-free while rebuild/load_snapshot
     #    swap them out; _lock is an RLock, so re-entry from locked
     #    callers stays cheap) ----------------------------------------------
+    @property
+    def spillable(self) -> bool:
+        """True when the exact-confirm tier spills to disk segments."""
+        return self._log is not None
+
+    @property
+    def digestlog(self):
+        """The attached DigestLog (None in all-RAM mode) — tests and
+        the bench read its counters; nothing else may reach past it to
+        the segment files (pbslint ``index-discipline``)."""
+        return self._log
+
     def __len__(self) -> int:
         with self._lock:
+            if self._log is not None:
+                return self._log.live_count
             return len(self._cuckoo)
 
     @property
@@ -192,21 +245,42 @@ class DedupIndex:
 
     @property
     def resident_bytes(self) -> int:
+        """ACTUAL resident cost: the filter table plus what the confirm
+        tier really holds in RAM — memtable + fence pointers when
+        spillable (the segments themselves are disk, not RAM), the
+        whole exact set only in all-RAM mode (the pre-ISSUE-14 gauge
+        assumed the latter unconditionally)."""
         with self._lock:
+            if self._log is not None:
+                return self._cuckoo._table.nbytes + self._log.resident_bytes
             return self._cuckoo._table.nbytes + _SET_ENTRY_BYTES * (
                 len(self._cuckoo) + len(self._datablob))
 
     def digests(self) -> Iterator[bytes]:
-        """Stable snapshot of the known digests (tests, persistence)."""
+        """Snapshot of the known digests (tests, persistence).  In
+        spill mode this streams the merged memtable+segment view —
+        ascending, tombstones applied."""
         with self._lock:
+            if self._log is not None:
+                return self._log.iter_live_digests()
             return iter(list(self._cuckoo._known))
 
     # -- membership --------------------------------------------------------
     def contains(self, digest: bytes) -> bool:
-        """Exact single-digest membership (the per-insert fast path —
-        a set lookup beats a scalar filter probe on the host)."""
+        """Exact single-digest membership.  All-RAM: a set lookup.
+        Spillable: the scalar filter gates — a filter NEGATIVE answers
+        without touching the log (disk-free), a positive pays one
+        confirm (memtable hit or one fence-guided ``pread``)."""
         with self._lock:
-            hit = self._cuckoo.contains_exact(digest)
+            if self._log is not None:
+                if not self._cuckoo.maybe_contains(digest):
+                    hit = False
+                else:
+                    hit = self._log.contains(digest)
+                    if not hit:
+                        METRICS.add("false_positives")
+            else:
+                hit = self._cuckoo.contains_exact(digest)
         METRICS.add("probes")
         if hit:
             METRICS.add("hits")
@@ -216,20 +290,39 @@ class DedupIndex:
         """One vectorized filter pass over the whole batch, exact-
         confirmed: digests (32-byte each) → [present?].  Filter
         positives that fail the exact confirm are counted as false
-        positives and answered False — never a false dedup skip."""
+        positives and answered False — never a false dedup skip.  In
+        spill mode only the filter POSITIVES reach the log (negatives
+        stay structurally disk-free), sorted once so every segment is
+        probed in one ascending sweep."""
         if not digests:
             return []
         arr = np.frombuffer(b"".join(digests),
                             dtype=np.uint8).reshape(-1, 32)
         with self._lock:
-            # .tolist() up front: iterating a numpy bool array yields
-            # np.bool_ objects and is ~10x slower than plain bools on
-            # this hot loop
-            maybe = self._probe_arr(arr).tolist()
-            known = self._cuckoo._known
-            out = [m and d in known for m, d in zip(maybe, digests)]
-        hits = out.count(True)
-        fps = maybe.count(True) - hits
+            if self._log is not None:
+                maybe = self._probe_arr(arr)
+                pos = np.flatnonzero(maybe)
+                if len(pos):
+                    flags = self._log.flags_arr(digests, arr, pos)
+                    present = (flags >= 0) & \
+                        ((flags & _TOMB) == 0)
+                    out_arr = np.zeros(len(digests), dtype=bool)
+                    out_arr[pos] = present
+                    hits = int(present.sum())
+                    fps = len(pos) - hits
+                else:
+                    out_arr = np.zeros(len(digests), dtype=bool)
+                    hits = fps = 0
+                out = out_arr.tolist()
+            else:
+                # .tolist() up front: iterating a numpy bool array
+                # yields np.bool_ objects and is ~10x slower than plain
+                # bools on this hot loop
+                maybe = self._probe_arr(arr).tolist()
+                known = self._cuckoo._known
+                out = [m and d in known for m, d in zip(maybe, digests)]
+                hits = out.count(True)
+                fps = maybe.count(True) - hits
         METRICS.add("probes", len(digests))
         if hits:
             METRICS.add("hits", hits)
@@ -249,22 +342,77 @@ class DedupIndex:
     # -- mutation ----------------------------------------------------------
     def insert(self, digest: bytes) -> bool:
         with self._lock:
-            new = self._cuckoo.insert(digest)
+            if self._log is not None:
+                if self._cuckoo.maybe_contains(digest):
+                    if self._log.contains(digest):
+                        return False
+                    METRICS.add("false_positives")
+                # the log learns the digest FIRST: a filter-growth
+                # rebuild streams from it
+                self._log.add(digest)
+                self._cuckoo.insert_fp(digest)
+                new = True
+            else:
+                new = self._cuckoo.insert(digest)
         if new:
             METRICS.add("inserts")
         return new
 
     def insert_many(self, digests: Iterable[bytes]) -> int:
+        digests = list(digests)
         with self._lock:
-            n = self._cuckoo.insert_many(list(digests))
+            if self._log is not None:
+                n = 0
+                # bounded batches: the memtable budget check (and spill)
+                # runs between batches, not after a 10^7 dict build
+                for i in range(0, len(digests), 1 << 16):
+                    n += self._insert_batch_spill(digests[i:i + (1 << 16)])
+            else:
+                n = self._cuckoo.insert_many(digests)
         if n:
             METRICS.add("inserts", n)
         return n
 
+    def _insert_batch_spill(self, batch: "list[bytes]") -> int:
+        for d in batch:
+            if len(d) != 32:
+                raise ValueError(f"digest must be 32 bytes, got {len(d)}")
+        seen: set[bytes] = set()
+        uniq = [d for d in batch if not (d in seen or seen.add(d))]
+        arr = np.frombuffer(b"".join(uniq), dtype=np.uint8).reshape(-1, 32)
+        maybe = self._probe_arr(arr)
+        pos = np.flatnonzero(maybe)
+        fresh_mask = np.ones(len(uniq), dtype=bool)
+        if len(pos):
+            flags = self._log.flags_arr(uniq, arr, pos)
+            present = (flags >= 0) & ((flags & _TOMB) == 0)
+            fresh_mask[pos[present]] = False
+            fps = len(pos) - int(present.sum())
+            if fps:
+                METRICS.add("false_positives", fps)
+        fresh = [uniq[i] for i in np.flatnonzero(fresh_mask).tolist()]
+        if not fresh:
+            return 0
+        self._log.add_many(fresh)
+        self._cuckoo.insert_fp_many(fresh)
+        return len(fresh)
+
     def discard(self, digest: bytes) -> bool:
         with self._lock:
-            gone = self._cuckoo.discard(digest)
-            self._datablob.discard(digest)
+            if self._log is not None:
+                if not self._cuckoo.maybe_contains(digest):
+                    return False
+                if not self._log.contains(digest):
+                    METRICS.add("false_positives")
+                    return False
+                # tombstone BEFORE the fingerprint leaves: the failure
+                # direction stays a safe false negative either way
+                self._log.discard(digest)
+                self._cuckoo.discard_fp(digest)
+                gone = True
+            else:
+                gone = self._cuckoo.discard(digest)
+                self._datablob.discard(digest)
         if gone:
             METRICS.add("discards")
         return gone
@@ -273,64 +421,113 @@ class DedupIndex:
         return sum(1 for d in digests if self.discard(d))
 
     def rebuild(self, digests: Iterable[bytes]) -> int:
-        """Reset to exactly ``digests`` (the boot-time shard scan)."""
+        """Reset to exactly ``digests`` (the boot-time shard scan).  In
+        spill mode the stream lands straight in the log (spilling at
+        budget — the scan's sorted order makes tidy runs) while the
+        filter ingests fingerprints batch-wise."""
         from ..ops.cuckoo import CuckooIndex
         with self._lock:
-            fresh = CuckooIndex(n_buckets=self._cuckoo.n_buckets)
-            fresh.insert_many(list(digests))
-            self._cuckoo = fresh
+            if self._log is not None:
+                self._log.reset()
+                fresh = CuckooIndex(n_buckets=self._cuckoo.n_buckets)
+                fresh.attach_digest_source(self._log.iter_live_digests)
+                self._cuckoo = fresh
+                n = 0
+                batch: list[bytes] = []
+                for d in digests:
+                    batch.append(d)
+                    if len(batch) == (1 << 16):
+                        self._log.add_many(batch)
+                        fresh.insert_fp_many(batch)
+                        n += len(batch)
+                        batch = []
+                if batch:
+                    self._log.add_many(batch)
+                    fresh.insert_fp_many(batch)
+                    n += len(batch)
+            else:
+                fresh = CuckooIndex(n_buckets=self._cuckoo.n_buckets)
+                fresh.insert_many(list(digests))
+                self._cuckoo = fresh
+                n = len(fresh)
             self._datablob.clear()
-            n = len(fresh)
         METRICS.add("rebuilds")
         return n
 
     # -- pbs DataBlob knowledge (the old capped _datablob_seen) ------------
     def is_datablob(self, digest: bytes) -> bool:
         with self._lock:
+            if self._log is not None:
+                if not self._cuckoo.maybe_contains(digest):
+                    return False
+                f = self._log.flags_of(digest)
+                return f is not None and not f & _TOMB \
+                    and bool(f & _DATABLOB)
             return digest in self._datablob
 
     def mark_datablob(self, digest: bytes) -> None:
         with self._lock:
-            self._datablob.add(digest)
+            if self._log is not None:
+                self._log.set_flags(digest, _DATABLOB)
+            else:
+                self._datablob.add(digest)
 
     # -- persistence -------------------------------------------------------
+    @staticmethod
+    def _sketch_section(sketches) -> bytes:
+        shdr = _SKETCH_HDR.pack(SKETCH_MAGIC, SKETCH_VERSION, 0,
+                                len(sketches))
+        recs = b"".join(
+            _SKETCH_REC.pack(d, s & ((1 << 64) - 1), min(255, dp))
+            for d, s, dp in sketches)
+        return shdr + recs + hashlib.sha256(shdr + recs).digest()
+
     def save_snapshot(self, path: str,
                       sketches: "list[tuple[bytes, int, int]] | None"
                       = None) -> None:
-        """Atomic journaled snapshot: header + known digests + DataBlob
-        subset + sha256 trailer over the payload.  ``sketches`` — the
-        similarity tier's (digest, sketch, depth) entries — append as
-        an independently-checksummed optional section so a restarted
-        server keeps offering pre-restart delta bases (corrupt/absent
-        section → organic rebuild, main payload unaffected)."""
+        """Atomic journaled snapshot.  All-RAM: header + known digests
+        + DataBlob subset + sha256 trailer.  Spillable: the memtable
+        spills to a durable segment and the snapshot becomes a THIN
+        MANIFEST over the live segments (names + counts + per-segment
+        trailer hashes) — boot re-opens the segment fences instead of
+        re-reading every digest off the chunk store.  ``sketches`` —
+        the similarity tier's (digest, sketch, depth) entries — append
+        as an independently-checksummed optional section either way
+        (corrupt/absent section → organic rebuild, main payload
+        unaffected)."""
         with self._lock:
-            known = sorted(self._cuckoo._known)
-            blob = sorted(self._datablob)
-        payload = b"".join(known) + b"".join(blob)
-        hdr = _SNAP_HDR.pack(SNAP_MAGIC, SNAP_VERSION, 0,
-                             len(known), len(blob))
-        digest = hashlib.sha256(hdr + payload).digest()
+            if self._log is not None:
+                # quiesce the compactor first: a merge finishing between
+                # manifest_bytes() and the rename would unlink segments
+                # the manifest just listed (the boot would then fall
+                # back to the shard scan — safe, but a wasted save)
+                self._log.drain()
+                self._log.flush()
+                body = self._log.manifest_bytes()
+            else:
+                known = sorted(self._cuckoo._known)
+                blob = sorted(self._datablob)
+                payload = b"".join(known) + b"".join(blob)
+                hdr = _SNAP_HDR.pack(SNAP_MAGIC, SNAP_VERSION, 0,
+                                     len(known), len(blob))
+                body = hdr + payload + \
+                    hashlib.sha256(hdr + payload).digest()
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
-            f.write(hdr)
-            f.write(payload)
-            f.write(digest)
+            f.write(body)
             if sketches is not None:
-                shdr = _SKETCH_HDR.pack(SKETCH_MAGIC, SKETCH_VERSION, 0,
-                                        len(sketches))
-                recs = b"".join(
-                    _SKETCH_REC.pack(d, s & ((1 << 64) - 1), min(255, dp))
-                    for d, s, dp in sketches)
-                f.write(shdr)
-                f.write(recs)
-                f.write(hashlib.sha256(shdr + recs).digest())
+                f.write(self._sketch_section(sketches))
         os.replace(tmp, path)
         METRICS.add("snapshot_saves")
 
     def load_snapshot(self, path: str) -> bool:
         """Replace contents from a snapshot; False (and unchanged) on a
         missing/corrupt/truncated file — the caller then rebuilds from
-        a shard scan.  A valid trailing sketch section lands in
+        a shard scan.  A spillable index loads either format: a TPXM
+        manifest adopts the on-disk segments (fences only — no digest
+        re-read), and a LEGACY TPXI snapshot loads once and migrates
+        into segments (the digests stream through the memtable and
+        spill).  A valid trailing sketch section lands in
         ``self.loaded_sketches`` for the similarity tier; any defect
         there leaves the main load intact and the sketches None."""
         self.loaded_sketches = None
@@ -339,6 +536,8 @@ class DedupIndex:
                 raw = f.read()
         except OSError:
             return False
+        if raw[:4] == _MAN_MAGIC:
+            return self._load_manifest(raw)
         if len(raw) < _SNAP_HDR.size + 32:
             return False
         magic, ver, _, n_known, n_blob = _SNAP_HDR.unpack_from(raw)
@@ -356,12 +555,64 @@ class DedupIndex:
         blob = [raw[off + 32 * i:off + 32 * (i + 1)] for i in range(n_blob)]
         from ..ops.cuckoo import CuckooIndex
         with self._lock:
-            fresh = CuckooIndex(n_buckets=self._cuckoo.n_buckets)
-            fresh.insert_many(known)
-            self._cuckoo = fresh
-            self._datablob = set(blob)
+            if self._log is not None:
+                # legacy snapshot into a spillable index: load once,
+                # migrate to segments (the next manifest save makes the
+                # migration durable)
+                self._log.reset()
+                fresh = CuckooIndex(n_buckets=self._cuckoo.n_buckets)
+                fresh.attach_digest_source(self._log.iter_live_digests)
+                self._cuckoo = fresh
+                blob_set = set(blob)
+                for i in range(0, len(known), 1 << 16):
+                    batch = known[i:i + (1 << 16)]
+                    plain = [d for d in batch if d not in blob_set]
+                    marked = [d for d in batch if d in blob_set]
+                    if plain:
+                        self._log.add_many(plain)
+                    if marked:
+                        self._log.add_many(marked, flags=_DATABLOB)
+                    fresh.insert_fp_many(batch)
+            else:
+                fresh = CuckooIndex(n_buckets=self._cuckoo.n_buckets)
+                fresh.insert_many(known)
+                self._cuckoo = fresh
+                self._datablob = set(blob)
         self.loaded_sketches = self._parse_sketch_section(
             raw, body_end + 32)
+        METRICS.add("snapshot_loads")
+        return True
+
+    def _load_manifest(self, raw: bytes) -> bool:
+        """Adopt a TPXM segment manifest (spillable mode only — an
+        all-RAM index treats it as unloadable and the caller rebuilds
+        from the shard scan).  The filter rebuilds from one sequential
+        stream over the adopted segments; fences were already loaded by
+        the manifest adoption, so boot never re-scans the chunk
+        store."""
+        if self._log is None:
+            return False
+        from ..ops.cuckoo import CuckooIndex, SLOTS
+        with self._lock:
+            ok, consumed = self._log.load_manifest_bytes(raw)
+            if not ok:
+                return False
+            nb = self._cuckoo.n_buckets
+            count = self._log.live_count
+            while count > nb * SLOTS * 0.85:
+                nb *= 2
+            fresh = CuckooIndex(n_buckets=nb)
+            fresh.attach_digest_source(self._log.iter_live_digests)
+            self._cuckoo = fresh
+            batch: list[bytes] = []
+            for d in self._log.iter_live_digests():
+                batch.append(d)
+                if len(batch) == (1 << 18):
+                    fresh.insert_fp_many(batch)
+                    batch = []
+            if batch:
+                fresh.insert_fp_many(batch)
+        self.loaded_sketches = self._parse_sketch_section(raw, consumed)
         METRICS.add("snapshot_loads")
         return True
 

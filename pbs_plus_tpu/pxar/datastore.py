@@ -118,6 +118,7 @@ class ChunkStore:
                  n_shards: "int | None" = None,
                  index_budget_mb: "int | None" = None,
                  index=None,
+                 index_resident_mb: "int | None" = None,
                  delta_tier: "bool | None" = None,
                  delta_threshold: "int | None" = None,
                  delta_max_chain: "int | None" = None):
@@ -129,6 +130,11 @@ class ChunkStore:
         ``index``: an explicit DedupIndex (tests); else one is built
         from ``index_budget_mb`` (None → PBS_PLUS_DEDUP_INDEX_MB,
         0 → index disabled, legacy utime-probe path).
+        ``index_resident_mb`` bounds the exact-confirm tier's resident
+        cost (None → PBS_PLUS_DEDUP_RESIDENT_MB): the confirm set
+        spills to sorted segments under ``.chunkindex/segments/``
+        (pxar/digestlog.py) once the memtable crosses the budget;
+        0 keeps the whole confirm set in RAM (the pre-ISSUE-14 shape).
 
         ``delta_tier`` enables the similarity-dedup tier (ISSUE 9,
         docs/data-plane.md "Similarity tier"): novel chunks resembling a
@@ -186,7 +192,16 @@ class ChunkStore:
                   if index_budget_mb is None else index_budget_mb)
             if mb and mb > 0:
                 from .chunkindex import DedupIndex
-                index = DedupIndex(budget_mb=mb)
+                rmb = (_conf.env().dedup_resident_mb
+                       if index_resident_mb is None else index_resident_mb)
+                if rmb and rmb > 0:
+                    index = DedupIndex(
+                        budget_mb=mb,
+                        spill_dir=os.path.join(base, ".chunkindex"),
+                        resident_mb=rmb)
+                else:
+                    # resident budget 0: the PR 8 all-RAM confirm set
+                    index = DedupIndex(budget_mb=mb)
         self._index = index
         if index is not None and index_explicit:
             # a caller-supplied index is taken as-is (tests pre-seed it)
@@ -327,8 +342,13 @@ class ChunkStore:
         in ONE call).  The sync engine's sanctioned membership fallback
         for index-less destinations (pbslint rule ``sync-discipline``:
         sync code negotiates membership via ``probe_batch``/
-        ``on_disk_many``, never per-digest loops of its own)."""
-        return [os.path.exists(self._path(d)) for d in digests]
+        ``on_disk_many``, never per-digest loops of its own).  Stats
+        run in ascending digest order — adjacent digests share prefix
+        dirs, so the sweep rides the dentry cache like the digestlog's
+        sorted segment sweeps — while the answer keeps input order."""
+        present = {d: os.path.exists(self._path(d))
+                   for d in sorted(set(digests))}
+        return [present[d] for d in digests]
 
     # -- raw (compressed-as-stored) transfer surface — docs/sync.md --------
     def get_raw(self, digest: bytes) -> bytes:
@@ -536,7 +556,9 @@ class ChunkStore:
         return True
 
     def _try_delta_write(self, digest: bytes, data, p: str,
-                         shard: int) -> bool:
+                         shard: int,
+                         exclude_bases: "frozenset[bytes]"
+                         = frozenset()) -> bool:
         """Similarity-tier insert attempt for a novel chunk (caller
         holds the shard lock): sketch → banded candidate → delta encode
         against the base, written only when it actually beats a plain
@@ -552,6 +574,10 @@ class ChunkStore:
         # presketch (one vectorized Hamming pass per hash batch) and
         # falls back to a live pool walk for inline writers
         cand = sim.take_candidate(digest, sketch, exclude=digest)
+        if cand is not None and cand[0] in exclude_bases:
+            # the refold path must not re-anchor a chunk onto a base GC
+            # is about to reclaim — plain is the only safe fallback
+            cand = None
         if cand is None:
             sim.add(digest, sketch, 0)
             return False
@@ -878,6 +904,60 @@ class ChunkStore:
             frontier = nxt
             hops += 1
         return out
+
+    def refold_deltas(self, live: "set[bytes]",
+                      doomed_bases: "set[bytes]") -> int:
+        """Re-delta on GC (ISSUE 14 satellite, ROADMAP item 3): a base
+        chunk kept alive ONLY by the delta closure — every snapshot
+        that referenced it directly is pruned — would otherwise pin
+        disk forever.  For every LIVE delta whose on-disk base is in
+        ``doomed_bases``, reassemble the chunk and re-encode it WITHOUT
+        that base: against a surviving similarity candidate when the
+        tier is on (never against another doomed base), else as a plain
+        full blob.  Content is immutable — the rewrite lands tmp+rename
+        under the chunk's shard lock, same digest, so concurrent
+        readers and in-flight sessions never notice.  Returns how many
+        chunks were refolded; a chunk that fails to refold keeps its
+        delta (the caller re-closes the live set, so its base stays
+        marked — a refold failure degrades to the old keep-the-base
+        behavior, never to a dangling delta)."""
+        refolded = 0
+        exclude = frozenset(doomed_bases)
+        for d in live:
+            base = self.delta_base_of(d)
+            if base is None or base not in doomed_bases:
+                continue
+            try:
+                # `raise` here models a mid-refold crash/EIO: the delta
+                # must stay intact and GC must keep its base
+                failpoints.hit("pbsstore.delta.refold")
+                data = self.get(d)        # reassembles through the chain
+            except (OSError, ValueError, failpoints.FailpointError) as e:
+                L.warning("delta refold of %s failed: %s — keeping its "
+                          "base marked", d.hex()[:16], e)
+                continue
+            p = self._path(d)
+            shard = self.shard_of(d)
+            # the WRITE leg degrades per-chunk too: an ENOSPC/EIO here
+            # (GC often runs exactly when the disk is full) must keep
+            # this delta and let the mark+sweep proceed — aborting the
+            # whole prune would make GC unable to free a full disk
+            try:
+                with self._shard_locks[shard]:
+                    if self._sim is not None:
+                        self._sim.discard(d)   # re-sketched by the rewrite
+                    if self._sim is None or not self._try_delta_write(
+                            d, data, p, shard, exclude_bases=exclude):
+                        self._write_chunk(p, data, shard)
+            except OSError as e:
+                L.warning("delta refold write of %s failed: %s — "
+                          "keeping its base marked", d.hex()[:16], e)
+                continue
+            refolded += 1
+        if refolded:
+            from .similarityindex import METRICS as _SM
+            _SM.add("refolds", refolded)
+        return refolded
 
     def _store_may_hold_deltas(self) -> bool:
         """Tier currently off: a previous run may still have written
@@ -1214,6 +1294,7 @@ class Datastore:
     def __init__(self, base: str, *, pbs_format: bool = False,
                  store_shards: "int | None" = None,
                  dedup_index_mb: "int | None" = None,
+                 dedup_resident_mb: "int | None" = None,
                  delta_tier: "bool | None" = None,
                  delta_threshold: "int | None" = None,
                  delta_max_chain: "int | None" = None):
@@ -1233,6 +1314,7 @@ class Datastore:
                                  blob_format="pbs" if pbs_format else "zstd",
                                  n_shards=store_shards,
                                  index_budget_mb=dedup_index_mb,
+                                 index_resident_mb=dedup_resident_mb,
                                  delta_tier=delta_tier,
                                  delta_threshold=delta_threshold,
                                  delta_max_chain=delta_max_chain)

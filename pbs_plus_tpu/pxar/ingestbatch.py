@@ -293,6 +293,12 @@ class IngestCollector:
                         digests = ingest_ops.digest_chunks(chunks)
                     backend = self._backend
                     if backend.capabilities.probe:
+                        # one fused probe for EVERY session's chunks:
+                        # with the spillable exact tier this is also
+                        # the confirm-amortization unit — the index
+                        # sorts the cross-session batch once and sweeps
+                        # each digest segment ascending, so N sessions
+                        # pay one sweep, not N (pxar/digestlog.py)
                         METRICS.add("probe_dispatches")
                         with trace.span("ingest.probe",
                                         chunks=len(digests)):

@@ -70,7 +70,7 @@ class SimilarityMetrics:
 
     _COUNTERS = ("probes", "candidates", "hits", "bytes_saved",
                  "chain_rejects", "encode_fallbacks", "delta_reads",
-                 "base_resolves", "read_errors")
+                 "base_resolves", "read_errors", "refolds")
 
     def __init__(self) -> None:
         self._lock = threading.Lock()

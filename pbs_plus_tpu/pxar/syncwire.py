@@ -265,7 +265,12 @@ class LocalSyncDest:
         """ONE batched membership answer for the whole digest batch:
         the dedup index's vectorized ``probe_batch``, or the batched
         disk fallback for index-less stores — never a per-digest
-        loop in sync code (pbslint ``sync-discipline``)."""
+        loop in sync code (pbslint ``sync-discipline``).  With the
+        spillable exact tier (pxar/digestlog.py) an incremental sync's
+        mostly-present batches confirm in sorted segment sweeps inside
+        ``probe_batch`` — ~one read per touched 4 KiB block, not per
+        digest — and the novel minority stays disk-free at the
+        destination via the filter."""
         present = self.ds.chunks.probe_batch(list(digests))
         if present is None:
             present = self.ds.chunks.on_disk_many(list(digests))

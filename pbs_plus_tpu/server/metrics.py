@@ -199,6 +199,9 @@ histogram("pbs_plus_ingest_stage_seconds",
           "Batched ingest dispatch per stage (cdc/sha/probe/presketch)")
 histogram("pbs_plus_chunk_cache_fetch_seconds",
           "Chunk-cache miss loads (disk read + decompress + verify)")
+histogram("pbs_plus_digestlog_confirm_read_seconds",
+          "Spillable exact-confirm tier segment reads (one fence-guided "
+          "pread, or a bulk region read amortizing a batch sweep)")
 histogram("pbs_plus_sync_batch_seconds",
           "Sync membership negotiation and chunk transfer, per batch")
 histogram("pbs_plus_mux_frame_write_seconds",
@@ -544,9 +547,29 @@ class MetricsRegistry:
               "Digests resident across live dedup indexes",
               [({}, float(di["entries"]))])
         gauge("pbs_plus_dedup_index_resident_bytes",
-              "Estimated resident bytes of live dedup indexes (filter "
-              "table + exact host set)",
+              "Actual resident bytes of live dedup indexes: filter "
+              "table + memtable + fence pointers when the exact tier "
+              "spills to segments, filter table + whole exact set in "
+              "all-RAM mode",
               [({}, float(di["resident_bytes"]))])
+
+        # -- spillable exact-confirm tier (pxar/digestlog.py;
+        #    docs/data-plane.md "Spillable exact-confirm tier") -------------
+        from ..pxar import digestlog as _digestlog
+        dg = _digestlog.metrics_snapshot()
+        gauge("pbs_plus_digestlog_segments",
+              "Live on-disk digest segments across spillable indexes",
+              [({}, float(dg["segments"]))])
+        gauge("pbs_plus_digestlog_spills_total",
+              "Memtable spills to a new immutable segment",
+              [({}, float(dg["spills"]))])
+        gauge("pbs_plus_digestlog_compactions_total",
+              "Background segment merges completed",
+              [({}, float(dg["compactions"]))])
+        gauge("pbs_plus_digestlog_confirm_reads_total",
+              "Exact-confirm segment reads (filter positives only — an "
+              "all-novel backup performs zero)",
+              [({}, float(dg["confirm_reads"]))])
 
         # -- similarity-dedup delta tier (pxar/similarityindex.py;
         #    docs/data-plane.md "Similarity tier") ---------------------------
@@ -580,6 +603,10 @@ class MetricsRegistry:
         gauge("pbs_plus_delta_read_errors_total",
               "Delta reassemblies that failed (corrupt payload/base — "
               "raised, never served)", [({}, float(dl["read_errors"]))])
+        gauge("pbs_plus_delta_refolds_total",
+              "Live deltas folded down by GC because their base was "
+              "about to be swept (re-delta on GC)",
+              [({}, float(dl["refolds"]))])
         gauge("pbs_plus_delta_entries",
               "Sketches resident across live resemblance indexes",
               [({}, float(dl["entries"]))])

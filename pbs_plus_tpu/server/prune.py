@@ -91,13 +91,10 @@ def select_keep(snaps: list[SnapshotRef],
     return keep
 
 
-def mark_live_chunks(ds: Datastore) -> int:
-    """GC phase 1: touch every chunk referenced by any snapshot index —
-    once per unique digest (a deduplicated store shares chunks across
-    many snapshots; per-entry utime would be millions of redundant
-    syscalls).  Live backup CHECKPOINTS (server/checkpoint.py) count as
-    references too: a crashed job's resume is about to splice exactly
-    those chunks, so the sweep must never take them."""
+def _live_digest_set(ds: Datastore) -> set[bytes]:
+    """Every digest referenced DIRECTLY by a snapshot index or a live
+    backup checkpoint (server/checkpoint.py — a crashed job's resume is
+    about to splice exactly those chunks)."""
     from . import checkpoint as _checkpoint
     live: set[bytes] = set()
     for ref in ds.list_snapshots(all_namespaces=True):
@@ -109,16 +106,53 @@ def mark_live_chunks(ds: Datastore) -> int:
             for i in range(len(idx.ends)):
                 live.add(idx.digests[i].tobytes())
     live.update(_checkpoint.live_checkpoint_digests(ds))
-    # similarity tier (docs/data-plane.md "Similarity tier"): a delta
-    # blob reassembles from its base chunk, so every base a live delta
-    # (transitively) references is live too even when no snapshot index
-    # names it — the closure reads the on-disk delta headers, so it
-    # holds across restarts and with the tier since turned off
-    live = ds.chunks.delta_closure(live)
+    return live
+
+
+def refold_doomed_bases(ds: Datastore,
+                        live: "set[bytes] | None" = None) -> int:
+    """Re-delta on GC (ISSUE 14 satellite): a base alive ONLY through
+    the delta closure — no snapshot or checkpoint names it — would pin
+    disk forever.  Fold the live deltas referencing it down
+    (``ChunkStore.refold_deltas``: re-encode against a surviving base,
+    or store plain) so the sweep can reclaim it.  MUST run before the
+    mark clock is stamped: the reassembly READS the doomed bases, and
+    a relatime filesystem refreshes their atime on that read — done
+    after ``_file_clock_now`` it would shield every doomed base from
+    this run's sweep.  ``live`` lets ``run_prune`` share one snapshot-
+    index scan between the refold and the mark (the digest set cannot
+    change between them — refolds rewrite chunk ENCODINGS, never
+    digests)."""
+    if live is None:
+        live = _live_digest_set(ds)
+    doomed = ds.chunks.delta_closure(live) - live
+    if not doomed:
+        return 0
+    return ds.chunks.refold_deltas(live, doomed)
+
+
+def mark_live_chunks(ds: Datastore,
+                     live: "set[bytes] | None" = None) -> int:
+    """GC phase 1: touch every chunk referenced by any snapshot index —
+    once per unique digest (a deduplicated store shares chunks across
+    many snapshots; per-entry utime would be millions of redundant
+    syscalls) — plus live checkpoint references.  The similarity tier's
+    delta closure rides on top (docs/data-plane.md "Similarity tier"):
+    a delta blob reassembles from its base chunk, so every base a live
+    delta (transitively) references is live too even when no snapshot
+    index names it — derived from on-disk delta headers, so it holds
+    across restarts and with the tier since turned off.  A base whose
+    refold failed earlier in the run stays in the closure: the failure
+    direction is keep-the-base, never a dangling delta.  The closure is
+    always re-derived here (post-refold headers), only the direct
+    ``live`` set may be shared by the caller."""
+    if live is None:
+        live = _live_digest_set(ds)
+    closure = ds.chunks.delta_closure(live)
     # shard-parallel mark (pxar/datastore.py touch_many): per-shard
     # utime loops overlap their syscall waits
-    ds.chunks.touch_many(live)
-    return len(live)
+    ds.chunks.touch_many(closure)
+    return len(closure)
 
 
 def run_prune(ds: Datastore, policy: PrunePolicy, *,
@@ -153,12 +187,19 @@ def run_prune(ds: Datastore, policy: PrunePolicy, *,
         _checkpoint.sweep_stale(
             ds, max_age_s=_checkpoint.CKPT_MAX_AGE_S
             if ckpt_max_age_s is None else ckpt_max_age_s)
+        # re-delta on GC BEFORE the mark clock: refold's reassembly
+        # reads must land before mark_start so the doomed bases stay
+        # sweep-eligible in THIS run (see refold_doomed_bases).  The
+        # snapshot-index scan is paid ONCE and shared with the mark —
+        # digests are immutable, so a refold cannot change the set
+        live = _live_digest_set(ds)
+        refold_doomed_bases(ds, live=live)
         # mark_start must come from the FILE clock, not time.time(): the
         # kernel stamps utime with the coarse clock, which can lag the
         # precise clock by ~1 ms — a wall-clock mark would sweep chunks
         # touched immediately after it (live-chunk loss)
         mark_start = _file_clock_now(ds.chunks.base)
-        mark_live_chunks(ds)
+        mark_live_chunks(ds, live=live)
         # sweep only chunks last touched before BOTH the mark and the
         # grace cutoff — a just-inserted chunk of an in-flight session
         # is always newer than the cutoff

@@ -109,10 +109,14 @@ class ServerConfig:
     verify_workers: int = 0
     # dedup index + store sharding (pxar/chunkindex.py, docs/
     # data-plane.md "Dedup index"): memory budget of the cuckoo-filter
-    # membership front in MiB (0 disables it) and the chunk store's
+    # membership front in MiB (0 disables it), resident budget of the
+    # spillable exact-confirm memtable in MiB (pxar/digestlog.py; 0
+    # keeps the whole confirm set in RAM), and the chunk store's
     # logical shard count.  Negative values fall back to the
-    # PBS_PLUS_DEDUP_INDEX_MB / PBS_PLUS_STORE_SHARDS environment knobs
+    # PBS_PLUS_DEDUP_INDEX_MB / PBS_PLUS_DEDUP_RESIDENT_MB /
+    # PBS_PLUS_STORE_SHARDS environment knobs
     dedup_index_mb: int = -1
+    dedup_resident_mb: int = -1
     store_shards: int = -1
     # similarity-dedup delta tier (pxar/similarityindex.py +
     # pxar/deltablob.py, docs/data-plane.md "Similarity tier"):
@@ -182,6 +186,8 @@ class Server:
                           else config.store_shards),
             dedup_index_mb=(None if config.dedup_index_mb < 0
                             else config.dedup_index_mb),
+            dedup_resident_mb=(None if config.dedup_resident_mb < 0
+                               else config.dedup_resident_mb),
             delta_tier=(None if config.delta_tier < 0
                         else bool(config.delta_tier)),
             delta_threshold=(None if config.delta_threshold < 0

@@ -65,6 +65,7 @@ ENV_VARS = {
     "PBS_PLUS_CHUNK_CACHE_MB": "shared read-path chunk cache budget (MiB)",
     "PBS_PLUS_CHUNK_READAHEAD": "chunks prefetched ahead of a scan",
     "PBS_PLUS_DEDUP_INDEX_MB": "dedup-index cuckoo filter budget (MiB)",
+    "PBS_PLUS_DEDUP_RESIDENT_MB": "exact-confirm memtable budget (MiB)",
     "PBS_PLUS_STORE_SHARDS": "chunk store logical shard count",
     "PBS_PLUS_DELTA_TIER": "enable the similarity-dedup delta tier",
     "PBS_PLUS_DELTA_THRESHOLD": "max sketch Hamming distance for a base",
@@ -129,6 +130,14 @@ class Env:
     # shard count (per-shard locks + compressors; GC mark/sweep runs
     # shard-parallel)
     dedup_index_mb: int = 64
+    # spillable exact-confirm tier (pxar/digestlog.py, docs/data-plane.md
+    # "Spillable exact-confirm tier"): resident budget of the confirm
+    # memtable in MiB — past it, recent digests spill to immutable
+    # sorted segments under <store>/.chunkindex/segments/ and a confirm
+    # probe costs one fence-guided pread.  0 keeps the whole exact set
+    # in RAM (the pre-spill behavior; resident cost then scales with
+    # the chunk count, ~120-160 B/digest)
+    dedup_resident_mb: int = 256
     store_shards: int = 16
     # similarity-dedup tier (pxar/similarityindex.py + pxar/deltablob.py,
     # docs/data-plane.md "Similarity tier"): store near-duplicate chunks
@@ -202,6 +211,8 @@ def env() -> Env:
         chunk_cache_mb=_int_env(e, "PBS_PLUS_CHUNK_CACHE_MB", "256"),
         chunk_readahead=_int_env(e, "PBS_PLUS_CHUNK_READAHEAD", "4"),
         dedup_index_mb=_int_env(e, "PBS_PLUS_DEDUP_INDEX_MB", "64"),
+        dedup_resident_mb=_int_env(e, "PBS_PLUS_DEDUP_RESIDENT_MB",
+                                   "256"),
         store_shards=_int_env(e, "PBS_PLUS_STORE_SHARDS", "16"),
         delta_tier=e.get("PBS_PLUS_DELTA_TIER", "").lower()
         in ("1", "true", "yes"),

@@ -83,6 +83,9 @@ SPANS = {
                          {"stage": "presketch"}),
     # read path (pxar/chunkcache.py)
     "chunkcache.fetch": ("pbs_plus_chunk_cache_fetch_seconds", None),
+    # spillable exact-confirm tier (pxar/digestlog.py)
+    "digestlog.confirm": ("pbs_plus_digestlog_confirm_read_seconds",
+                          None),
     # replication wire (pxar/syncwire.py)
     "sync.negotiate": ("pbs_plus_sync_batch_seconds",
                        {"phase": "negotiate"}),

@@ -283,18 +283,22 @@ def test_bench_dedup_index():
     assert res["insert_per_s"] > 0 and res["negative_probe_per_s"] > 0
 
 
-def test_bench_delta_tier():
-    """Similarity-tier benchmark (bench._delta_bench → detail.delta in
-    the bench JSON) with the ISSUE 9 acceptance gate: on the synthetic
-    near-duplicate corpus (2409.06066 methodology — mutate p% of bytes
-    per generation) the tier-on dedup ratio is >= 1.5x tier-off, the
-    tier actually engaged (delta hits > 0), and restores stay
-    bit-identical."""
+def test_bench_delta_tier_real_corpus():
+    """Similarity-tier benchmark on the REAL-corpus profile (ISSUE 14
+    satellite; bench._delta_bench profile="auto" → detail.delta): the
+    base image is real file bytes and each generation applies VM-image
+    / rotated-log style mutations (2409.06066), so the >= 1.5x tier-on
+    gate measures what a user with real images would see — ON TOP of
+    whatever the exact tier already dedups.  Falls back to the
+    synthetic generator (and its gates) when no corpus seed dir can
+    supply the bytes."""
     import bench
 
-    res = bench._delta_bench(mib=16 if FULL else 6,
-                             generations=6 if FULL else 5)
-    print(f"\n  delta tier: ratio off {res['dedup_ratio_off']:5.2f}"
+    res = bench._delta_bench(mib=16 if FULL else 8,
+                             generations=6 if FULL else 5,
+                             profile="auto")
+    print(f"\n  delta tier [{res['profile']}]:"
+          f" ratio off {res['dedup_ratio_off']:5.2f}"
           f" | on {res['dedup_ratio_on']:5.2f}"
           f" ({res['on_vs_off']}x)"
           f" | hits {res['delta_hits']}/{res['delta_probes']}"
@@ -303,9 +307,73 @@ def test_bench_delta_tier():
     assert res["delta_hits"] > 0
     assert res["delta_bytes_saved"] > 0
     assert res["restore_parity"] is True
-    # off-store ratio ~1 proves every generation chunk was novel to the
-    # exact tier — the win above is the similarity tier's alone
+    if res["profile"].startswith("real-corpus"):
+        # realism evidence: the mutation stream is near-dup, not novel
+        # noise — most chunks changed (else the tier had nothing to do)
+        # but the content stayed delta-encodable
+        assert res["exact_new_chunks_off"] > 0
+    else:
+        # synthetic fallback: every generation chunk was novel to the
+        # exact tier, so the off-ratio flatlines
+        assert res["dedup_ratio_off"] < 1.2
+
+
+def test_bench_delta_tier_synthetic_fallback():
+    """The documented fallback profile (corpus seed unavailable) keeps
+    the original ISSUE 9 isolation property: scattered byte mutations
+    make every generation chunk novel to the exact tier, and the >=
+    1.5x win is the similarity tier's alone."""
+    import bench
+
+    res = bench._delta_bench(mib=6, generations=4, profile="synthetic")
+    assert res["profile"] == "synthetic-random"
+    assert res["on_vs_off"] >= 1.5, res
+    assert res["delta_hits"] > 0
+    assert res["restore_parity"] is True
     assert res["dedup_ratio_off"] < 1.2
+
+
+def test_bench_digestlog():
+    """Spillable exact-confirm tier gates (ISSUE 14 acceptance;
+    bench._digestlog_bench → detail.digestlog): indexing 10^6 digests
+    through a squeezed resident budget must (a) hold peak measured
+    resident index bytes <= 2x the configured budget, (b) keep batched
+    member-probe throughput >= 5x the per-digest stat baseline even
+    though confirms now sweep on-disk segments, and (c) perform ZERO
+    confirm reads for an all-novel probe pass — negatives never touch
+    a segment, structurally asserted by the confirm_reads counter."""
+    import bench
+
+    res = bench._digestlog_bench(n=1_000_000, stat_sample=10_000)
+    print(f"\n  digestlog n={res['digests']}:"
+          f" insert {res['insert_per_s']:>11,.0f}/s"
+          f" | probe {res['batched_probe_per_s']:>12,.0f}/s"
+          f" ({res['batched_vs_stat']}x stat)"
+          f" | resident {res['peak_resident_bytes'] >> 20} MiB"
+          f" / budget {res['resident_budget_mb']} MiB"
+          f" | spills {res['spills']} segs {res['segments']}")
+    assert res["resident_vs_budget"] <= 2.0, res
+    assert res["batched_vs_stat"] >= 5.0, res
+    assert res["novel_confirm_reads"] == 0, res
+    # the squeeze was real: the memtable actually spilled and probes
+    # actually confirmed against segments
+    assert res["spills"] > 0 and res["segments"] >= 1
+    assert res["confirm_reads_total"] > 0
+    # resident cost decoupled from digest count: far under the ~120 B/
+    # digest the all-RAM confirm set paid
+    assert res["resident_bytes_per_digest"] < 60
+
+
+@pytest.mark.slow
+def test_bench_digestlog_at_1e7():
+    """The ISSUE 14 headline scale: 10^7 digests, same three gates."""
+    import bench
+
+    res = bench._digestlog_bench(n=10_000_000, stat_sample=10_000)
+    assert res["resident_vs_budget"] <= 2.0, res
+    assert res["batched_vs_stat"] >= 5.0, res
+    assert res["novel_confirm_reads"] == 0, res
+    assert res["spills"] > 0
 
 
 def test_bench_commit_walk_refs(tmp_path):

@@ -1,0 +1,399 @@
+"""Spillable exact-confirm tier battery (ISSUE 14): segment lifecycle
+(memtable spill at budget, tmp+rename compaction atomicity, tombstone
+survival rules), the DedupIndex spill mode (zero confirm reads on
+filter negatives, GC sweep coherence, manifest boot, legacy snapshot
+migration), and the no-manifest crash fallback that keeps a stale
+segment from ever resurrecting a swept digest."""
+
+import hashlib
+import os
+import time
+
+import numpy as np
+
+from pbs_plus_tpu.pxar import chunkindex, digestlog
+from pbs_plus_tpu.pxar.chunkindex import DedupIndex
+from pbs_plus_tpu.pxar.datastore import ChunkStore
+from pbs_plus_tpu.pxar.digestlog import (FLAG_TOMBSTONE, MAN_MAGIC,
+                                         DigestLog)
+from pbs_plus_tpu.utils import failpoints
+
+
+def _digests(n: int, seed: int = 0) -> list[bytes]:
+    rng = np.random.default_rng(seed)
+    arr = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+    return [arr[i].tobytes() for i in range(n)]
+
+
+def _chunk(i: int, size: int = 512) -> tuple[bytes, bytes]:
+    data = (b"%08d" % i) * (size // 8)
+    return hashlib.sha256(data).digest(), data
+
+
+def _confirm_reads() -> int:
+    return digestlog.metrics_snapshot()["confirm_reads"]
+
+
+# ------------------------------------------------------------ DigestLog
+
+
+def test_memtable_spills_at_budget(tmp_path):
+    log = DigestLog(str(tmp_path / "segs"), budget_bytes=1 << 20)
+    m0 = digestlog.metrics_snapshot()
+    digs = _digests(12_000, seed=1)
+    for i in range(0, len(digs), 2000):
+        log.add_many(digs[i:i + 2000])
+    log.drain()
+    m1 = digestlog.metrics_snapshot()
+    assert log.segment_count >= 1
+    assert m1["spills"] > m0["spills"]
+    # memtable stayed bounded by the budget throughout
+    assert len(log._mem) * digestlog._MEM_ENTRY_BYTES < (1 << 20)
+    assert log.live_count == 12_000
+    # membership exact across memtable + segments
+    assert all(log.contains_many(digs))
+    assert not any(log.contains_many(_digests(500, seed=2)))
+
+
+def test_block_and_bulk_probe_paths_agree(tmp_path):
+    log = DigestLog(str(tmp_path / "segs"), budget_bytes=64 << 20)
+    members = sorted(_digests(2000, seed=3))
+    log.add_many(members)
+    log.flush()
+    absent = _digests(2000, seed=4)
+    # sparse path: a handful of probes -> per-block preads
+    few = members[:3] + absent[:3] + members[-3:]
+    assert log.contains_many(few) == [True] * 3 + [False] * 3 + [True] * 3
+    # dense path: the whole set -> one region read
+    allp = members + absent
+    got = log.contains_many(allp)
+    assert got == [True] * len(members) + [False] * len(absent)
+    # scalar path agrees record-for-record
+    assert log.contains(members[7]) and not log.contains(absent[7])
+
+
+def test_leading_word_collisions_resolve_exactly(tmp_path):
+    """Digests sharing their leading 8 bytes exercise the fence- and
+    record-level collision fallbacks (first-word searchsorted alone
+    cannot separate them)."""
+    rng = np.random.default_rng(5)
+    prefix = rng.integers(0, 256, 8, dtype=np.uint8).tobytes()
+    coll = sorted({prefix + rng.integers(0, 256, 24, dtype=np.uint8)
+                   .tobytes() for _ in range(400)})
+    log = DigestLog(str(tmp_path / "segs"), budget_bytes=64 << 20)
+    log.add_many(coll[:300])
+    log.flush()
+    got = log.contains_many(coll)
+    assert got == [i < 300 for i in range(len(coll))]
+    assert log.contains(coll[0]) and not log.contains(coll[350])
+
+
+def test_tombstone_survives_until_oldest_merge(tmp_path):
+    log = DigestLog(str(tmp_path / "segs"), budget_bytes=64 << 20)
+    g1, g2, g3 = (_digests(300, 6), _digests(200, 7), _digests(100, 8))
+    log.add_many(g1)
+    log.flush()
+    log.add_many(g2)
+    log.flush()
+    victim = g1[0]
+    log.discard(victim)                       # tombstone in the memtable
+    log.add_many(g3)
+    log.flush()                               # ...now in the newest run
+    assert log.segment_count == 3
+    assert not log.contains(victim)
+    # merge the two NEWEST runs: the oldest still carries the digest,
+    # so the tombstone must survive the merge
+    log._merge_pair(log._segs[1], log._segs[2])
+    assert log.segment_count == 2
+    assert not log.contains(victim)
+    recs = log._segs[1].read_records()
+    t = [i for i in range(len(recs))
+         if recs[i, :32].tobytes() == victim]
+    assert t and recs[t[0], 32] & FLAG_TOMBSTONE
+    # merge including the oldest run: tombstone AND digest both gone
+    log._merge_pair(log._segs[0], log._segs[1])
+    assert log.segment_count == 1
+    alld = {r.tobytes() for r in log._segs[0].read_records()[:, :32]}
+    assert victim not in alld
+    assert not log.contains(victim)
+    assert log.live_count == 599 == len(list(log.iter_live_digests()))
+
+
+def test_background_compaction_tiers_segments(tmp_path):
+    log = DigestLog(str(tmp_path / "segs"), budget_bytes=64 << 20)
+    m0 = digestlog.metrics_snapshot()
+    for s in range(6):
+        log.add_many(_digests(100, 20 + s))
+        log.flush()
+    assert log.segment_count == 6
+    log.compact(wait=True)
+    m1 = digestlog.metrics_snapshot()
+    assert log.segment_count < 6
+    assert m1["compactions"] > m0["compactions"]
+    assert log.live_count == 600
+    for s in range(6):
+        assert all(log.contains_many(_digests(100, 20 + s)))
+
+
+def test_crash_mid_compaction_old_segments_stay_authoritative(tmp_path):
+    log = DigestLog(str(tmp_path / "segs"), budget_bytes=64 << 20)
+    a, b = _digests(200, 30), _digests(150, 31)
+    log.add_many(a)
+    log.flush()
+    log.add_many(b)
+    log.flush()
+    names = [s.name for s in log._segs]
+    m0 = digestlog.metrics_snapshot()
+    with failpoints.armed("pbsstore.digestlog.compact", "raise"):
+        log.compact(wait=True)
+    m1 = digestlog.metrics_snapshot()
+    assert m1["compactions"] == m0["compactions"]
+    assert m1["compaction_failures"] > m0["compaction_failures"]
+    # the old pair is untouched on disk and in the live list
+    assert [s.name for s in log._segs] == names
+    for n in names:
+        assert os.path.exists(os.path.join(str(tmp_path / "segs"), n))
+    assert all(log.contains_many(a + b))
+    # and the merge completes cleanly once the fault clears
+    log.compact(wait=True)
+    assert log.segment_count == 1
+    assert all(log.contains_many(a + b))
+
+
+def test_torn_segment_rejected_and_manifest_load_fails(tmp_path):
+    log = DigestLog(str(tmp_path / "segs"), budget_bytes=64 << 20)
+    log.add_many(_digests(500, 40))
+    log.flush()
+    man = log.manifest_bytes()
+    seg_path = log._segs[0].path
+    raw = open(seg_path, "rb").read()
+    # torn tail: structural size check rejects the segment
+    open(seg_path, "wb").write(raw[:-10])
+    fresh = DigestLog(str(tmp_path / "segs"), budget_bytes=64 << 20)
+    ok, _ = fresh.load_manifest_bytes(man)
+    assert not ok and fresh.segment_count == 0
+    # flipped fence byte: the trailer sha rejects it
+    raw2 = bytearray(raw)
+    raw2[-40] ^= 0xFF
+    open(seg_path, "wb").write(bytes(raw2))
+    fresh2 = DigestLog(str(tmp_path / "segs"), budget_bytes=64 << 20)
+    ok, _ = fresh2.load_manifest_bytes(man)
+    assert not ok and fresh2.segment_count == 0
+
+
+def test_manifest_roundtrip_reaps_strays(tmp_path):
+    root = str(tmp_path / "segs")
+    log = DigestLog(root, budget_bytes=64 << 20)
+    digs = _digests(400, 41)
+    log.add_many(digs)
+    log.flush()
+    man = log.manifest_bytes()
+    # a crashed compaction's tmp file and an unlisted orphan run
+    open(os.path.join(root, "999.seg.tmp.123"), "wb").write(b"junk")
+    open(os.path.join(root, "0000000000000099.seg"), "wb").write(b"old")
+    fresh = DigestLog(root, budget_bytes=64 << 20)
+    ok, consumed = fresh.load_manifest_bytes(man)
+    assert ok and consumed == len(man)
+    assert fresh.live_count == 400
+    assert all(fresh.contains_many(digs))
+    left = set(os.listdir(root))
+    assert left == {s.name for s in fresh._segs}
+
+
+# ----------------------------------------------- DedupIndex spill mode
+
+
+def test_spillable_index_filter_negatives_never_touch_segments(tmp_path):
+    idx = DedupIndex(budget_mb=1, spill_dir=str(tmp_path), resident_mb=1)
+    digs = _digests(20_000, 50)                  # ~2 spills at 1 MiB
+    idx.insert_many(digs)
+    idx.digestlog.flush()
+    assert idx.digestlog.segment_count >= 1
+    cr0 = _confirm_reads()
+    novel = _digests(20_000, 51)
+    assert not any(idx.probe_batch(novel))
+    for d in novel[:50]:
+        assert not idx.contains(d)
+    # the structural ISSUE 14 zero: negatives are answered by the
+    # filter alone
+    assert _confirm_reads() == cr0
+    # members DO confirm on disk (memtable was flushed)
+    assert all(idx.probe_batch(digs))
+    assert _confirm_reads() > cr0
+
+
+def test_all_novel_backup_zero_confirm_reads(tmp_path):
+    """End-to-end: a whole backup session of novel data through the
+    DedupWriter performs ZERO exact-confirm segment reads — the spilled
+    tier keeps the PR 8 disk-free-negative discipline."""
+    from pbs_plus_tpu.chunker import ChunkerParams
+    from pbs_plus_tpu.pxar.backupproxy import LocalStore
+    from pbs_plus_tpu.pxar.walker import backup_tree
+
+    src = tmp_path / "src"
+    src.mkdir()
+    rng = np.random.default_rng(52)
+    for i in range(6):
+        (src / f"f{i}.bin").write_bytes(
+            rng.integers(0, 256, 40_000, dtype=np.uint8).tobytes())
+    store = LocalStore(str(tmp_path / "ds"),
+                       ChunkerParams(avg_size=8 << 10),
+                       store_shards=4, dedup_index_mb=4,
+                       dedup_resident_mb=1)
+    idx = store.datastore.chunks.index
+    assert idx is not None and idx.spillable
+    cr0 = _confirm_reads()
+    sess = store.start_session(backup_type="host", backup_id="novel")
+    backup_tree(sess, str(src))
+    man = sess.finish()
+    assert man["stats"]["new_chunks"] > 0
+    assert man["stats"]["known_chunks"] == 0
+    assert _confirm_reads() == cr0
+
+
+def test_spillable_sweep_coherence_and_manifest_boot(tmp_path):
+    store = ChunkStore(str(tmp_path), n_shards=4, index_budget_mb=2,
+                       index_resident_mb=1)
+    pairs = [_chunk(i) for i in range(2000)]
+    for d, data in pairs:
+        store.insert(d, data, verify=False)
+    store.index.digestlog.flush()                # memtable -> segment
+    assert store.index.digestlog.segment_count >= 1
+    # sweep half: tombstones + filter discards, manifest re-saved
+    cutoff = time.time() + 60
+    live = [d for d, _ in pairs[:1000]]
+    for d, _ in pairs[:1000]:
+        os.utime(store._path(d), (cutoff + 10, cutoff + 10))
+    removed, _ = store.sweep(before=cutoff)
+    assert removed == 1000
+    assert all(store.index.contains(d) for d in live)
+    assert not any(store.index.contains(d) for d, _ in pairs[1000:])
+    assert os.path.exists(store._index_snap)
+    with open(store._index_snap, "rb") as f:
+        assert f.read(4) == MAN_MAGIC            # the thin manifest
+    # boot a fresh store from the manifest: no shard scan, coherent
+    before_loads = chunkindex.metrics_snapshot()["snapshot_loads"]
+    b = ChunkStore(str(tmp_path), n_shards=4, index_budget_mb=2,
+                   index_resident_mb=1)
+    disk = set(b.iter_digests())
+    known = set(b.index.digests())
+    assert disk == known == set(live)
+    assert chunkindex.metrics_snapshot()["snapshot_loads"] == \
+        before_loads + 1
+    assert not os.path.exists(b._index_snap)     # consume-once
+    # a swept digest re-inserts as new (safe false negative direction)
+    d, data = pairs[1500]
+    assert b.insert(d, data, verify=False)
+
+
+def test_legacy_snapshot_loads_once_and_migrates_to_segments(tmp_path):
+    store = ChunkStore(str(tmp_path), n_shards=2, index_budget_mb=2,
+                       index_resident_mb=1)
+    pairs = [_chunk(i) for i in range(30)]
+    for d, data in pairs:
+        store.insert(d, data, verify=False)
+    # forge a LEGACY all-RAM snapshot at the store's snapshot path
+    legacy = DedupIndex(budget_mb=1)
+    legacy.insert_many([d for d, _ in pairs])
+    legacy.mark_datablob(pairs[3][0])
+    os.makedirs(os.path.dirname(store._index_snap), exist_ok=True)
+    legacy.save_snapshot(store._index_snap)
+    with open(store._index_snap, "rb") as f:
+        assert f.read(4) == chunkindex.SNAP_MAGIC
+
+    b = ChunkStore(str(tmp_path), n_shards=2, index_budget_mb=2,
+                   index_resident_mb=1)
+    assert all(b.index.contains(d) for d, _ in pairs)   # loaded once
+    assert b.index.is_datablob(pairs[3][0])             # flags migrated
+    assert not b.index.is_datablob(pairs[4][0])
+    # the next save persists the MIGRATED form: segments + manifest
+    assert b.save_index_snapshot()
+    with open(b._index_snap, "rb") as f:
+        assert f.read(4) == MAN_MAGIC
+    assert b.index.digestlog.segment_count >= 1
+    c = ChunkStore(str(tmp_path), n_shards=2, index_budget_mb=2,
+                   index_resident_mb=1)
+    assert all(c.index.contains(d) for d, _ in pairs)
+
+
+def test_no_manifest_boot_rescans_and_resets_stale_segments(tmp_path):
+    """The crash window: segments on disk but no manifest (a sweep's
+    unlinks happened, the save did not).  Boot must fall back to the
+    shard scan and RESET the segment dir — a stale segment must never
+    resurrect a swept digest as a false dedup skip."""
+    store = ChunkStore(str(tmp_path), n_shards=2, index_budget_mb=2,
+                       index_resident_mb=1)
+    pairs = [_chunk(i) for i in range(40)]
+    for d, data in pairs:
+        store.insert(d, data, verify=False)
+    store.save_index_snapshot()                  # segments + manifest
+    seg_dir = os.path.join(str(tmp_path), ".chunkindex", "segments")
+    assert os.listdir(seg_dir)
+    # crash simulation: a chunk vanishes (sweep unlink) but neither the
+    # tombstone nor the manifest made it to disk
+    victim = pairs[0][0]
+    os.unlink(store._path(victim))
+    os.unlink(store._index_snap)
+
+    b = ChunkStore(str(tmp_path), n_shards=2, index_budget_mb=2,
+                   index_resident_mb=1)
+    assert not b.index.contains(victim)          # scan = ground truth
+    assert all(b.index.contains(d) for d, _ in pairs[1:])
+    # insert() on the victim is a WRITE, never a skip
+    assert b.insert(victim, pairs[0][1], verify=False)
+    assert os.path.exists(b._path(victim))
+
+
+def test_resident_bytes_bounded_by_spill(tmp_path):
+    """The gauge fix: a spilled index reports memtable + fences, not
+    the whole exact set — resident cost stops scaling with digests."""
+    n = 30_000
+    digs = _digests(n, 60)
+    ram = DedupIndex(budget_mb=1)
+    ram.insert_many(digs)
+    spill = DedupIndex(budget_mb=1, spill_dir=str(tmp_path),
+                       resident_mb=1)
+    spill.insert_many(digs)
+    spill.digestlog.flush()
+    spill.digestlog.drain()
+    assert len(spill) == len(ram) == n
+    # the RAM index pays per-digest; the spilled one pays fences only
+    assert spill.resident_bytes < ram.resident_bytes / 3
+    assert spill.resident_bytes - spill.table_bytes < (1 << 20)
+
+
+def test_discard_reinsert_datablob_flags_across_spill(tmp_path):
+    idx = DedupIndex(budget_mb=1, spill_dir=str(tmp_path), resident_mb=1)
+    digs = _digests(100, 61)
+    idx.insert_many(digs)
+    idx.mark_datablob(digs[5])
+    idx.digestlog.flush()                        # knowledge on disk
+    assert idx.is_datablob(digs[5])
+    assert not idx.is_datablob(digs[6])
+    # datablob marking of an already-spilled digest: shadow record wins
+    idx.mark_datablob(digs[7])
+    idx.digestlog.flush()
+    idx.digestlog.compact(wait=True)
+    assert idx.is_datablob(digs[7])
+    # discard drops membership AND the flag knowledge
+    assert idx.discard(digs[5])
+    assert not idx.contains(digs[5])
+    assert not idx.is_datablob(digs[5])
+    assert idx.insert(digs[5])                   # safe re-learn
+    assert not idx.is_datablob(digs[5])
+
+
+def test_filter_growth_streams_from_log(tmp_path):
+    """Filter growth in spill mode rebuilds fingerprints from the log
+    stream (digest source), not an in-RAM set — membership stays exact
+    through a table doubling."""
+    idx = DedupIndex(budget_mb=0, spill_dir=str(tmp_path),
+                     resident_mb=1)
+    # budget_mb=0 clamps to the minimum table (32K buckets, ~111K
+    # capacity at the 0.85 load factor): 150K digests guarantee growth
+    digs = _digests(150_000, 62)
+    nb0 = idx.n_buckets
+    idx.insert_many(digs)
+    assert idx.n_buckets > nb0
+    assert all(idx.probe_batch(digs))
+    assert not any(idx.probe_batch(_digests(1000, 63)))

@@ -319,6 +319,73 @@ def test_index_discipline_out_of_scope_module_clean():
     assert v == []
 
 
+def test_index_discipline_flags_segment_open_outside_digestlog():
+    v = run_lint("""
+        import os
+        def peek(store, name):
+            with open(os.path.join(store, ".chunkindex", "segments",
+                                   name), "rb") as f:
+                return f.read(33)
+    """, path="pbs_plus_tpu/server/verification_job.py",
+        rules=["index-discipline"])
+    assert names(v) == ["index-discipline"]
+    assert "digestlog" in v[0].message
+
+
+def test_index_discipline_flags_os_open_on_segments():
+    v = run_lint("""
+        import os
+        def raw(seg_dir, name):
+            return os.open(seg_dir + "/.chunkindex/segments/" + name,
+                           os.O_RDONLY)
+    """, path="pbs_plus_tpu/pxar/remote.py", rules=["index-discipline"])
+    assert names(v) == ["index-discipline"]
+
+
+def test_index_discipline_digestlog_owns_segment_files():
+    v = run_lint("""
+        import os
+        def _open_segment(path):
+            fd = os.open(path, os.O_RDONLY)
+            with open(path + ".chunkindex/segments/x", "rb") as f:
+                return fd, f.read()
+    """, path="pbs_plus_tpu/pxar/digestlog.py",
+        rules=["index-discipline"])
+    assert v == []
+
+
+def test_index_discipline_chunkindex_may_open_snapshot_manifest():
+    v = run_lint("""
+        def load(self, path):
+            with open(path, "rb") as f:      # the .chunkindex snapshot
+                return f.read()
+    """, path="pbs_plus_tpu/pxar/chunkindex.py",
+        rules=["index-discipline"])
+    assert v == []
+
+
+def test_index_discipline_non_segment_open_clean():
+    v = run_lint("""
+        def load_manifest(snapdir):
+            with open(snapdir + "/manifest.json") as f:
+                return f.read()
+    """, path="pbs_plus_tpu/server/restore_job.py",
+        rules=["index-discipline"])
+    assert v == []
+
+
+def test_index_discipline_unrelated_segments_file_clean():
+    # a bare "segments" path with no .chunkindex component is NOT the
+    # exact-confirm tier's — the rule must not annex the word
+    v = run_lint("""
+        def load(self):
+            with open(self.log_segments_path, "rb") as f:
+                return f.read()
+    """, path="pbs_plus_tpu/server/sync_job.py",
+        rules=["index-discipline"])
+    assert v == []
+
+
 # --------------------------------------------- bounded-queue-discipline
 
 

@@ -221,24 +221,19 @@ def test_sweep_failpoint_fires_after_mark(tmp_path):
         assert ds.chunks.has(dg)
 
 
-def test_gc_mark_closes_over_delta_bases(tmp_path):
-    """Similarity tier (ISSUE 9): after pruning every snapshot that
-    references a delta's BASE chunk directly, a zero-grace GC must
-    still keep the base alive as long as a surviving snapshot holds a
-    delta that reassembles from it — the mark's ``delta_closure``."""
+def _two_generation_delta_store(tmp_path, seed=31):
+    """gen0 snapshot (the bases) + gen1 near-dup snapshot whose chunks
+    delta against gen0's; returns (store, s1, s2, mut_bytes, bases)."""
     store = LocalStore(str(tmp_path / "ds"), P, delta_tier=True)
-    rng = np.random.default_rng(31)
+    rng = np.random.default_rng(seed)
     blob = rng.integers(0, 256, 96 << 10, dtype=np.uint8)
     src = tmp_path / "src"
     src.mkdir()
-
-    # snapshot 1: the base generation
     (src / "f.bin").write_bytes(blob.tobytes())
     s1 = store.start_session(backup_type="host", backup_id="g",
                              backup_time=1_753_000_000)
     backup_tree(s1, str(src))
     s1.finish()
-    # snapshot 2: a mutated generation — its chunks delta against s1's
     mut = blob.copy()
     mut[rng.choice(len(mut), 400, replace=False)] ^= 0xFF
     (src / "f.bin").write_bytes(mut.tobytes())
@@ -247,32 +242,99 @@ def test_gc_mark_closes_over_delta_bases(tmp_path):
                              auto_previous=False)
     backup_tree(s2, str(src))
     s2.finish()
-
     ds = store.datastore
     _m2, p2 = ds.load_indexes(s2.ref)
     published2 = {p2.digest(i) for i in range(len(p2))}
     bases = {ds.chunks.delta_base_of(d) for d in published2} - {None}
     assert bases, "tier never engaged — nothing to prove"
     assert not bases & published2       # bases live only via snapshot 1
+    return store, s1, s2, mut.tobytes(), bases
 
-    # prune snapshot 1 away; zero-grace GC; age everything first so
-    # only the mark's touches decide survival
-    old = time.time() - 10 * 24 * 3600
+
+def _age_all(ds, days=10):
+    old = time.time() - days * 24 * 3600
     for dg in ds.chunks.iter_digests():
         os.utime(ds.chunks._path(dg), (old, old))
+
+
+def test_gc_refolds_deltas_when_base_snapshot_pruned(tmp_path):
+    """Re-delta on GC (ISSUE 14 satellite): pruning every snapshot
+    that referenced a delta's base directly used to pin the base on
+    disk FOREVER via the closure.  Now a zero-grace GC folds the live
+    deltas down first (re-encode without the doomed base, or store
+    plain), sweeps the bases in the SAME run, leaves no dangling
+    delta, and the surviving snapshot restores bit-identical."""
+    from pbs_plus_tpu.pxar.similarityindex import metrics_snapshot
+
+    store, s1, s2, mut, bases = _two_generation_delta_store(tmp_path)
+    ds = store.datastore
+    _age_all(ds)
+    m0 = metrics_snapshot()
     rep = run_prune(ds, PrunePolicy(keep_last=1), gc_grace_s=0.0)
+    m1 = metrics_snapshot()
     assert str(s1.ref) in rep.removed and str(s2.ref) in rep.kept
-    for b in bases:
-        assert ds.chunks.on_disk(b), "live delta's base was swept"
-    # the surviving snapshot restores bit-identical
-    reader = store.open_snapshot(s2.ref)
-    assert reader.read_file(reader.lookup("f.bin")) == mut.tobytes()
-    # a second GC with snapshot 2 gone reaps bases + deltas alike
-    ds.remove_snapshot(s2.ref)
+    assert m1["refolds"] > m0["refolds"]
+    # the doomed bases were reclaimed in THIS run
+    assert not any(ds.chunks.on_disk(b) for b in bases)
+    assert rep.chunks_removed >= len(bases)
+    # no dangling delta: every surviving chunk reassembles, and no
+    # remaining delta references a missing base
     for dg in ds.chunks.iter_digests():
-        os.utime(ds.chunks._path(dg), (old, old))
+        base = ds.chunks.delta_base_of(dg)
+        assert base is None or ds.chunks.on_disk(base)
+        ds.chunks.get(dg)                       # raises if dangling
+    reader = store.open_snapshot(s2.ref)
+    assert reader.read_file(reader.lookup("f.bin")) == mut
+    # a second GC with snapshot 2 gone reaps everything
+    ds.remove_snapshot(s2.ref)
+    _age_all(ds)
     run_prune(ds, PrunePolicy(), gc_grace_s=0.0)
     assert list(ds.chunks.iter_digests()) == []
+
+
+def test_refold_failpoint_degrades_to_keep_the_base(tmp_path):
+    """A refold killed by the ``pbsstore.delta.refold`` failpoint must
+    leave the delta intact and the GC mark must keep its base — the
+    pre-ISSUE-14 closure behavior, never a dangling delta."""
+    from pbs_plus_tpu.utils import failpoints
+
+    store, s1, s2, mut, bases = _two_generation_delta_store(tmp_path)
+    ds = store.datastore
+    _age_all(ds)
+    with failpoints.armed("pbsstore.delta.refold", "raise"):
+        rep = run_prune(ds, PrunePolicy(keep_last=1), gc_grace_s=0.0)
+    assert str(s1.ref) in rep.removed
+    # every base survives: the closure re-protected them
+    for b in bases:
+        assert ds.chunks.on_disk(b), "failed refold lost its base"
+    reader = store.open_snapshot(s2.ref)
+    assert reader.read_file(reader.lookup("f.bin")) == mut
+    # with the fault cleared the next GC refolds and reclaims
+    _age_all(ds)
+    run_prune(ds, PrunePolicy(keep_last=1), gc_grace_s=0.0)
+    assert not any(ds.chunks.on_disk(b) for b in bases)
+    reader = store.open_snapshot(s2.ref)
+    assert reader.read_file(reader.lookup("f.bin")) == mut
+
+
+def test_refold_never_reanchors_on_a_doomed_base(tmp_path):
+    """The refold's re-encode must not pick ANOTHER doomed base as its
+    new anchor (that would re-create the leak it is fixing): after the
+    refold pass, no live chunk's on-disk base chain touches a doomed
+    digest."""
+    from pbs_plus_tpu.server.prune import refold_doomed_bases
+
+    store, s1, s2, mut, bases = _two_generation_delta_store(tmp_path)
+    ds = store.datastore
+    ds.remove_snapshot(s1.ref)
+    refold_doomed_bases(ds)
+    _m2, p2 = ds.load_indexes(s2.ref)
+    live = {p2.digest(i) for i in range(len(p2))}
+    for d in live:
+        b = ds.chunks.delta_base_of(d)
+        assert b is None or b in live, "refold re-anchored outside live"
+    reader = store.open_snapshot(s2.ref)
+    assert reader.read_file(reader.lookup("f.bin")) == mut
 
 
 def test_prune_web_route_and_snapshot_delete(tmp_path):

@@ -13,6 +13,14 @@ datastore module's sanctioned membership surface instead:
 ``chunk_size``/``get`` when the chunk is already known live.
 
 ``pxar/datastore.py`` itself is exempt — it implements the oracle.
+
+Second invariant (ISSUE 14): the spillable exact-confirm tier's
+segment files under ``.chunkindex/segments/`` belong to
+``pxar/digestlog.py`` ALONE.  Any other module opening one bypasses
+the memtable/tombstone merge view and the fence-pointer read
+discipline — it can read a digest a newer tombstone already killed,
+which is exactly the false dedup skip the tier's ordering rules out.
+Everything else goes through ``DedupIndex``.
 """
 
 from __future__ import annotations
@@ -32,6 +40,16 @@ _PROBES = frozenset({
 # itself, the store's path builder, or a digest-derived path
 _CHUNK_MARKERS = (".chunks", "._path(", "chunk_path", "digest")
 
+# the segment-file invariant: open-family calls on .chunkindex paths
+# (the segment dir, or the snapshot-manifest the segments hang off).
+# The marker is the `.chunkindex` component alone — a bare "segments"
+# substring would false-positive every unrelated *_segments file a
+# future module might open
+_SEG_OWNERS = ("pbs_plus_tpu/pxar/digestlog.py",
+               "pbs_plus_tpu/pxar/chunkindex.py")
+_OPENERS = frozenset({"open", "io.open", "os.open"})
+_SEG_MARKERS = (".chunkindex",)
+
 
 class IndexDiscipline(Rule):
     name = "index-discipline"
@@ -41,10 +59,15 @@ class IndexDiscipline(Rule):
                  "membership oracle")
 
     def begin_file(self, ctx):
-        return ctx.path.startswith(_SCOPES) and ctx.path != _EXEMPT
+        return ctx.path.startswith(_SCOPES)
 
     def visit_Call(self, ctx, node: ast.Call) -> None:
-        if call_name(node) not in _PROBES or not node.args:
+        name = call_name(node)
+        if name in _OPENERS and ctx.path not in _SEG_OWNERS:
+            self._check_segment_open(ctx, node, name)
+        if ctx.path == _EXEMPT:
+            return
+        if name not in _PROBES or not node.args:
             return
         try:
             arg_src = ast.unparse(node.args[0])
@@ -54,9 +77,27 @@ class IndexDiscipline(Rule):
         if not any(m in low for m in _CHUNK_MARKERS):
             return
         ctx.report(self, node,
-                   f"`{call_name(node)}({arg_src})` probes chunk "
+                   f"`{name}({arg_src})` probes chunk "
                    "existence on disk: one stat per digest, bypassing "
                    "the dedup index and its GC sweep coherence — use "
                    "ChunkStore.has / ChunkStore.probe_batch "
                    "(pxar/chunkindex.py), the sanctioned membership "
                    "oracle")
+
+    def _check_segment_open(self, ctx, node: ast.Call, name: str) -> None:
+        if not node.args:
+            return
+        try:
+            arg_src = ast.unparse(node.args[0])
+        except Exception:
+            return
+        low = arg_src.lower()
+        if not any(m in low for m in _SEG_MARKERS):
+            return
+        ctx.report(self, node,
+                   f"`{name}({arg_src})` opens an exact-confirm tier "
+                   "file directly: only pxar/digestlog.py may read "
+                   "`.chunkindex/segments/` (and only pxar/chunkindex.py "
+                   "the snapshot manifest) — a raw segment read bypasses "
+                   "the memtable/tombstone merge view and can resurrect "
+                   "a discarded digest; go through DedupIndex")
