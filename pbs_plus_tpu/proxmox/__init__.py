@@ -11,7 +11,8 @@ The CLI wrappers are thin subprocess shims gated on binary availability
 a PBS host shows our tasks natively.
 """
 
-from .upid import UPID, parse_upid, new_upid
+from .upid import UPID, make_upid, new_upid, parse_upid
 from .tasklog import TaskLogDir, WorkerTask
 
-__all__ = ["UPID", "parse_upid", "new_upid", "TaskLogDir", "WorkerTask"]
+__all__ = ["UPID", "parse_upid", "new_upid", "make_upid", "TaskLogDir",
+           "WorkerTask"]

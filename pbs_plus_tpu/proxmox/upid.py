@@ -58,6 +58,14 @@ def new_upid(worker_type: str, worker_id: str, *,
                 auth_id=auth_id)
 
 
+def make_upid(kind: str, job_id: str) -> str:
+    """PBS-compatible unique process id STRING for task logs — the one
+    shared wrapper (reference: internal/proxmox/upid.go:23-141); the
+    composition root and the jobs service both mint through here so
+    the format can never diverge between the two paths."""
+    return str(new_upid(kind, job_id))
+
+
 def parse_upid(s: str) -> UPID:
     m = _RE.match(s.strip())
     if m is None:

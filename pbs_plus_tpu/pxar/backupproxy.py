@@ -204,14 +204,16 @@ class LocalStore:
                  delta_tier: "bool | None" = None,
                  delta_threshold: "int | None" = None,
                  delta_max_chain: "int | None" = None,
-                 fused_ingest: "bool | None" = None):
+                 fused_ingest: "bool | None" = None,
+                 shared_instance: "str | None" = None):
         self.datastore = Datastore(base_dir, pbs_format=pbs_format,
                                    store_shards=store_shards,
                                    dedup_index_mb=dedup_index_mb,
                                    dedup_resident_mb=dedup_resident_mb,
                                    delta_tier=delta_tier,
                                    delta_threshold=delta_threshold,
-                                   delta_max_chain=delta_max_chain)
+                                   delta_max_chain=delta_max_chain,
+                                   shared_instance=shared_instance)
         self.params = params
         self._chunker_factory = chunker_factory
         self.batch_hasher = batch_hasher

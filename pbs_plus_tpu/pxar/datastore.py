@@ -35,6 +35,7 @@ except ImportError:                 # image lacks the wheel; ctypes shim
     from ..utils import zstdshim as zstandard
 
 from ..utils import failpoints, validate
+from ..utils.counters import Counters
 from ..utils.log import L
 
 DIDX_MAGIC = b"TPXD"
@@ -43,6 +44,20 @@ _HDR = struct.Struct("<4sHH16sQQ")
 _REC_DTYPE = np.dtype([("end", "<u8"), ("digest", "V32")])
 
 BACKUP_TYPES = ("host", "vm", "ct")
+
+# cross-process write accounting (ISSUE 15, docs/data-plane.md "Shared
+# datastore"): chunks_written counts chunk-file writes this process
+# CLAIMED (full blobs and, in shared mode, raw sync-mirror landings);
+# cross_process_hits counts claims lost to another process that
+# already held the chunk (the link-CAS EEXIST) — summed across a
+# fleet's /metrics, written-once means Σ chunks_written == distinct
+# chunks on disk.  Rendered by server/metrics.py.
+METRICS = Counters("chunks_written", "cross_process_hits")
+_count = METRICS.add
+
+
+def metrics_snapshot() -> dict:
+    return METRICS.snapshot()
 
 
 def parse_backup_type(s: str) -> str:
@@ -121,10 +136,26 @@ class ChunkStore:
                  index_resident_mb: "int | None" = None,
                  delta_tier: "bool | None" = None,
                  delta_threshold: "int | None" = None,
-                 delta_max_chain: "int | None" = None):
+                 delta_max_chain: "int | None" = None,
+                 shared_instance: "str | None" = None):
         """blob_format="zstd" (native raw zstd frame) | "pbs" (stock-PBS
         DataBlob envelope: magic + crc32 + zstd payload).  Reads sniff
         the on-disk magic, so a datastore may hold both formats.
+
+        ``shared_instance`` (None → PBS_PLUS_SHARED_DATASTORE; "" = off)
+        names THIS process when several server processes open one
+        datastore (ISSUE 15, docs/data-plane.md "Shared datastore"):
+        novel-chunk writes claim their final path with an ``os.link``
+        CAS instead of a rename — a lost claim is a cross-process dedup
+        hit, so every chunk is WRITTEN exactly once fleet-wide even
+        though each process runs its own membership index — and the
+        index's spill segments + boot snapshot move to per-instance
+        paths (``.chunkindex/proc-<id>/`` / ``snapshot-<id>``): the
+        digestlog's tmp+rename segment discipline is single-writer per
+        directory, so coexistence means one directory per writer.  The
+        similarity delta tier is forced OFF in shared mode — its
+        base-pin protocol is in-process and a cross-process sweep
+        cannot see another process's pins.
 
         ``n_shards``: logical shard count (None → PBS_PLUS_STORE_SHARDS).
         ``index``: an explicit DedupIndex (tests); else one is built
@@ -148,6 +179,9 @@ class ChunkStore:
         self.base = os.path.join(base, ".chunks")
         os.makedirs(self.base, exist_ok=True)
         self.blob_format = blob_format
+        if shared_instance is None:
+            shared_instance = _conf.env().shared_datastore
+        self.shared_instance = shared_instance or ""
         self._level = compression_level
         if n_shards is None:
             n_shards = _conf.env().store_shards
@@ -186,6 +220,14 @@ class ChunkStore:
         # would let another shard's add() race the iteration
         self._datablob_lock = threading.Lock()
         # (annotated below: _datablob_seen is only touched under it)
+        # per-instance index state in shared mode: the spill segments
+        # and the boot snapshot are single-writer artifacts, so every
+        # co-resident process gets its own directory/file (the segment
+        # NAME sequence would collide in one shared dir)
+        _inst = self.shared_instance
+        _spill_root = os.path.join(base, ".chunkindex",
+                                   f"proc-{_inst}") if _inst \
+            else os.path.join(base, ".chunkindex")
         index_explicit = index is not None
         if index is None:
             mb = (_conf.env().dedup_index_mb
@@ -197,7 +239,7 @@ class ChunkStore:
                 if rmb and rmb > 0:
                     index = DedupIndex(
                         budget_mb=mb,
-                        spill_dir=os.path.join(base, ".chunkindex"),
+                        spill_dir=_spill_root,
                         resident_mb=rmb)
                 else:
                     # resident budget 0: the PR 8 all-RAM confirm set
@@ -206,7 +248,32 @@ class ChunkStore:
         if index is not None and index_explicit:
             # a caller-supplied index is taken as-is (tests pre-seed it)
             index.mark_booted()
-        self._index_snap = os.path.join(base, ".chunkindex", "snapshot")
+        self._index_snap = os.path.join(
+            base, ".chunkindex",
+            f"snapshot-{_inst}" if _inst else "snapshot")
+        self._instance_lock_fd: "int | None" = None
+        if _inst and self._index is not None and not index_explicit:
+            # duplicate-id guard: two processes booting with the SAME
+            # instance id would share a spill directory (single-writer
+            # by design), a GC-lease holder name, and a queue owner —
+            # every cross-process guarantee voided at once.  An
+            # advisory flock on the instance's lock file fails the
+            # second boot loudly instead; held (deliberately, no
+            # close) for the store's whole lifetime.
+            import fcntl
+            os.makedirs(_spill_root, exist_ok=True)
+            fd = os.open(os.path.join(_spill_root, ".instance-lock"),
+                         os.O_CREAT | os.O_RDWR, 0o644)
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                os.close(fd)
+                raise RuntimeError(
+                    f"shared-datastore instance id "
+                    f"{self.shared_instance!r} is already in use by a "
+                    "live process — PBS_PLUS_SHARED_DATASTORE ids must "
+                    "be unique per server process")
+            self._instance_lock_fd = fd
         # similarity-dedup tier (docs/data-plane.md "Similarity tier")
         env = _conf.env()
         if delta_tier is None:
@@ -227,6 +294,15 @@ class ChunkStore:
         # never held across encode/IO-heavy work
         self._pin_lock = threading.Lock()
         self._pinned_bases: dict[bytes, int] = {}   # guarded-by: self._pin_lock
+        if delta_tier and self.shared_instance:
+            # the base-pin commit protocol (exists-confirm + pin under
+            # _pin_lock) is in-process state: a leader's sweep cannot
+            # see a follower's pins, so a cross-process delta commit
+            # could anchor on a base mid-unlink.  Forced off, loudly.
+            L.warning("similarity delta tier disabled: shared-datastore "
+                      "instance %r (the base-pin protocol is "
+                      "in-process)", self.shared_instance)
+            delta_tier = False
         if delta_tier and blob_format != "pbs":
             from .similarityindex import SimilarityIndex
             self._sim = SimilarityIndex(
@@ -411,7 +487,7 @@ class ChunkStore:
                 # PBS readability beats the as-stored purity here)
                 from .pbsformat import blob_encode
                 with self._shard_locks[shard]:
-                    self._write_payload(
+                    self._land_payload(
                         p, blob_encode(data, cctx=self._shard_cctx[shard]))
                     if self.index is not None:
                         self.index.insert(digest)
@@ -442,7 +518,7 @@ class ChunkStore:
                         f"raw chunk {digest.hex()} does not verify "
                         "against its digest")
         with self._shard_locks[shard]:
-            self._write_payload(p, payload)
+            self._land_payload(p, payload)
             if self.index is not None:
                 self.index.insert(digest)
                 if datablob:
@@ -517,16 +593,20 @@ class ChunkStore:
                     return False
             if verify and hashlib.sha256(data).digest() != digest:
                 raise ValueError("chunk digest mismatch on insert")
+            claimed = True
             if self._sim is None or not self._try_delta_write(
                     digest, data, p, shard):
-                self._write_chunk(p, data, shard)
+                claimed = self._write_chunk(p, data, shard)
+            # the local index learns the digest either way: a lost
+            # cross-process claim is a dedup hit this index simply had
+            # not heard about yet (the other process wrote it)
             if self.index is not None:
                 self.index.insert(digest)
                 if self.blob_format == "pbs":
                     self.index.mark_datablob(digest)
             elif self.blob_format == "pbs":
                 self._remember_datablob(digest)
-            return True
+            return claimed
 
     def note_dedup_hit(self, digest: bytes) -> bool:
         """Record a dedup hit discovered via ``probe_batch``: GC-mark
@@ -666,17 +746,22 @@ class ChunkStore:
         _SM.add("bytes_saved", len(plain) - len(blob))
         return True
 
-    def _write_chunk(self, p: str, data: bytes, shard: int) -> None:
+    def _write_chunk(self, p: str, data: bytes, shard: int) -> bool:
+        """Encode + land a full blob.  True when THIS process's bytes
+        became the chunk file.  In shared-datastore mode the landing is
+        an ``os.link`` CAS — False means another process already held
+        the chunk: a cross-process dedup hit (counted, GC-touched),
+        never a second write.  The trade vs the rename path: a shared
+        store gives up silent overwrite-repair of a corrupt chunk file
+        (operators unlink first), buying written-exactly-once."""
         if self.blob_format == "pbs":
             from .pbsformat import blob_encode
             payload = blob_encode(data, cctx=self._shard_cctx[shard])
         else:
             payload = self._shard_cctx[shard].compress(data)
-        self._write_payload(p, payload)
+        return self._land_payload(p, payload)
 
-    def _write_payload(self, p: str, payload: bytes) -> None:
-        """tmp+rename an already-encoded on-disk payload into place."""
-        d = os.path.dirname(p)
+    def _ensure_dir(self, d: str) -> None:
         with self._made_dirs_lock:
             fresh = d not in self._made_dirs
         if fresh:
@@ -686,10 +771,56 @@ class ChunkStore:
             os.makedirs(d, exist_ok=True)
             with self._made_dirs_lock:
                 self._made_dirs.add(d)
+
+    def _land_payload(self, p: str, payload: bytes) -> bool:
+        """Land a verified, already-encoded payload with the mode-
+        appropriate discipline: rename in single-process mode, the
+        ``os.link`` claim in shared mode (the sync-mirror write path,
+        ``insert_raw``, must keep the written-exactly-once identity
+        too — two shared servers pulling the same source would
+        otherwise re-land each other's chunks via rename, invisibly
+        to the claim accounting).  True = our bytes became the file."""
+        if not self.shared_instance:
+            self._write_payload(p, payload)
+            _count("chunks_written")
+            return True
+        if self._claim_payload(p, payload):
+            _count("chunks_written")
+            return True
+        _count("cross_process_hits")
+        try:
+            os.utime(p)           # the dedup-hit GC mark
+        except OSError:
+            pass
+        return False
+
+    def _write_payload(self, p: str, payload: bytes) -> None:
+        """tmp+rename an already-encoded on-disk payload into place."""
+        self._ensure_dir(os.path.dirname(p))
         tmp = f"{p}.tmp.{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "wb") as f:
             f.write(payload)
         os.replace(tmp, p)
+
+    def _claim_payload(self, p: str, payload: bytes) -> bool:
+        """tmp + ``os.link`` CAS: the final path is CREATED, never
+        replaced, so exactly one process's write wins (EEXIST = lost
+        claim).  The tmp name carries pid+tid, so co-resident writers
+        and sibling processes never collide on the staging file."""
+        self._ensure_dir(os.path.dirname(p))
+        tmp = f"{p}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        try:
+            os.link(tmp, p)
+        except FileExistsError:
+            return False
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return True
 
     def _note_datablob_hit(self, digest: bytes, p: str, shard: int) -> None:
         """pbs-format dedup hit: a hit against a NATIVE raw-zstd chunk
@@ -1297,7 +1428,8 @@ class Datastore:
                  dedup_resident_mb: "int | None" = None,
                  delta_tier: "bool | None" = None,
                  delta_threshold: "int | None" = None,
-                 delta_max_chain: "int | None" = None):
+                 delta_max_chain: "int | None" = None,
+                 shared_instance: "str | None" = None):
         """pbs_format=True publishes snapshots in the stock-PBS on-disk
         layout (DataBlob chunks, PBS dynamic indexes under .didx names,
         index.json.blob manifest) so a PBS can serve what this build
@@ -1317,7 +1449,8 @@ class Datastore:
                                  index_resident_mb=dedup_resident_mb,
                                  delta_tier=delta_tier,
                                  delta_threshold=delta_threshold,
-                                 delta_max_chain=delta_max_chain)
+                                 delta_max_chain=delta_max_chain,
+                                 shared_instance=shared_instance)
 
     @property
     def meta_idx_name(self) -> str:

@@ -191,6 +191,48 @@ _MIGRATIONS: list[str] = [
         created_at REAL NOT NULL
     );
     """,
+    # 009 — shared-datastore scale-out (ISSUE 15, docs/architecture.md
+    # "Service map"): job/queue state, admission counters, and the GC
+    # leader lease move behind the DB so a SECOND server process can
+    # open the same datastore.  job_queue mirrors every jobs-plane
+    # admission (the shared bounded queue: the bound is checked against
+    # the DB-wide 'queued' count, not one process's); admission_counters
+    # accumulates AgentsManager verdicts across processes;
+    # gc_lease is the single-row TTL'd leader lease — exactly one
+    # sweeper per cycle, stolen on expiry (server/services/prune.py).
+    """
+    CREATE TABLE job_queue (
+        id TEXT PRIMARY KEY,
+        kind TEXT NOT NULL DEFAULT 'backup',
+        tenant TEXT NOT NULL DEFAULT '',
+        owner TEXT NOT NULL DEFAULT '',
+        status TEXT NOT NULL DEFAULT 'queued',
+        enqueued_at REAL NOT NULL,
+        started_at REAL,
+        finished_at REAL,
+        error TEXT NOT NULL DEFAULT ''
+    );
+    """,
+    """
+    CREATE INDEX job_queue_status ON job_queue (status);
+    """,
+    """
+    CREATE TABLE admission_counters (
+        key TEXT PRIMARY KEY,
+        value INTEGER NOT NULL DEFAULT 0
+    );
+    """,
+    """
+    CREATE TABLE gc_lease (
+        id INTEGER PRIMARY KEY CHECK (id = 1),
+        holder TEXT NOT NULL,
+        generation INTEGER NOT NULL DEFAULT 1,
+        acquired_at REAL NOT NULL,
+        renewed_at REAL NOT NULL,
+        expires_at REAL NOT NULL,
+        sweeping INTEGER NOT NULL DEFAULT 1
+    );
+    """,
 ]
 
 
@@ -220,7 +262,11 @@ class BackupJobRow:
 class Database:
     def __init__(self, path: str, *, seal_key: bytes | None = None):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._conn = sqlite3.connect(path, check_same_thread=False)
+        # timeout: cross-process writers (a second server sharing this
+        # datastore, migration 009) serialize on SQLite's write lock —
+        # wait it out instead of surfacing SQLITE_BUSY to the jobs plane
+        self._conn = sqlite3.connect(path, check_same_thread=False,
+                                     timeout=10.0)
         self._conn.row_factory = sqlite3.Row
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA foreign_keys=ON")
@@ -229,17 +275,36 @@ class Database:
         self._migrate()
 
     def _migrate(self) -> None:
-        with self._lock, self._conn:
+        """Apply pending migrations under BEGIN IMMEDIATE: two server
+        processes cold-starting against one fresh database (migration
+        009's whole point) serialize on SQLite's write lock — the loser
+        re-reads the version after the winner commits and no-ops,
+        instead of both racing the same CREATE TABLE.  Each migration
+        entry is a single statement, executed via ``execute`` (never
+        ``executescript``, which would commit the guard transaction)."""
+        with self._lock:
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS schema_version (v INTEGER)")
-            row = self._conn.execute(
-                "SELECT v FROM schema_version").fetchone()
-            current = row["v"] if row else 0
-            if row is None:
-                self._conn.execute("INSERT INTO schema_version VALUES (0)")
-            for i, sql in enumerate(_MIGRATIONS[current:], start=current + 1):
-                self._conn.executescript(sql)
-                self._conn.execute("UPDATE schema_version SET v = ?", (i,))
+            self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                row = self._conn.execute(
+                    "SELECT v FROM schema_version").fetchone()
+                current = row["v"] if row else 0
+                if row is None:
+                    self._conn.execute(
+                        "INSERT INTO schema_version VALUES (0)")
+                for i, sql in enumerate(_MIGRATIONS[current:],
+                                        start=current + 1):
+                    self._conn.execute(sql)
+                    self._conn.execute(
+                        "UPDATE schema_version SET v = ?", (i,))
+                self._conn.execute("COMMIT")
+            except BaseException:
+                try:
+                    self._conn.execute("ROLLBACK")
+                except sqlite3.OperationalError:
+                    pass
+                raise
 
     def close(self) -> None:
         self._conn.close()
@@ -694,3 +759,215 @@ class Database:
                 "SELECT pattern FROM exclusions WHERE job_id='' OR job_id=?",
                 (job_id,)).fetchall()
         return [r["pattern"] for r in rows]
+
+    # -- shared job queue (migration 009; server/services/jobqueue.py) -------
+    # The DB-wide mirror of the jobs plane: every admission lands a row
+    # here so the queue BOUND is shared across every server process that
+    # opens this database.  Fairness (strict priority + per-tenant RR)
+    # stays per-process inside JobsManager — the shared state is the
+    # bound and the queue's observability, not the grant order.
+
+    def queue_admit(self, job_id: str, kind: str, tenant: str,
+                    owner: str, *, max_queued: int = 0) -> str:
+        """Admit ``job_id`` into the shared queue.  Returns
+        ``"admitted"``, ``"full"`` (DB-wide 'queued' count at
+        ``max_queued`` — the caller raises the typed QueueFullError),
+        or ``"active"`` (a NON-TERMINAL row already exists — in any
+        process: resetting a live sibling's 'running' row would both
+        double-run the job and blind GC's fleet-wide running check, so
+        dedup-by-id is fleet-wide here).  Only terminal rows (a retry
+        round) are reset.  The check+insert runs under BEGIN IMMEDIATE
+        so two processes admitting concurrently serialize on the
+        database write lock — the bound cannot be overshot and the
+        active-row check cannot race."""
+        with self._lock:
+            if not self._conn.in_transaction:
+                # a real lock-wait failure ("database is locked") must
+                # raise, not silently drop the serialization guarantee
+                self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                row = self._conn.execute(
+                    "SELECT status FROM job_queue WHERE id=?",
+                    (job_id,)).fetchone()
+                if row is not None and row["status"] in ("queued",
+                                                         "running"):
+                    self._conn.execute("ROLLBACK")
+                    return "active"
+                if max_queued and max_queued > 0:
+                    n = self._conn.execute(
+                        "SELECT COUNT(*) AS n FROM job_queue WHERE "
+                        "status='queued'").fetchone()["n"]
+                    if n >= max_queued:
+                        self._conn.execute("ROLLBACK")
+                        return "full"
+                self._conn.execute(
+                    """INSERT INTO job_queue (id,kind,tenant,owner,status,
+                       enqueued_at) VALUES (?,?,?,?, 'queued', ?)
+                       ON CONFLICT(id) DO UPDATE SET kind=excluded.kind,
+                         tenant=excluded.tenant, owner=excluded.owner,
+                         status='queued', enqueued_at=excluded.enqueued_at,
+                         started_at=NULL, finished_at=NULL, error=''""",
+                    (job_id, kind, tenant, owner, time.time()))
+                self._conn.execute("COMMIT")
+            except BaseException:
+                try:
+                    self._conn.execute("ROLLBACK")
+                except sqlite3.OperationalError:
+                    pass
+                raise
+        return "admitted"
+
+    def queue_mark_running(self, job_id: str) -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                "UPDATE job_queue SET status='running', started_at=? "
+                "WHERE id=?", (time.time(), job_id))
+
+    def queue_finish(self, job_id: str, status: str,
+                     error: str = "") -> None:
+        """Terminal transition (``done`` / ``error`` / ``rejected``)."""
+        with self._lock, self._conn:
+            self._conn.execute(
+                "UPDATE job_queue SET status=?, finished_at=?, error=? "
+                "WHERE id=?", (status, time.time(), error, job_id))
+
+    def queue_depth(self) -> int:
+        """DB-wide queued count — the shared bound's denominator."""
+        with self._lock:
+            r = self._conn.execute(
+                "SELECT COUNT(*) AS n FROM job_queue WHERE "
+                "status='queued'").fetchone()
+        return int(r["n"])
+
+    def queue_counts(self) -> dict[str, int]:
+        """{status: count} across every process sharing this DB."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT status AS k, COUNT(*) AS n FROM job_queue "
+                "GROUP BY status").fetchall()
+        return {str(r["k"]): int(r["n"]) for r in rows}
+
+    def queue_reap_owner(self, owner: "str | None") -> int:
+        """Rows a dead/restarted process left queued or running become
+        error rows (the bootstrap orphan-cleanup discipline applied to
+        the shared queue) — they must stop counting against the bound.
+        ``owner=None`` reaps EVERY live row: the single-process boot
+        path, where a pid-derived owner id changes across restarts and
+        no sibling process can exist by definition."""
+        q = ("UPDATE job_queue SET status='error', finished_at=?, "
+             "error='owner restarted' WHERE status IN "
+             "('queued','running')")
+        args: tuple = (time.time(),)
+        if owner is not None:
+            q += " AND owner=?"
+            args += (owner,)
+        with self._lock, self._conn:
+            cur = self._conn.execute(q, args)
+        return cur.rowcount
+
+    # -- shared admission counters (migration 009) ---------------------------
+    def bump_admission_counters(self, deltas: "dict[str, int]") -> None:
+        """Accumulate AgentsManager admission verdict deltas into the
+        cross-process counters (flushed, not per-event — one write per
+        flush, not per session open)."""
+        items = [(k, int(v)) for k, v in deltas.items() if v]
+        if not items:
+            return
+        with self._lock, self._conn:
+            self._conn.executemany(
+                """INSERT INTO admission_counters (key, value)
+                   VALUES (?, ?) ON CONFLICT(key) DO UPDATE SET
+                   value = value + excluded.value""", items)
+
+    def admission_counters(self) -> dict[str, int]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT key, value FROM admission_counters").fetchall()
+        return {str(r["key"]): int(r["value"]) for r in rows}
+
+    # -- GC leader lease (migration 009; server/services/prune.py) ----------
+    # Single-row CAS discipline: the conditional upsert only lands when
+    # the caller already holds the lease OR the incumbent's TTL has
+    # expired — one statement, atomic under SQLite's write lock, so two
+    # processes racing for an expired lease cannot both win.
+
+    def acquire_gc_lease(self, holder: str, ttl_s: float) -> dict:
+        """Try to take (or renew) the GC leader lease.  Returns
+        ``{"acquired": bool, "outcome": "acquired"|"renewed"|"stolen"|
+        "held", "holder": ..., "expires_at": ...}`` — ``held`` means a
+        live incumbent owns it and the caller must not sweep."""
+        now = time.time()
+        with self._lock, self._conn:
+            prior = self._conn.execute(
+                "SELECT * FROM gc_lease WHERE id=1").fetchone()
+            prior = dict(prior) if prior else None
+            cur = self._conn.execute(
+                """INSERT INTO gc_lease (id,holder,generation,acquired_at,
+                   renewed_at,expires_at,sweeping) VALUES (1,?,1,?,?,?,1)
+                   ON CONFLICT(id) DO UPDATE SET
+                     holder=excluded.holder,
+                     generation=gc_lease.generation +
+                       (gc_lease.holder != excluded.holder),
+                     acquired_at=CASE WHEN gc_lease.holder=excluded.holder
+                       THEN gc_lease.acquired_at
+                       ELSE excluded.acquired_at END,
+                     renewed_at=excluded.renewed_at,
+                     expires_at=excluded.expires_at,
+                     sweeping=1
+                   WHERE gc_lease.holder=excluded.holder
+                      OR gc_lease.expires_at < excluded.renewed_at""",
+                (holder, now, now, now + ttl_s))
+            acquired = cur.rowcount > 0
+        if not acquired:
+            return {"acquired": False, "outcome": "held",
+                    "holder": prior["holder"] if prior else "",
+                    "expires_at": prior["expires_at"] if prior else 0.0}
+        if prior is None:
+            outcome = "acquired"
+        elif prior["holder"] == holder:
+            outcome = "renewed"
+        elif prior["expires_at"] < now:
+            outcome = "stolen"
+        else:
+            # prior expired between our read and the upsert's check —
+            # still a steal from the caller's point of view
+            outcome = "stolen"
+        return {"acquired": True, "outcome": outcome, "holder": holder,
+                "expires_at": now + ttl_s}
+
+    def renew_gc_lease(self, holder: str, ttl_s: float) -> bool:
+        """Heartbeat: extend the TTL iff we still hold the lease.  False
+        means the lease was stolen (TTL lapsed mid-sweep) — the caller's
+        sweep result is suspect and must be logged as such."""
+        now = time.time()
+        with self._lock, self._conn:
+            cur = self._conn.execute(
+                "UPDATE gc_lease SET renewed_at=?, expires_at=? "
+                "WHERE id=1 AND holder=?", (now, now + ttl_s, holder))
+        return cur.rowcount > 0
+
+    def mark_gc_lease_idle(self, holder: str) -> bool:
+        """A successful sweep KEEPS the lease for its TTL (the unexpired
+        row is how a same-cycle loser observes `held` — exactly-once per
+        cycle) but demotes it to a cycle marker: ``sweeping=0`` lets the
+        jobs plane's ``fleet_gc_active`` gate reopen immediately instead
+        of stalling backups for a whole TTL after every GC."""
+        with self._lock, self._conn:
+            cur = self._conn.execute(
+                "UPDATE gc_lease SET sweeping=0 WHERE id=1 AND holder=?",
+                (holder,))
+        return cur.rowcount > 0
+
+    def release_gc_lease(self, holder: str) -> bool:
+        """Drop the lease iff still held — fast handover beats waiting
+        out the TTL when the sweeper exits cleanly."""
+        with self._lock, self._conn:
+            cur = self._conn.execute(
+                "DELETE FROM gc_lease WHERE id=1 AND holder=?", (holder,))
+        return cur.rowcount > 0
+
+    def get_gc_lease(self) -> Optional[dict]:
+        with self._lock:
+            r = self._conn.execute(
+                "SELECT * FROM gc_lease WHERE id=1").fetchone()
+        return dict(r) if r else None

@@ -1,0 +1,309 @@
+"""Shared-datastore fleet worker: ONE real server process of the
+multi-process soak (ISSUE 15; docs/fleet.md "Two-process shared
+datastore").
+
+``fleetsim.run_multiproc_fleet`` spawns two of these as REAL
+subprocesses against one datastore directory and one SQLite database,
+then drives them over a JSON-per-line stdio protocol (logs go to
+stderr, so stdout stays a clean event stream):
+
+    stdin commands                  stdout events
+    ------------------------------  ---------------------------------
+    {"cmd":"backup","cn","job_id"}  {"event":"done","job_id","ok",...}
+    {"cmd":"gc","grace","slow"}     {"event":"gc_running"} →
+                                    {"event":"gc_started"} (lease won)
+                                    → {"event":"gc_result","outcome"}
+    {"cmd":"drop_group","cn"}       {"event":"dropped","removed"}
+    {"cmd":"probe","digests":[hex]} {"event":"probe","present":[...]}
+    {"cmd":"metrics"}               {"event":"metrics",...}
+    {"cmd":"exit"}                  {"event":"bye"}
+                                    {"event":"ready","port","pid"}
+
+This module is the multiproc worker's COMPOSITION ROOT (the second of
+the two modules pbslint's ``service-discipline`` rule allows to
+construct services): it wires ``JobQueueService`` (DB-shared bounded
+queue over the PR 7 fair JobsManager) and ``PruneService`` (GC leader
+lease) around a ``FleetServer`` data plane, exactly like
+``server/store.py`` does for the production ``Server`` minus TLS/web.
+
+GC outcomes: ``swept`` (lease won, sweep ran), ``held`` (a live peer
+holds the lease — the exactly-once witness), ``deferred`` (jobs still
+running fleet-wide), ``error``.  ``--gc-ttl`` bounds failover: SIGKILL
+the sweeping worker and a sibling's next ``gc`` steals the lease within
+one TTL.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+from ..utils import trace
+from ..utils.log import L
+
+
+def _emit(obj: dict) -> None:
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+async def _stdin_reader() -> asyncio.StreamReader:
+    loop = asyncio.get_running_loop()
+    reader = asyncio.StreamReader()
+    await loop.connect_read_pipe(
+        lambda: asyncio.StreamReaderProtocol(reader), sys.stdin)
+    return reader
+
+
+class Worker:
+    def __init__(self, args) -> None:
+        from . import database
+        from .fleetsim import FleetConfig, FleetServer
+        from .prune import PrunePolicy
+        from .services import JobQueueService, PruneService
+        from ..utils import conf
+
+        self.proc_id = args.proc_id
+        self.db = database.Database(
+            os.path.join(args.state_dir, conf.DEFAULT_DB_NAME))
+        cfg = FleetConfig(
+            n_agents=args.max_agents, chunk_avg=args.chunk_avg,
+            max_concurrent=args.max_concurrent,
+            max_queued=args.max_queued,
+            mux_write_deadline_s=args.write_deadline)
+        # composition (the store.py pattern, minus TLS/web): job queue
+        # first, its JobsManager injected into the data plane, prune
+        # last — cross-service needs as narrow late-bound callables
+        self.job_queue = JobQueueService(
+            db=self.db,
+            gc_active=lambda: self.prune.fleet_gc_active(),
+            max_concurrent=args.max_concurrent,
+            max_queued=args.max_queued, owner=self.proc_id)
+        self.server = FleetServer(args.datastore, cfg,
+                                  jobs=self.job_queue.jobs,
+                                  shared_instance=self.proc_id)
+        self.job_queue.agents = self.server.agents
+        self.job_queue.datastore = self.server.store
+        self.prune = PruneService(
+            datastore=self.server.store,
+            policy_factory=PrunePolicy,
+            jobs_active=lambda: self.job_queue.active_count,
+            db=self.db, holder=self.proc_id,
+            lease_ttl_s=args.gc_ttl)
+        self._bg: list[asyncio.Task] = []
+        self.log = L.with_scope(component=f"fleetproc:{self.proc_id}")
+
+    async def start(self) -> int:
+        port = await self.server.start()
+        # force the lazy index boot NOW, on the empty/startup store:
+        # chunks a sibling writes later must reach this process through
+        # the cross-process claim path, not a conveniently timed boot
+        # scan (the soak's written-once accounting depends on it)
+        await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.server.store.datastore.chunks.has(
+                b"\0" * 32))
+        return port
+
+    # -- commands ----------------------------------------------------------
+    def cmd_backup(self, msg: dict) -> None:
+        from .jobs import Job, QueueFullError
+        cn, job_id = msg["cn"], msg["job_id"]
+        tenant = msg.get("tenant", cn)
+
+        result_box: dict = {}
+
+        async def execute():
+            while self.prune.fleet_gc_active():    # never start mid-GC
+                await asyncio.sleep(0.2)
+            # serialize session startups exactly like the production
+            # enqueue path, and feed the same per-service histogram
+            t_mu = time.perf_counter()
+            async with self.job_queue.jobs.startup_mu:   # pbslint: lock-order jobs.startup-mu
+                trace.record("service.lock_wait",
+                             time.perf_counter() - t_mu,
+                             service="jobqueue")
+            result_box["res"] = await self.server.backup_once(cn, job_id)
+
+        async def on_success():
+            # emitted from the SUCCESS hook, which the JobQueueService
+            # wrapper runs AFTER the shared queue row flips to `done` —
+            # the driver keys its GC ticks off this event, and emitting
+            # from execute() left a window where a 'running' row made a
+            # cycle report `deferred` (a phantom fleet-wide job)
+            res = result_box["res"]
+            _emit({"event": "done", "job_id": job_id, "ok": True,
+                   "entries": res["entries"], "bytes": res["bytes"]})
+
+        async def on_error(exc: BaseException):
+            _emit({"event": "done", "job_id": job_id, "ok": False,
+                   "error": f"{type(exc).__name__}: {exc}"})
+
+        try:
+            self.job_queue.submit(Job(
+                id=f"backup:{cn}:{job_id}", kind="backup", tenant=tenant,
+                execute=execute, on_success=on_success,
+                on_error=on_error))
+        except QueueFullError as e:
+            _emit({"event": "done", "job_id": job_id, "ok": False,
+                   "error": f"QueueFullError: {e}"})
+
+    async def cmd_gc(self, msg: dict) -> None:
+        from ..utils import failpoints
+        from .services import GCLeaseHeldError
+        grace = float(msg.get("grace", 0.0))
+        slow = float(msg.get("slow", 0.0))
+        _emit({"event": "gc_running"})
+        started = asyncio.create_task(self._watch_lease())
+        try:
+            if slow > 0:
+                # hold the sweep open so the driver can SIGKILL us
+                # mid-sweep with the lease held (the failover probe)
+                with failpoints.armed("pbsstore.chunk.sweep", "delay",
+                                      arg=slow):
+                    report = await self.prune.run_prune(gc_grace_s=grace)
+            else:
+                report = await self.prune.run_prune(gc_grace_s=grace)
+            _emit({"event": "gc_result", "outcome": "swept",
+                   "chunks_removed": report.chunks_removed,
+                   "bytes_freed": report.bytes_freed,
+                   "snapshots_removed": len(report.removed)})
+        except GCLeaseHeldError as e:
+            _emit({"event": "gc_result", "outcome": "held",
+                   "detail": str(e)})
+        except RuntimeError as e:
+            _emit({"event": "gc_result", "outcome": "deferred",
+                   "detail": str(e)})
+        except Exception as e:
+            self.log.exception("gc failed")
+            _emit({"event": "gc_result", "outcome": "error",
+                   "detail": f"{type(e).__name__}: {e}"})
+        finally:
+            started.cancel()
+
+    async def _watch_lease(self) -> None:
+        """Emit gc_started the moment THIS cycle's lease names us — the
+        driver's structural I-am-the-leader signal (no sleeps-as-sync:
+        the kill choreography keys off this event).  Matching requires
+        a live SWEEPING lease renewed at/after this watch began: a
+        stale idle row from a previous cycle we won (kept as the
+        cycle marker, sweeping=0) must not fire the signal before the
+        stalled sweep actually holds the lease."""
+        t0 = time.time()
+        try:
+            while True:
+                lease = self.db.get_gc_lease()
+                if lease is not None and lease["holder"] == self.proc_id \
+                        and lease["sweeping"] \
+                        and lease["renewed_at"] >= t0 - 0.5:
+                    _emit({"event": "gc_started",
+                           "expires_at": lease["expires_at"]})
+                    return
+                await asyncio.sleep(0.03)
+        except asyncio.CancelledError:
+            raise
+
+    async def cmd_drop_group(self, msg: dict) -> None:
+        cn = msg["cn"]
+        ds = self.server.store.datastore
+        removed = 0
+        for ref in list(ds.list_snapshots(all_namespaces=True)):
+            if ref.backup_id == cn:
+                await self.prune.delete_snapshot(ref)
+                removed += 1
+        _emit({"event": "dropped", "cn": cn, "removed": removed})
+
+    def cmd_probe(self, msg: dict) -> None:
+        digests = [bytes.fromhex(h) for h in msg.get("digests", [])]
+        chunks = self.server.store.datastore.chunks
+        present = chunks.probe_batch(digests)
+        if present is None:     # index-less store: disk-true fallback
+            present = chunks.on_disk_many(digests)
+        _emit({"event": "probe", "present": [bool(p) for p in present]})
+
+    def cmd_metrics(self) -> None:
+        from ..pxar import chunkindex as _chunkindex
+        from ..pxar import datastore as _pxds
+        from . import metrics as _metrics
+        from .services import prune_service as _prune_svc
+        self.job_queue.flush_admission()
+        h = _metrics.HISTOGRAMS["pbs_plus_service_lock_wait_seconds"]
+        lock_wait = {
+            svc: {"p50": h.quantile(0.50, {"service": svc}),
+                  "p99": h.quantile(0.99, {"service": svc}),
+                  "count": h.snapshot().get(
+                      (("service", svc),), {}).get("count", 0)}
+            for svc in ("prune", "jobqueue")}
+        _emit({
+            "event": "metrics",
+            "proc": self.proc_id,
+            "store": _pxds.metrics_snapshot(),
+            "gc_lease": _prune_svc.metrics_snapshot(),
+            "dedup_index": _chunkindex.metrics_snapshot(),
+            "jobs": dict(self.job_queue.jobs.stats),
+            "queue_counts": self.db.queue_counts(),
+            "admission": self.db.admission_counters(),
+            "mux": self.server.mux_stats(),
+            "service_lock_wait": lock_wait,
+        })
+
+    async def run(self) -> None:
+        port = await self.start()
+        _emit({"event": "ready", "port": port, "pid": os.getpid(),
+               "proc": self.proc_id})
+        reader = await _stdin_reader()
+        while True:
+            line = await reader.readline()
+            if not line:
+                break
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                self.log.warning("bad command line: %r", line[:200])
+                continue
+            cmd = msg.get("cmd", "")
+            if cmd == "backup":
+                self.cmd_backup(msg)
+            elif cmd == "gc":
+                self._bg.append(asyncio.create_task(self.cmd_gc(msg)))
+            elif cmd == "drop_group":
+                await self.cmd_drop_group(msg)
+            elif cmd == "probe":
+                self.cmd_probe(msg)
+            elif cmd == "metrics":
+                self.cmd_metrics()
+            elif cmd == "exit":
+                break
+            else:
+                self.log.warning("unknown command %r", cmd)
+        await self.job_queue.drain(timeout=60)
+        for t in self._bg:
+            if not t.done():
+                t.cancel()
+        await asyncio.gather(*self._bg, return_exceptions=True)
+        await self.server.stop()
+        self.job_queue.flush_admission()
+        self.db.close()
+        _emit({"event": "bye"})
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="fleetproc")
+    ap.add_argument("--state-dir", required=True)
+    ap.add_argument("--datastore", required=True)
+    ap.add_argument("--proc-id", required=True)
+    ap.add_argument("--gc-ttl", type=float, default=5.0)
+    ap.add_argument("--chunk-avg", type=int, default=4 << 10)
+    ap.add_argument("--max-agents", type=int, default=64)
+    ap.add_argument("--max-concurrent", type=int, default=4)
+    ap.add_argument("--max-queued", type=int, default=512)
+    ap.add_argument("--write-deadline", type=float, default=60.0)
+    args = ap.parse_args(argv)
+    asyncio.run(Worker(args).run())
+
+
+if __name__ == "__main__":
+    main()

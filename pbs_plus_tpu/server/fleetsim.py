@@ -25,7 +25,9 @@ agentfs reads (mid-backup), composing the failpoint/chaos discipline
 from __future__ import annotations
 
 import asyncio
+import json
 import os
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -40,7 +42,7 @@ from ..arpc.router import HandlerError
 from ..arpc.transport import HDR_LOOPBACK_CN, HandshakeError
 from ..chunker import ChunkerParams
 from ..pxar.backupproxy import LocalStore
-from ..utils import trace
+from ..utils import conf, trace
 from ..utils.log import L
 from . import checkpoint, metrics
 from .backup_job import RemoteTreeBackup
@@ -88,6 +90,21 @@ class FleetConfig:
     # sync after the backup rounds makes the mirror complete
     sync_jobs: int = 0
     sync_mirror_dir: str = ""
+    # hostile agent profiles (ISSUE 15 satellite; docs/fleet.md
+    # "Hostile clients"): EXTRA agents beyond n_agents that abuse the
+    # mux — each performs the RX-credit violation (floods DATA past its
+    # advertised credit on a kept-open call stream → server resets the
+    # stream, flow_violations counted) and then the slow-reader attack
+    # (pauses its transport reads and keeps requesting echo responses →
+    # the server's write blocks past mux_write_deadline_s and sheds the
+    # CONNECTION, write_deadline_sheds counted).  Both paths were built
+    # in PR 7 and never before exercised by a soak.
+    # sized past loopback TCP autotuning (~10 MiB of kernel buffering
+    # can absorb a smaller flood without ever blocking the server's
+    # writes): ~25 MiB of refused responses guarantees the drain stalls
+    hostile_agents: int = 0
+    hostile_echo_calls: int = 400
+    hostile_echo_bytes: int = 64 << 10
 
 
 def has_checkpoint(store: LocalStore, cn: str) -> bool:
@@ -347,26 +364,112 @@ class SimAgent:
         return out
 
 
+class HostileAgent(SimAgent):
+    """A PR 7 abuse profile driven at soak scale (ISSUE 15 satellite):
+
+    1. **RX-credit violation.**  A hand-rolled call keeps its stream
+       open after the response and floods DATA frames PAST the
+       advertised credit (bypassing ``MuxStream.write``'s credit loop —
+       exactly what a malicious client would do).  The server's
+       ``_dispatch`` sees per-stream RX buffering blow through
+       ``INITIAL_CREDIT + slack``, counts a ``flow_violation`` and
+       resets the stream — bounded memory no matter how the peer
+       behaves.
+    2. **Slow-reader shed.**  The agent pauses its transport reads and
+       keeps firing echo requests it never drains.  The server's
+       response writes block on the full socket past
+       ``mux_write_deadline_s`` and the connection is SHED
+       (``write_deadline_sheds``) — the only safe unit, since skipping
+       frames would desync the mux.
+
+    Runs concurrently with the legit backup round; the soak asserts
+    both counters fired server-side AND every legit agent still
+    published.
+    """
+
+    async def run_attacks(self, *, echo_calls: int,
+                          echo_bytes: int) -> None:
+        try:
+            await self._attack_flow_violation()
+            await asyncio.sleep(0.05)
+            await self._attack_slow_reader(echo_calls, echo_bytes)
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            pass        # the server killed us — that is the assertion
+        finally:
+            self.dead = True
+
+    async def _attack_flow_violation(self) -> None:
+        """Valid call, then a credit-bypassing flood on the same stream
+        (the server half-closed after responding, so nothing drains the
+        RX buffer — the bound must trip)."""
+        from ..arpc.call import Request, read_envelope
+        from ..arpc.mux import DATA, INITIAL_CREDIT, _RX_CREDIT_SLACK
+        conn = self.conn
+        st = await conn.open_stream()
+        await st.write(Request("ping", {}).encode())
+        await read_envelope(st)             # response consumed, NO close
+        junk = b"\xa5" * (256 << 10)
+        flood = INITIAL_CREDIT + _RX_CREDIT_SLACK + (1 << 20)
+        sent = 0
+        while sent < flood:
+            try:
+                await conn._send_frame(DATA, st.sid, junk)
+            except ConnectionError:
+                break                       # already reset hard enough
+            sent += len(junk)
+
+    async def _attack_slow_reader(self, echo_calls: int,
+                                  echo_bytes: int) -> None:
+        """Stop draining the socket, keep demanding payloads."""
+        from ..arpc.call import Request
+        conn = self.conn
+        conn.writer.transport.pause_reading()
+        blob = "x" * echo_bytes
+        for i in range(echo_calls):
+            if conn.closed:
+                break                       # shed fired — done
+            try:
+                st = await conn.open_stream()
+                await st.write(Request("echo", {"data": blob}).encode())
+            except ConnectionError:
+                break
+            if i % 32 == 31:
+                await asyncio.sleep(0)      # let the loop breathe
+
+
 class FleetServer:
     """The server side of the simulation: real AgentsManager admission,
     real JobsManager fairness, real datastore sessions — reached over
     real mux connections (the production ``Server`` minus DB/TLS/web)."""
 
-    def __init__(self, datastore_dir: str, cfg: FleetConfig):
+    def __init__(self, datastore_dir: str, cfg: FleetConfig, *,
+                 jobs: "JobsManager | None" = None,
+                 shared_instance: str = ""):
         self.cfg = cfg
         max_sessions = cfg.max_sessions or (2 * cfg.n_agents + 16)
         self.agents = AgentsManager(
             is_expected=None, rate=cfg.client_rate, burst=cfg.client_burst,
             max_sessions=max_sessions, open_rate=cfg.open_rate)
-        self.jobs = JobsManager(max_concurrent=cfg.max_concurrent,
-                                max_queued=cfg.max_queued)
+        # an injected JobsManager lets the multiproc worker route every
+        # enqueue through its JobQueueService (the DB-shared bound)
+        # while this class keeps owning the data plane
+        self.jobs = jobs if jobs is not None else JobsManager(
+            max_concurrent=cfg.max_concurrent, max_queued=cfg.max_queued)
         self.store = LocalStore(datastore_dir,
-                                ChunkerParams(avg_size=cfg.chunk_avg))
+                                ChunkerParams(avg_size=cfg.chunk_avg),
+                                shared_instance=shared_instance or None)
         self.router = Router()
 
         async def ping(req, ctx):
             return {"pong": True}
         self.router.handle("ping", ping)
+
+        async def echo(req, ctx):
+            """Payload mirror — gives the hostile slow-reader profile a
+            server→agent byte stream to refuse to drain (the shed needs
+            OUR writes to block, and backups stream agent→server)."""
+            return {"data": req.payload.get("data", "")}
+        self.router.handle("echo", echo)
         self._server: Optional[asyncio.AbstractServer] = None
         self.conns: list[MuxConnection] = []
         self.port = 0
@@ -523,6 +626,12 @@ class FleetReport:
     sync_chunks: int = 0
     sync_wire_bytes: int = 0
     sync_failures: dict = field(default_factory=dict)  # job_id → error
+    # hostile profile observations, SERVER side (the soak's assertion
+    # surface: the abuse must be seen and survived by the server, not
+    # merely attempted by the agents)
+    hostile_run: int = 0
+    server_flow_violations: int = 0
+    server_write_deadline_sheds: int = 0
     # per-histogram snapshot taken at soak start: the report's
     # percentiles are bucket-diff quantiles of the PROCESS-SHARED
     # /metrics histograms (ISSUE 12 — one quantile implementation,
@@ -583,6 +692,9 @@ class FleetReport:
             "sync_failed": self.sync_failed,
             "sync_chunks": self.sync_chunks,
             "sync_wire_bytes": self.sync_wire_bytes,
+            "hostile_run": self.hostile_run,
+            "server_flow_violations": self.server_flow_violations,
+            "server_write_deadline_sheds": self.server_write_deadline_sheds,
         }
 
 
@@ -731,7 +843,44 @@ async def run_fleet_async(datastore_dir: str,
     # kinds of traffic contend for the same execution slots
     for i in range(cfg.sync_jobs):
         submit_sync(f"fleet-sync-{i:02d}")
+    # hostile agents attack CONCURRENTLY with the backup round: the
+    # server must count + survive the abuse while the legit fleet
+    # publishes (ISSUE 15 satellite)
+    hostile_tasks: list[asyncio.Task] = []
+    hostiles: list[HostileAgent] = []
+    for h in range(cfg.hostile_agents):
+        ha = HostileAgent(f"hostile-{h:03d}", "127.0.0.1", port,
+                          {"f.bin": b"\0" * 64},
+                          connect_attempts=cfg.connect_attempts,
+                          write_deadline_s=0.0)   # never shed OUR writes
+        await ha.start()
+        hostiles.append(ha)
+        hostile_tasks.append(asyncio.create_task(
+            ha.run_attacks(echo_calls=cfg.hostile_echo_calls,
+                           echo_bytes=cfg.hostile_echo_bytes),
+            name=f"hostile:{ha.cn}"))
     await server.jobs.drain(timeout=cfg.job_timeout_s)
+    if hostile_tasks:
+        await asyncio.wait_for(asyncio.gather(*hostile_tasks),
+                               cfg.job_timeout_s)
+        report.hostile_run = len(hostiles)
+        # the shed fires up to one write deadline AFTER the refused
+        # responses were queued — wait it out (bounded), then read the
+        # server-side counters the soak asserts on
+        deadline = time.perf_counter() + \
+            max(2.0, 3.0 * cfg.mux_write_deadline_s)
+        while time.perf_counter() < deadline:
+            srv_stats = server.mux_stats()
+            if srv_stats.get("write_deadline_sheds", 0) >= 1 and \
+                    srv_stats.get("flow_violations", 0) >= len(hostiles):
+                break
+            await asyncio.sleep(0.05)
+        srv_stats = server.mux_stats()
+        report.server_flow_violations = srv_stats.get("flow_violations", 0)
+        report.server_write_deadline_sheds = srv_stats.get(
+            "write_deadline_sheds", 0)
+        for ha in hostiles:
+            await ha.stop()
     report.breaker_states_round1 = {
         k: cb.state for k, cb in server.jobs._breakers.items()}
     report.killed = {a.cn for a in agents.values() if a.dead}
@@ -782,3 +931,453 @@ async def run_fleet_async(datastore_dir: str,
 def run_fleet(datastore_dir: str, cfg: FleetConfig) -> FleetReport:
     """Sync wrapper: one fresh event loop per soak."""
     return asyncio.run(run_fleet_async(datastore_dir, cfg))
+
+
+# -- two-process shared-datastore soak (ISSUE 15) ---------------------------
+
+@dataclass
+class MultiProcConfig:
+    """Knobs for ``run_multiproc_fleet``: two REAL server subprocesses
+    (server/fleetproc.py) over ONE datastore directory and ONE SQLite
+    database, agents dialing each over loopback aRPC from this
+    process."""
+    n_agents: int = 8                  # per server process
+    shared_fraction: float = 0.5       # agents whose tree BYTES repeat
+    #                                    across processes (the cross-
+    #                                    process written-once probe)
+    files_per_agent: int = 2
+    file_size: int = 8 << 10
+    chunk_avg: int = 4 << 10
+    processes: int = 2
+    max_concurrent: int = 4
+    max_queued: int = 512              # the SHARED bound (db-wide)
+    gc_ttl_s: float = 2.0
+    gc_grace_s: float = 0.0
+    kill_leader: bool = True           # SIGKILL the sweeping leader
+    kill_slow_sweep_s: float = 6.0     # sweep stall while it dies
+    seed: int = 2026
+    job_timeout_s: float = 180.0
+    spawn_timeout_s: float = 120.0
+
+
+@dataclass
+class MultiProcReport:
+    cfg: MultiProcConfig
+    published: int = 0
+    failed: int = 0
+    failures: dict = field(default_factory=dict)
+    wall_s: float = 0.0
+    # written-once accounting summed across the fleet's /metrics
+    chunks_written_total: int = 0
+    cross_process_hits: int = 0
+    index_hits_total: int = 0
+    distinct_chunks_after: int = 0
+    chunks_removed_total: int = 0
+    written_once: bool = False
+    # exactly-once GC per cycle under the lease
+    gc_cycles: int = 0
+    gc_swept: int = 0
+    gc_held: int = 0
+    gc_outcomes: list = field(default_factory=list)   # per-cycle detail
+    lease_counters: dict = field(default_factory=dict)   # proc → dict
+    # leader-kill failover
+    leader_killed: str = ""
+    failover_s: float = 0.0
+    failover_outcome: str = ""
+    steals_total: int = 0
+    doomed_resurrected: int = 0
+    doomed_on_disk: int = 0
+    live_missing: int = 0
+    # per-service lock-wait histogram quantiles per process (the trace
+    # ladder: where the old one-big-_prune_lock convoy would show)
+    service_lock_wait: dict = field(default_factory=dict)
+    queue_counts: dict = field(default_factory=dict)
+    admission: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "processes": self.cfg.processes,
+            "n_agents_per_proc": self.cfg.n_agents,
+            "published": self.published,
+            "failed": self.failed,
+            "wall_s": round(self.wall_s, 3),
+            "chunks_written_total": self.chunks_written_total,
+            "cross_process_hits": self.cross_process_hits,
+            "index_hits_total": self.index_hits_total,
+            "distinct_chunks_after": self.distinct_chunks_after,
+            "chunks_removed_total": self.chunks_removed_total,
+            "written_once": self.written_once,
+            "gc_cycles": self.gc_cycles,
+            "gc_swept": self.gc_swept,
+            "gc_held": self.gc_held,
+            "gc_outcomes": list(self.gc_outcomes),
+            "lease_counters": dict(self.lease_counters),
+            "leader_killed": self.leader_killed,
+            "failover_s": round(self.failover_s, 3),
+            "failover_outcome": self.failover_outcome,
+            "failover_ttl_s": self.cfg.gc_ttl_s,
+            "steals_total": self.steals_total,
+            "doomed_resurrected": self.doomed_resurrected,
+            "doomed_on_disk": self.doomed_on_disk,
+            "live_missing": self.live_missing,
+            "service_lock_wait": dict(self.service_lock_wait),
+            "queue_counts": dict(self.queue_counts),
+            "admission": dict(self.admission),
+        }
+
+
+class _WorkerProc:
+    """One fleetproc subprocess + its JSON event stream."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.proc: "asyncio.subprocess.Process | None" = None
+        self.port = 0
+        self.pid = 0
+        # driver-paced: a worker only ever emits in response to driver
+        # commands (one event per command, one `done` per submitted
+        # job), so depth is bounded by the driver's own outstanding
+        # work — an explicit maxsize would just deadlock the pump
+        # against a slow assertion.
+        self._events: asyncio.Queue = \
+            asyncio.Queue()   # pbslint: disable=bounded-queue-discipline
+        self._pump: "asyncio.Task | None" = None
+
+    async def spawn(self, argv: list[str], timeout: float) -> None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        self.proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "pbs_plus_tpu.server.fleetproc", *argv,
+            stdin=asyncio.subprocess.PIPE, stdout=asyncio.subprocess.PIPE,
+            env=env)
+        self._pump = asyncio.create_task(self._pump_events(),
+                                         name=f"fleetproc-pump:{self.name}")
+        ready = await self.expect("ready", timeout=timeout)
+        self.port, self.pid = ready["port"], ready["pid"]
+
+    async def _pump_events(self) -> None:
+        assert self.proc is not None and self.proc.stdout is not None
+        while True:
+            line = await self.proc.stdout.readline()
+            if not line:
+                self._events.put_nowait(None)       # EOF sentinel
+                return
+            try:
+                self._events.put_nowait(json.loads(line))
+            except ValueError:
+                L.warning("fleetproc %s: bad event line %r",
+                          self.name, line[:200])
+
+    def send(self, msg: dict) -> None:
+        assert self.proc is not None and self.proc.stdin is not None
+        self.proc.stdin.write((json.dumps(msg) + "\n").encode())
+
+    async def expect(self, event: str, timeout: float = 60.0) -> dict:
+        """Next event of the given type.  Non-matching events are
+        DROPPED, not re-buffered — the driver's command choreography
+        must consume every command's reply in order (sending a second
+        command before reading the first's event loses the reply)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise asyncio.TimeoutError(
+                    f"fleetproc {self.name}: no {event!r} within "
+                    f"{timeout}s")
+            msg = await asyncio.wait_for(self._events.get(), left)
+            if msg is None:
+                # keep the EOF sentinel visible: later expects must
+                # fail fast too, not hang out their whole timeout
+                self._events.put_nowait(None)
+                raise ConnectionError(
+                    f"fleetproc {self.name} exited while waiting for "
+                    f"{event!r}")
+            if msg.get("event") == event:
+                return msg
+
+    def kill(self) -> None:
+        assert self.proc is not None
+        self.proc.kill()                            # SIGKILL, no cleanup
+
+    async def shutdown(self, timeout: float = 30.0) -> None:
+        if self.proc is None:
+            return
+        if self.proc.returncode is None:
+            try:
+                self.send({"cmd": "exit"})
+                await self.expect("bye", timeout=timeout)
+            except (ConnectionError, asyncio.TimeoutError, OSError):
+                try:
+                    self.proc.kill()
+                except ProcessLookupError:
+                    pass        # died between the check and the kill
+        await self.proc.wait()
+        if self._pump is not None:
+            self._pump.cancel()
+            try:
+                await self._pump
+            except asyncio.CancelledError:
+                pass
+
+
+def _multiproc_trees(cfg: MultiProcConfig) -> "dict[str, dict]":
+    """cn → tree for every agent of every process.  The first
+    ``shared_fraction`` of each process's agents share tree BYTES with
+    their cross-process twin (same (seed, idx) → same chunks from two
+    different processes — the written-once probe); the rest are unique
+    per process."""
+    trees: dict[str, dict] = {}
+    n_shared = int(cfg.n_agents * cfg.shared_fraction)
+    for w in range(cfg.processes):
+        for i in range(cfg.n_agents):
+            cn = f"p{w}-a{i:03d}"
+            idx = i if i < n_shared else 1000 + w * cfg.n_agents + i
+            trees[cn] = synthetic_tree(cfg.seed, idx,
+                                       cfg.files_per_agent, cfg.file_size)
+    return trees
+
+
+async def run_multiproc_fleet_async(root_dir: str,
+                                    cfg: MultiProcConfig
+                                    ) -> MultiProcReport:
+    """The two-process shared-datastore soak (ISSUE 15 acceptance):
+
+    1. spawn ``cfg.processes`` fleetproc workers over one datastore +
+       one DB; dial agents at each from this process (loopback aRPC);
+    2. run one backup per agent through BOTH processes' job planes —
+       every job must publish through the ONE shared bounded queue;
+    3. written-once: Σ chunks_written across the fleet's /metrics must
+       equal the distinct chunk files ever created (cross-process
+       collisions resolve via the os.link claim, counted as
+       cross_process_hits — asserted > 0, the collision really raced);
+    4. GC cycles: both processes sweep on the same tick — exactly one
+       wins the lease per cycle (swept + held == processes);
+    5. leader-kill failover: SIGKILL the sweeping leader mid-sweep (a
+       delay failpoint holds the sweep open); the survivor's next cycle
+       STEALS the expired lease within one TTL and completes the sweep
+       — zero double-unlinks, zero resurrected digests, zero lost live
+       chunks (disk + index re-checked)."""
+    from ..pxar.datastore import Datastore
+    report = MultiProcReport(cfg=cfg)
+    t_start = time.perf_counter()
+    datastore_dir = os.path.join(root_dir, "ds")
+    state_dir = os.path.join(root_dir, "state")
+    os.makedirs(datastore_dir, exist_ok=True)
+    os.makedirs(state_dir, exist_ok=True)
+
+    workers = [_WorkerProc(f"p{w}") for w in range(cfg.processes)]
+    agents: dict[str, SimAgent] = {}
+    try:
+        await asyncio.gather(*(
+            w.spawn(["--state-dir", state_dir,
+                     "--datastore", datastore_dir,
+                     "--proc-id", w.name,
+                     "--gc-ttl", str(cfg.gc_ttl_s),
+                     "--chunk-avg", str(cfg.chunk_avg),
+                     "--max-agents", str(2 * cfg.n_agents + 8),
+                     "--max-concurrent", str(cfg.max_concurrent),
+                     "--max-queued", str(cfg.max_queued)],
+                    cfg.spawn_timeout_s)
+            for w in workers))
+
+        trees = _multiproc_trees(cfg)
+        for w_i, w in enumerate(workers):
+            for i in range(cfg.n_agents):
+                cn = f"p{w_i}-a{i:03d}"
+                a = SimAgent(cn, "127.0.0.1", w.port, trees[cn])
+                await a.start()
+                agents[cn] = a
+
+        # -- one backup per agent through both job planes ------------------
+        pending: dict[str, int] = {}
+        for w_i, w in enumerate(workers):
+            for i in range(cfg.n_agents):
+                cn = f"p{w_i}-a{i:03d}"
+                w.send({"cmd": "backup", "cn": cn, "job_id": f"job-{cn}",
+                        "tenant": f"tenant-{i % 4}"})
+                pending[f"job-{cn}"] = w_i
+        for w_i, w in enumerate(workers):
+            mine = sum(1 for v in pending.values() if v == w_i)
+            for _ in range(mine):
+                done = await w.expect("done", timeout=cfg.job_timeout_s)
+                if done["ok"]:
+                    report.published += 1
+                else:
+                    report.failed += 1
+                    report.failures[done["job_id"]] = done.get("error", "")
+
+        # -- GC cycle with both processes racing the lease -----------------
+        def gc_all():
+            for w in workers:
+                w.send({"cmd": "gc", "grace": cfg.gc_grace_s})
+
+        async def gc_results() -> list[dict]:
+            out = []
+            for w in workers:
+                await w.expect("gc_running", timeout=30)
+                res = await w.expect("gc_result", timeout=60)
+                report.gc_outcomes.append(
+                    {"proc": w.name, "outcome": res["outcome"],
+                     "detail": res.get("detail", "")})
+                out.append(res)
+            return out
+
+        ds_view = Datastore(datastore_dir, dedup_index_mb=0)
+
+        def digests_of(refs) -> set:
+            out = set()
+            for ref in refs:
+                for idx in ds_view.load_indexes(ref):
+                    for k in range(len(idx.ends)):
+                        out.add(idx.digests[k].tobytes())
+            return out
+
+        def split_live(doom_ids: set) -> tuple[set, set]:
+            """(doomed-unique digests, live digests) for dropping the
+            given backup_ids' snapshot groups."""
+            all_refs = list(ds_view.list_snapshots(all_namespaces=True))
+            doomed_refs = [r for r in all_refs if r.backup_id in doom_ids]
+            live_refs = [r for r in all_refs if r.backup_id not in doom_ids]
+            live = digests_of(live_refs)
+            return digests_of(doomed_refs) - live, live
+
+        # cycle 1: no garbage — still exactly-once (one swept, rest held)
+        gc_all()
+        res1 = await gc_results()
+        report.gc_cycles += 1
+        report.gc_swept += sum(1 for r in res1 if r["outcome"] == "swept")
+        report.gc_held += sum(1 for r in res1 if r["outcome"] == "held")
+
+        # cycle 2: real garbage (drop two p0-unique groups on worker 0)
+        n_shared = int(cfg.n_agents * cfg.shared_fraction)
+        doom1 = {f"p0-a{i:03d}" for i in (n_shared, n_shared + 1)
+                 if i < cfg.n_agents}
+        doomed1, _live1 = split_live(doom1)
+        for cn in sorted(doom1):
+            workers[0].send({"cmd": "drop_group", "cn": cn})
+            await workers[0].expect("dropped", timeout=30)
+        gc_all()
+        res2 = await gc_results()
+        report.gc_cycles += 1
+        report.gc_swept += sum(1 for r in res2 if r["outcome"] == "swept")
+        report.gc_held += sum(1 for r in res2 if r["outcome"] == "held")
+        report.chunks_removed_total += sum(
+            r.get("chunks_removed", 0) for r in res2)
+
+        # written-once accounting BEFORE any kill: every chunk write
+        # happened in the backup phase, and a SIGKILLed leader takes
+        # its claim counters with it — collect while both are alive
+        for w in workers:
+            w.send({"cmd": "metrics"})
+        for w in workers:
+            m = await w.expect("metrics", timeout=30)
+            report.chunks_written_total += m["store"]["chunks_written"]
+            report.cross_process_hits += m["store"]["cross_process_hits"]
+            report.index_hits_total += m["dedup_index"]["hits"]
+
+        # -- leader-kill failover ------------------------------------------
+        doomed2: set = set()
+        live2: set = set()
+        if cfg.kill_leader:
+            doom2 = {f"p1-a{i:03d}" for i in (n_shared, n_shared + 1)
+                     if i < cfg.n_agents}
+            doomed2, live2 = split_live(doom2)
+            for cn in sorted(doom2):
+                workers[1].send({"cmd": "drop_group", "cn": cn})
+                await workers[1].expect("dropped", timeout=30)
+            leader, survivor = workers[0], workers[1]
+            # the cycle-2 winner still HOLDS its lease as an unexpired
+            # cycle marker — wait it out (or until the leader-designate
+            # already owns it) so the stalled sweep below is guaranteed
+            # to win the lease before the kill
+            from . import database as _database
+            ctrl_db = _database.Database(
+                os.path.join(state_dir, conf.DEFAULT_DB_NAME))
+            try:
+                deadline = time.monotonic() + 3 * cfg.gc_ttl_s + 5
+                while time.monotonic() < deadline:
+                    lease = ctrl_db.get_gc_lease()
+                    if lease is None or lease["holder"] == leader.name \
+                            or lease["expires_at"] < time.time():
+                        break
+                    await asyncio.sleep(0.05)
+            finally:
+                ctrl_db.close()
+            # leader alone runs a STALLED sweep (delay failpoint), so
+            # the kill lands mid-sweep with the lease held
+            leader.send({"cmd": "gc", "grace": cfg.gc_grace_s,
+                         "slow": cfg.kill_slow_sweep_s})
+            await leader.expect("gc_running", timeout=30)
+            await leader.expect("gc_started", timeout=30)   # lease won
+            leader.kill()
+            report.leader_killed = leader.name
+            t_kill = time.perf_counter()
+            # the survivor hammers gc until the expired lease is stolen
+            outcome = ""
+            while time.perf_counter() - t_kill < \
+                    cfg.gc_ttl_s + max(5.0, 3 * cfg.gc_ttl_s):
+                survivor.send({"cmd": "gc", "grace": cfg.gc_grace_s})
+                await survivor.expect("gc_running", timeout=30)
+                res = await survivor.expect("gc_result", timeout=60)
+                if res["outcome"] == "swept":
+                    outcome = "swept"
+                    report.failover_s = time.perf_counter() - t_kill
+                    report.chunks_removed_total += res["chunks_removed"]
+                    break
+                await asyncio.sleep(min(0.25, cfg.gc_ttl_s / 4))
+            report.failover_outcome = outcome
+
+            # coherence re-check: doomed digests are GONE from disk and
+            # from the survivor's index; live digests all present
+            doomed_list = sorted(doomed1 | doomed2)
+            report.doomed_on_disk = sum(
+                ds_view.chunks.on_disk_many(doomed_list))
+            survivor.send({"cmd": "probe",
+                           "digests": [d.hex() for d in doomed_list]})
+            probe = await survivor.expect("probe", timeout=30)
+            report.doomed_resurrected = sum(probe["present"])
+            live_list = sorted(live2)
+            report.live_missing = len(live_list) - sum(
+                ds_view.chunks.on_disk_many(live_list))
+
+        # -- lease counters + lock-wait ladder from the survivors ----------
+        live_workers = [w for w in workers
+                        if w.proc is not None and w.proc.returncode is None]
+        for w in live_workers:
+            w.send({"cmd": "metrics"})
+        for w in live_workers:
+            m = await w.expect("metrics", timeout=30)
+            report.lease_counters[w.name] = m["gc_lease"]
+            report.steals_total += m["gc_lease"]["steals"]
+            report.service_lock_wait[w.name] = m["service_lock_wait"]
+            report.queue_counts = m["queue_counts"]
+            report.admission = m["admission"]
+        report.distinct_chunks_after = sum(
+            1 for _ in ds_view.chunks.iter_digests())
+        # the written-once identity over the whole run: every chunk file
+        # was CREATED exactly once (the link claim never overwrites), so
+        # the fleet's summed claim counters — captured before any kill —
+        # must equal distinct-ever == still-on-disk + swept
+        report.written_once = (
+            report.chunks_written_total ==
+            report.distinct_chunks_after + report.chunks_removed_total)
+    finally:
+        for a in agents.values():
+            try:
+                await a.stop()
+            except Exception as e:          # killed worker's peers
+                L.debug("multiproc agent stop: %s", e)
+        for w in workers:
+            await w.shutdown()
+    report.wall_s = time.perf_counter() - t_start
+    return report
+
+
+def run_multiproc_fleet(root_dir: str,
+                        cfg: MultiProcConfig) -> MultiProcReport:
+    """Sync wrapper: one fresh event loop per multiproc soak."""
+    return asyncio.run(run_multiproc_fleet_async(root_dir, cfg))

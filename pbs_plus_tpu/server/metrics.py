@@ -207,6 +207,10 @@ histogram("pbs_plus_sync_batch_seconds",
 histogram("pbs_plus_mux_frame_write_seconds",
           "Mux frame write incl. transport drain (slow readers surface "
           "in the tail)")
+histogram("pbs_plus_service_lock_wait_seconds",
+          "Wait to acquire a server service's own lock, by service "
+          "(ISSUE 15: where the old Server._prune_lock convoy would "
+          "reappear if the service split ever regressed)")
 
 
 class MetricsRegistry:
@@ -749,6 +753,39 @@ class MetricsRegistry:
         gauge("pbs_plus_prune_last_bytes_freed",
               "Bytes freed by the last GC",
               [({}, float(lp["bytes_freed"]))] if lp else [])
+        # -- shared-datastore scale-out (ISSUE 15; services/prune_service
+        #    leader lease + pxar/datastore cross-process write claims) ------
+        from ..pxar import datastore as _pxds
+        from .services import prune_service as _prune_svc
+        gl = _prune_svc.metrics_snapshot()
+        gauge("pbs_plus_gc_lease_acquisitions_total",
+              "GC leader-lease acquisitions by this process (fresh "
+              "grants; renewals and steals counted separately)",
+              [({}, float(gl["acquisitions"]))])
+        gauge("pbs_plus_gc_lease_renewals_total",
+              "GC leader-lease heartbeat renewals (ttl/3 cadence while "
+              "a sweep runs)", [({}, float(gl["renewals"]))])
+        gauge("pbs_plus_gc_lease_steals_total",
+              "Expired GC leases stolen from a dead holder (failover "
+              "within one TTL)", [({}, float(gl["steals"]))])
+        gauge("pbs_plus_gc_lease_held_skips_total",
+              "GC cycles skipped because a live peer held the lease "
+              "(the exactly-once-per-cycle witness)",
+              [({}, float(gl["held_skips"]))])
+        st = _pxds.metrics_snapshot()
+        gauge("pbs_plus_store_chunks_written_total",
+              "Full-blob chunk writes this process claimed (shared "
+              "datastores: summed across the fleet == distinct chunks "
+              "written once)", [({}, float(st["chunks_written"]))])
+        gauge("pbs_plus_store_cross_process_hits_total",
+              "Novel-chunk claims lost to a sibling process that "
+              "already held the chunk (the os.link CAS EEXIST — a "
+              "cross-process dedup hit, never a second write)",
+              [({}, float(st["cross_process_hits"]))])
+        gauge("pbs_plus_jobs_queued_shared",
+              "DB-wide queued jobs across every process sharing this "
+              "datastore (the shared bound's denominator)",
+              [({}, float(s.db.queue_depth()))])
         gauge("pbs_plus_db_bytes", "SQLite database size",
               [({}, float(s.db.file_size()))])
         gauge("pbs_plus_scrape_timestamp", "Scrape time", [({}, time.time())])

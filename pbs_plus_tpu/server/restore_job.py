@@ -126,9 +126,16 @@ def enqueue_restore(server, *, target: str, snapshot: str,
 
     from .jobs import QueueFullError
     try:
-        server.jobs.enqueue(Job(id=rid, kind="restore", tenant=target,
-                                execute=execute, on_success=on_success,
-                                on_error=on_error))
+        # through the JobQueueService when the server has one (ISSUE
+        # 15): a restore must land a shared job_queue row, or a SIBLING
+        # process's GC-lease winner cannot see it running fleet-wide
+        # and could prune the very snapshot this restore is reading
+        job_queue = getattr(server, "job_queue", None)
+        submit = job_queue.submit if job_queue is not None \
+            else server.jobs.enqueue
+        submit(Job(id=rid, kind="restore", tenant=target,
+                   execute=execute, on_success=on_success,
+                   on_error=on_error))
     except QueueFullError as e:
         server.db.append_task_log(upid, f"error: {e}")
         server.db.finish_task(upid, database.STATUS_ERROR)

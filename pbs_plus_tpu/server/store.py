@@ -5,6 +5,17 @@ DB, app services, AgentsManager, jobs Manager, notification tracker,
 CertManager) and internal/server/bootstrap.go:29-196 (startup sequence:
 cleanup queued backups → secret key → CA validate → stale-mount cleanup →
 RPC servers in self-restarting loops → jobs manager → scheduler).
+
+ISSUE 15 shattered the inherited god-object shape: the jobs plane,
+prune/GC, checkpoints self-heal, the chunk-cache config and the sync
+observability state each live in a narrow service
+(``server/services/``), every one owning its own lock and state —
+``Server`` is reduced to THE composition root that constructs them and
+wires their cross-service needs as narrow callables.  The legacy
+attribute surface (``server.jobs``, ``server.last_prune``,
+``server._gc_active``, ...) is preserved as delegating properties so
+the web/metrics/test layers keep working unchanged.  See
+docs/architecture.md "Service map".
 """
 
 from __future__ import annotations
@@ -23,17 +34,18 @@ from ..utils import conf, crypto
 from ..utils.log import L
 from ..utils.mtls import CertManager
 from . import database
-from .backup_job import (make_batch_hasher, make_chunker_factory,
-                         run_target_backup)
-from .jobs import Job, JobsManager, QueueFullError
+from .backup_job import make_batch_hasher, make_chunker_factory
 from .scheduler import Scheduler
+from .services import (CheckpointService, ChunkCacheService,
+                       JobQueueService, PruneService, SyncStateService)
 
 
 def make_upid(kind: str, job_id: str) -> str:
-    """PBS-compatible unique process id for task logs (proxmox/upid.py —
-    reference: internal/proxmox/upid.go:23-141)."""
-    from ..proxmox import new_upid
-    return str(new_upid(kind, job_id))
+    """PBS-compatible unique process id for task logs — re-exported for
+    the web/verification importers; the shared implementation lives in
+    proxmox/upid.py so the TLS-free jobs service mints identically."""
+    from ..proxmox import make_upid as _make_upid
+    return _make_upid(kind, job_id)
 
 
 @dataclass
@@ -141,6 +153,17 @@ class ServerConfig:
     agent_open_rate: float = -1.0
     agent_max_sessions: int = -1
     max_queued_jobs: int = -1
+    # shared-datastore scale-out (ISSUE 15, docs/architecture.md
+    # "Service map"): shared_instance names THIS process when several
+    # server processes open one datastore ("" falls back to
+    # PBS_PLUS_SHARED_DATASTORE; empty everywhere = single-process
+    # mode).  When set, the chunk store claims novel chunks with an
+    # os.link CAS (written exactly once across processes) and keeps its
+    # index spill/snapshot state per-instance.  gc_lease_ttl_s is the
+    # GC leader lease TTL: a killed sweeper is stolen from within one
+    # TTL (server/services/prune_service.py)
+    shared_instance: str = ""
+    gc_lease_ttl_s: float = 30.0
 
 
 class Server:
@@ -166,15 +189,13 @@ class Server:
                        else config.agent_open_rate),
             max_sessions=(None if config.agent_max_sessions < 0
                           else config.agent_max_sessions))
-        self.jobs = JobsManager(
-            max_concurrent=config.max_concurrent,
-            max_queued=(None if config.max_queued_jobs < 0
-                        else config.max_queued_jobs))
-        if config.chunk_cache_mb >= 0:
-            from ..pxar import chunkcache
-            chunkcache.configure_shared(
-                max_bytes=config.chunk_cache_mb << 20)
+        # -- the service split (ISSUE 15): each service owns its own
+        # lock and state; cross-service needs are wired as NARROW
+        # callables (never the peer service object) -----------------------
+        self.chunk_cache = ChunkCacheService(
+            chunk_cache_mb=config.chunk_cache_mb)
         params = ChunkerParams(avg_size=config.chunk_avg)
+        shared = config.shared_instance or conf.env().shared_datastore
         self.datastore = LocalStore(
             config.datastore_dir, params,
             chunker_factory=make_chunker_factory(
@@ -193,34 +214,93 @@ class Server:
             delta_threshold=(None if config.delta_threshold < 0
                              else config.delta_threshold),
             delta_max_chain=(None if config.delta_max_chain < 0
-                             else config.delta_max_chain))
+                             else config.delta_max_chain),
+            shared_instance=shared)
+        holder = f"{config.hostname}:{shared or os.getpid()}"
+        self.prune = PruneService(
+            datastore=self.datastore,
+            policy_factory=self.prune_policy,
+            # narrow gate into the jobs plane, late-bound on purpose:
+            # the job queue is constructed just below
+            jobs_active=lambda: self.job_queue.active_count,
+            db=self.db, holder=holder,
+            lease_ttl_s=config.gc_lease_ttl_s)
+        self.job_queue = JobQueueService(
+            db=self.db, config=config, agents=self.agents,
+            datastore=self.datastore,
+            gc_active=lambda: self.prune.fleet_gc_active(),
+            checkpoint_interval=lambda: self.checkpoints.interval(),
+            max_concurrent=config.max_concurrent,
+            max_queued=(None if config.max_queued_jobs < 0
+                        else config.max_queued_jobs),
+            owner=holder, reap_all_on_boot=not shared)
+        self.checkpoints = CheckpointService(
+            db=self.db, config=config,
+            enqueue_backup=self.enqueue_backup)
+        self.sync_state = SyncStateService()
         self.scheduler = Scheduler(
             self.db, self.jobs,
             enqueue_backup=self._enqueue_backup_row,
             enqueue_verification=self._enqueue_verification,
             enqueue_sync=self._enqueue_sync)
+        self.job_queue.on_backup_complete = \
+            self.scheduler.on_backup_complete
         self.router = Router()          # control-plane server handlers
         self._register_handlers()
         # routers pre-attached to expected job sessions (restore jobs serve
         # the remote-archive protocol on their data session)
         self._job_routers: dict[str, Router] = {}
         self._arpc_server: Optional[asyncio.AbstractServer] = None
-        # notification batch tracker (reference: BatchTracker.RecordJobResult
-        # in the backup OnSuccess path) — a sink is attached by the caller
-        self.notifications = None
         self.mount_service = None       # lazily created by the web layer
         self.job_rpc = None             # unix-socket job mutation service
-        self._prune_lock = asyncio.Lock()   # serializes prune/GC/delete
-        self._gc_active = False             # backups wait while GC runs
-        self.last_prune: dict = {}          # metrics: last prune/GC stats
         self._tasks: list[asyncio.Task] = []
         self.log = L.with_scope(component="server")
-        # observability state (metrics.py): live per-job progress objects
-        # and the last finished run's stats, both in-memory
         self.started_at = time.time()
-        self.live_progress: dict[str, tuple[float, object]] = {}
-        self.last_run_stats: dict[str, dict] = {}
-        self.last_sync_stats: dict[str, dict] = {}
+
+    # -- legacy attribute surface (delegating into the services) ----------
+    @property
+    def jobs(self):
+        """The JobsManager (owned by JobQueueService)."""
+        return self.job_queue.jobs
+
+    @property
+    def notifications(self):
+        """Notification batch tracker (reference: BatchTracker.
+        RecordJobResult in the backup OnSuccess path) — a sink attached
+        by the caller, consumed by the jobs plane."""
+        return self.job_queue.notifications
+
+    @notifications.setter
+    def notifications(self, sink) -> None:
+        self.job_queue.notifications = sink
+
+    @property
+    def live_progress(self) -> dict:
+        return self.job_queue.live_progress
+
+    @property
+    def last_run_stats(self) -> dict:
+        return self.job_queue.last_run_stats
+
+    @property
+    def last_sync_stats(self) -> dict:
+        """Snapshot view; writers go through ``sync_state.record``."""
+        return self.sync_state.view()
+
+    @property
+    def last_prune(self) -> dict:
+        return self.prune.last_prune
+
+    @property
+    def _gc_active(self) -> bool:
+        # fleet-wide: a sibling process's sweep (live lease row) gates
+        # this process's restore/sync/verify starts exactly like a
+        # local one
+        return self.prune.fleet_gc_active()
+
+    @property
+    def _prune_lock(self) -> asyncio.Lock:
+        return self.prune.lock
 
     # -- admission ---------------------------------------------------------
     async def _is_expected_host(self, cn: str, cert_der: bytes) -> bool:
@@ -301,7 +381,7 @@ class Server:
         return port
 
     async def start(self) -> None:
-        self._cleanup_orphaned_tasks()
+        self.checkpoints.cleanup_orphaned_tasks()
         from .mount_service import MountService
         self.mount_service = MountService(self)
         # stale-mount reaping shells out (fusermount) — keep it off the loop
@@ -317,55 +397,8 @@ class Server:
         await self.job_rpc.start()
         self._tasks.append(asyncio.create_task(self.scheduler.run()))
         if self.config.prune_schedule:
-            self._tasks.append(asyncio.create_task(self._prune_loop()))
-
-    def _cleanup_orphaned_tasks(self) -> None:
-        """Tasks still 'running' at startup died with the previous process —
-        convert them to error tasks (reference: cleanupQueuedBackups,
-        internal/server/bootstrap.go:136-171), then re-enqueue the backup
-        jobs among them as resumable: with durable checkpoints
-        (server/checkpoint.py) the re-run picks up from the last
-        checkpoint, so a server crash mid-backup self-heals on restart."""
-        from .backup_job import crashed_backup_job_ids
-        orphans = self.db.list_running_tasks()
-        requeue = crashed_backup_job_ids(self.db, orphans)
-        for t in orphans:
-            self.db.append_task_log(
-                t["upid"], "error: interrupted by server restart")
-            self.db.finish_task(t["upid"], database.STATUS_ERROR)
-        if orphans:
-            self.log.warning("converted %d orphaned tasks to errors",
-                             len(orphans))
-        if not requeue or self.config.resume_requeue_delay_s < 0:
-            return
-        try:
-            loop = asyncio.get_running_loop()
-        except RuntimeError:
-            self.log.warning("no running event loop: %d crashed "
-                             "backup(s) not re-enqueued", len(requeue))
-            return
-        self._tasks.append(loop.create_task(
-            self._requeue_crashed(requeue)))
-        # logged only once the requeue is actually scheduled, so the
-        # task log never promises a resume that was disabled/failed
-        for t in orphans:
-            if t["kind"] == "backup" and t["job_id"] in requeue:
-                self.db.append_task_log(
-                    t["upid"], "re-enqueued for resume after restart")
-
-    async def _requeue_crashed(self, job_ids: list[str]) -> None:
-        """Startup self-heal: give agents a moment to reconnect, then
-        re-enqueue the backups that died with the previous process."""
-        if self.config.resume_requeue_delay_s:
-            await asyncio.sleep(self.config.resume_requeue_delay_s)
-        for jid in job_ids:
-            try:
-                self.enqueue_backup(jid)
-                self.log.info("re-enqueued crashed backup %s for resume",
-                              jid)
-            except Exception as e:
-                self.log.warning("re-enqueue of crashed backup %s "
-                                 "failed: %s", jid, e)
+            self._tasks.append(asyncio.create_task(
+                self.prune.run_loop(self.config.prune_schedule)))
 
     async def stop(self) -> None:
         if getattr(self, "job_rpc", None) is not None:
@@ -373,6 +406,7 @@ class Server:
         if self.mount_service is not None:
             await self.mount_service.unmount_all()
         self.scheduler.stop()
+        await self.checkpoints.stop()
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:
@@ -390,7 +424,10 @@ class Server:
                 await asyncio.wait_for(self._arpc_server.wait_closed(), 5)
             except asyncio.TimeoutError:
                 pass
-        await self.jobs.drain(timeout=10)
+        await self.job_queue.drain(timeout=10)
+        # the shared admission counters get this process's final deltas
+        # before the DB handle goes away (cross-process /metrics sums)
+        self.job_queue.flush_admission()
         self.db.close()
 
     # -- bootstrap endpoint logic (used by the web API) --------------------
@@ -446,265 +483,17 @@ class Server:
 
     async def run_prune(self, policy=None, *, dry_run: bool = False,
                         gc_grace_s: float | None = None):
-        """Prune+GC off the event loop (reference capability: the
-        keep-last retention + chunk GC the reference's datastore tests
-        pin down; PBS's own prune/GC job analog).  Serialized with every
-        other datastore-mutating admin path (snapshot delete, concurrent
-        prunes) via _prune_lock — a delete racing the mark phase would
-        abort GC mid-flight."""
-        from .prune import GC_GRACE_S, run_prune
-        policy = policy or self.prune_policy()
-        kw = {"gc_grace_s": GC_GRACE_S if gc_grace_s is None
-              else gc_grace_s}
-        async with self._prune_lock:
-            if not dry_run:
-                # GC must never run concurrently with backups: a mid-
-                # flight incremental may still REFERENCE chunks of the
-                # very snapshot this prune removes (splice touch happens
-                # at walk time, so neither the mark nor the grace window
-                # protects them).  Mutual exclusion: refuse while jobs
-                # run; new jobs wait out the GC (the flag is checked
-                # before each job's session starts).
-                if self.jobs.active_count:
-                    raise RuntimeError(
-                        f"prune deferred: {self.jobs.active_count} "
-                        f"job(s) active")
-                self._gc_active = True
-            try:
-                report = await asyncio.get_running_loop().run_in_executor(
-                    None, lambda: run_prune(self.datastore.datastore,
-                                            policy, dry_run=dry_run, **kw))
-                if not dry_run:
-                    self.last_prune = {
-                        "at": time.time(),
-                        "removed": len(report.removed),
-                        "chunks_removed": report.chunks_removed,
-                        "bytes_freed": report.bytes_freed}
-                return report
-            finally:
-                self._gc_active = False
-
-    async def _prune_loop(self) -> None:
-        import datetime as dt
-
-        from ..utils import calendar
-        while True:
-            try:
-                nxt = calendar.compute_next_event(
-                    self.config.prune_schedule, dt.datetime.now())
-                if nxt is None:
-                    return
-                await asyncio.sleep(
-                    max(1.0, (nxt - dt.datetime.now()).total_seconds()))
-                report = await self.run_prune()
-                self.log.info("scheduled prune: -%d snapshots, -%d chunks",
-                              len(report.removed), report.chunks_removed)
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                self.log.exception("scheduled prune failed")
-                await asyncio.sleep(60)
-
-    async def _post_hook(self, row, status: str, *, snapshot: str = "",
-                         error: str = "") -> None:
-        """Best-effort post-script (reference: runPostScript — a failing
-        post hook never changes the job result)."""
-        from . import hooks
-        try:
-            post = hooks.resolve_script(self.db, row.post_script)
-            if post:
-                await hooks.run_hook(post, hooks.job_env(
-                    row, {"STATUS": status, "SNAPSHOT": snapshot,
-                          "ERROR": error}))
-        except Exception as e:
-            self.log.warning("post-script for %s failed: %s", row.id, e)
+        """Prune+GC via the PruneService: serialized with every other
+        datastore-mutating admin path in this process through the
+        service's own lock, and with other server processes through the
+        GC leader lease (services/prune_service.py)."""
+        return await self.prune.run_prune(policy, dry_run=dry_run,
+                                          gc_grace_s=gc_grace_s)
 
     def enqueue_backup(self, job_id: str) -> bool:
-        row = self.db.get_backup_job(job_id)
-        if row is None:
-            raise KeyError(f"unknown backup job {job_id!r}")
-        upid = make_upid("backup", row.id)
-        self.db.create_task(upid, row.id, "backup", detail=row.source_path)
-        result_box: dict = {}
-
-        store = self.datastore
-        if row.store == "pbs":
-            if not self.config.pbs_url:
-                # Record as a job error rather than raising: a raise here
-                # would abort the scheduler tick mid-loop and starve every
-                # due job sorted after the misconfigured one.
-                msg = (f"job {row.id!r} wants store='pbs' but no PBS push "
-                       f"target is configured (ServerConfig.pbs_url)")
-                self.log.error("%s", msg)
-                self.db.append_task_log(upid, f"error: {msg}")
-                self.db.finish_task(upid, database.STATUS_ERROR)
-                self.db.record_backup_result(row.id, database.STATUS_ERROR,
-                                             error=msg)
-                if self.notifications is not None:
-                    self.notifications.record(row.id, database.STATUS_ERROR,
-                                              detail=msg)
-                try:    # post-script fires on every failed run (on_error
-                        # parity); enqueue_backup itself is sync
-                    asyncio.get_running_loop().create_task(self._post_hook(
-                        row, database.STATUS_ERROR, error=msg))
-                except RuntimeError:
-                    pass
-                return False
-            from ..pxar.pbsstore import PBSConfig, PBSStore
-            kind = row.chunker or self.config.chunker
-            store = PBSStore(
-                PBSConfig(base_url=self.config.pbs_url,
-                          datastore=self.config.pbs_datastore,
-                          auth_token=self.config.pbs_token,
-                          namespace=self.config.pbs_namespace,
-                          fingerprint=self.config.pbs_fingerprint),
-                ChunkerParams(avg_size=self.config.chunk_avg),
-                chunker_factory=make_chunker_factory(
-                    kind, cpu_backend=self.config.chunker_backend),
-                batch_hasher=make_batch_hasher(kind),
-                pipeline_workers=self.config.pipeline_workers)
-        elif row.chunker and row.chunker != self.config.chunker:
-            store = LocalStore(
-                self.config.datastore_dir,
-                ChunkerParams(avg_size=self.config.chunk_avg),
-                chunker_factory=make_chunker_factory(
-                    row.chunker, cpu_backend=self.config.chunker_backend),
-                batch_hasher=make_batch_hasher(row.chunker),
-                pbs_format=self.config.datastore_format == "pbs",
-                pipeline_workers=self.config.pipeline_workers,
-                store_shards=(None if self.config.store_shards < 0
-                              else self.config.store_shards),
-                dedup_index_mb=0)
-            # the per-job store shares the server datastore's directory —
-            # share the ONE dedup index too (built above with index
-            # disabled), so the two views can never disagree about
-            # membership within this process.  RAW `_index`, not the
-            # property: the getter would run the lazy boot scan HERE,
-            # on the event loop — boot state rides the index object and
-            # the scan happens on whichever writer thread probes first
-            store.datastore.chunks.index = \
-                self.datastore.datastore.chunks._index
-            # same sharing rule for the similarity tier's sketch state
-            store.datastore.chunks.similarity = \
-                self.datastore.datastore.chunks.similarity
-
-        async def execute():
-            from . import hooks
-            while self._gc_active:         # never start mid-GC
-                await asyncio.sleep(0.5)
-            # serialize session startups; property-reached lock, so the
-            # acquisition joins the static graph by its vocabulary name
-            async with self.jobs.startup_mu:   # pbslint: lock-order jobs.startup-mu
-                pass
-            t0 = time.time()
-            self.live_progress[row.id] = (t0, None)
-
-            # pre-script: PBS_PLUS__* env, KEY=VALUE stdout feedback
-            # (reference: runPreScript + override protocol, job.go:459-482)
-            run_row = row
-            pre = hooks.resolve_script(self.db, row.pre_script)
-            if pre:
-                fb = await hooks.run_hook(pre, hooks.job_env(row))
-                if fb:
-                    self.db.append_task_log(upid, f"pre-script: {fb}")
-                import dataclasses
-                run_row = dataclasses.replace(
-                    row,
-                    source_path=fb.get("SOURCE", row.source_path),
-                    exclusions=row.exclusions +
-                    ([fb["EXCLUDE"]] if fb.get("EXCLUDE") else []))
-            result_box["row"] = run_row
-
-            def on_pump(result):
-                self.live_progress[row.id] = (t0, result)
-            res = await run_target_backup(
-                run_row, db=self.db, agents=self.agents, store=store,
-                on_pump=on_pump,
-                # applied by run_target_backup on the agent branch only
-                # (the one place the target kind is resolved)
-                breaker_factory=lambda: self.jobs.breaker(
-                    f"agent:{run_row.target}",
-                    failure_threshold=self.config.target_breaker_threshold,
-                    reset_timeout_s=self.config.target_breaker_reset_s),
-                attempts=self.config.backup_retry_attempts,
-                checkpoint_interval=self.config.checkpoint_interval
-                or conf.env().checkpoint_interval)
-            result_box["res"] = res
-            if res.manifest.get("resume"):
-                self.jobs.note_resumed()
-            result_box["t0"] = t0
-            self.db.append_task_log(
-                upid, f"backup complete: {res.entries} entries, "
-                      f"{res.bytes_total} bytes -> {res.snapshot}")
-            for err in res.errors[:50]:
-                self.db.append_task_log(upid, f"warning: {err}")
-
-        async def on_success():
-            res = result_box.get("res")
-            status = (database.STATUS_WARNING
-                      if res and res.errors else database.STATUS_SUCCESS)
-            self.live_progress.pop(row.id, None)
-            if res is not None:
-                self.last_run_stats[row.id] = {
-                    "duration": time.time() - result_box.get("t0",
-                                                             time.time()),
-                    "bytes": res.bytes_total, "files": res.files,
-                    "entries": res.entries, "errors": len(res.errors),
-                    # backend pinned at stream open (manifest label):
-                    # which chunker actually scanned this run's bytes
-                    "chunker_backend":
-                        res.manifest.get("chunker_backend", "")}
-            self.db.finish_task(upid, status)
-            self.db.record_backup_result(
-                row.id, status, snapshot=res.snapshot if res else "")
-            self.scheduler.on_backup_complete(row.store)
-            if self.notifications is not None:
-                self.notifications.record(row.id, status)
-            await self._post_hook(result_box.get("row", row), status,
-                                  snapshot=res.snapshot if res else "")
-
-        async def on_error(exc: BaseException):
-            self.live_progress.pop(row.id, None)
-            self.db.append_task_log(upid, f"error: {exc}")
-            self.db.finish_task(upid, database.STATUS_ERROR)
-            self.db.record_backup_result(row.id, database.STATUS_ERROR,
-                                         error=str(exc))
-            if self.notifications is not None:
-                self.notifications.record(row.id, database.STATUS_ERROR,
-                                          detail=str(exc))
-            await self._post_hook(result_box.get("row", row),
-                                  database.STATUS_ERROR, error=str(exc))
-
-        try:
-            # tenant = target CN: the fair dequeue's lane, so one noisy
-            # tenant's backlog cannot starve another's single job
-            return self.jobs.enqueue(Job(
-                id=f"backup:{row.id}", kind="backup", tenant=row.target,
-                execute=execute, on_success=on_success, on_error=on_error))
-        except QueueFullError as e:
-            # typed fast-fail admission: record it as this run's failure
-            # instead of letting the exception abort the scheduler tick —
-            # with full on_error parity (notification + post-script), so
-            # shed backups are as loud as failed ones
-            self.log.warning("backup %s rejected: %s", row.id, e)
-            self.db.append_task_log(upid, f"error: {e}")
-            self.db.finish_task(upid, database.STATUS_ERROR)
-            self.db.record_backup_result(row.id, database.STATUS_ERROR,
-                                         error=str(e))
-            if self.notifications is not None:
-                self.notifications.record(row.id, database.STATUS_ERROR,
-                                          detail=str(e))
-            try:
-                # enqueue_backup is sync; fire the async post-script the
-                # way on_error would have (callers all hold a loop)
-                asyncio.get_running_loop().create_task(
-                    self._post_hook(row, database.STATUS_ERROR,
-                                    error=str(e)))
-            except RuntimeError:
-                self.log.warning(
-                    "no running loop; post-hook skipped for rejected "
-                    "backup %s", row.id)
-            return False
+        """Backup enqueue via the JobQueueService (the shared-bounded,
+        DB-mirrored jobs plane)."""
+        return self.job_queue.enqueue_backup(job_id)
 
     async def _enqueue_verification(self, v: dict) -> None:
         from .verification_job import enqueue_verification

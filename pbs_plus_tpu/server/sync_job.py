@@ -92,7 +92,13 @@ def enqueue_sync(server, row: dict) -> bool:
         # executor thread parent under this job's span
         report = await asyncio.get_running_loop().run_in_executor(
             None, trace.wrap(lambda: run_sync_job(server, row)))
-        server.last_sync_stats[sid] = report
+        # the SyncStateService owns last-sync reports (ISSUE 15); bare
+        # test stubs without the service keep the legacy dict write
+        sync_state = getattr(server, "sync_state", None)
+        if sync_state is not None:
+            sync_state.record(sid, report)
+        else:
+            server.last_sync_stats[sid] = report
         server.db.record_sync_result(sid, database.STATUS_SUCCESS, report)
         server.db.append_task_log(
             upid, f"sync complete: {report['snapshots_synced']} synced, "
@@ -112,8 +118,14 @@ def enqueue_sync(server, row: dict) -> bool:
     try:
         # ONE shared fairness lane for every sync job (docs/fleet.md
         # "Fairness": same crowding rule as verification — per-config
-        # lanes would let scheduled syncs outvote backup tenants)
-        return server.jobs.enqueue(
+        # lanes would let scheduled syncs outvote backup tenants).
+        # Submitted through the JobQueueService when the server has one
+        # (ISSUE 15: the DB-mirrored shared bound); bare test stubs
+        # fall back to the local JobsManager.
+        job_queue = getattr(server, "job_queue", None)
+        submit = job_queue.submit if job_queue is not None \
+            else server.jobs.enqueue
+        return submit(
             Job(id=f"sync:{sid}", kind="sync", tenant="sync",
                 execute=execute, on_error=on_error))
     except QueueFullError as e:

@@ -173,8 +173,13 @@ def enqueue_verification(server, v: dict) -> bool:
         # one SHARED fairness lane for all verification jobs: a verify
         # config has no single target CN, and giving each config its own
         # lane would let 50 scheduled verifications crowd a backup
-        # tenant out of 50/51 slot grants (docs/fleet.md "Fairness")
-        return server.jobs.enqueue(
+        # tenant out of 50/51 slot grants (docs/fleet.md "Fairness").
+        # Through the JobQueueService's DB-mirrored shared bound when
+        # the server has one (ISSUE 15); stubs keep the local queue.
+        job_queue = getattr(server, "job_queue", None)
+        submit = job_queue.submit if job_queue is not None \
+            else server.jobs.enqueue
+        return submit(
             Job(id=f"verify:{vid}", kind="verify", tenant="verify",
                 execute=execute, on_error=on_error))
     except QueueFullError as e:

@@ -1269,9 +1269,9 @@ echo "  --bootstrap-token <token_id:secret>"
         if ref not in ds.list_snapshots(all_namespaces=True):
             return web.json_response({"error": "unknown snapshot"},
                                      status=404)
-        async with server._prune_lock:      # never race a GC mark phase
-            await asyncio.get_running_loop().run_in_executor(
-                None, ds.remove_snapshot, ref)
+        # PruneService serializes the delete against a GC mark phase
+        # (ISSUE 15: the service owns the lock, not the Server)
+        await server.prune.delete_snapshot(ref)
         return web.json_response({"ok": True})
 
     app.router.add_get("/api2/json/d2d/sync", sync_list)

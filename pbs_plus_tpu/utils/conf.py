@@ -67,6 +67,7 @@ ENV_VARS = {
     "PBS_PLUS_DEDUP_INDEX_MB": "dedup-index cuckoo filter budget (MiB)",
     "PBS_PLUS_DEDUP_RESIDENT_MB": "exact-confirm memtable budget (MiB)",
     "PBS_PLUS_STORE_SHARDS": "chunk store logical shard count",
+    "PBS_PLUS_SHARED_DATASTORE": "shared-datastore instance id ('' = off)",
     "PBS_PLUS_DELTA_TIER": "enable the similarity-dedup delta tier",
     "PBS_PLUS_DELTA_THRESHOLD": "max sketch Hamming distance for a base",
     "PBS_PLUS_DELTA_MAX_CHAIN": "max delta-chain depth (base hops)",
@@ -139,6 +140,12 @@ class Env:
     # the chunk count, ~120-160 B/digest)
     dedup_resident_mb: int = 256
     store_shards: int = 16
+    # shared-datastore scale-out (ISSUE 15, docs/architecture.md
+    # "Service map"): names THIS server process when several processes
+    # open one datastore — switches novel-chunk writes to the os.link
+    # claim (written exactly once fleet-wide) and moves index spill/
+    # snapshot state to per-instance paths.  "" = single-process mode.
+    shared_datastore: str = ""
     # similarity-dedup tier (pxar/similarityindex.py + pxar/deltablob.py,
     # docs/data-plane.md "Similarity tier"): store near-duplicate chunks
     # as deltas against a resembling base chunk.  delta_tier 0 disables
@@ -214,6 +221,7 @@ def env() -> Env:
         dedup_resident_mb=_int_env(e, "PBS_PLUS_DEDUP_RESIDENT_MB",
                                    "256"),
         store_shards=_int_env(e, "PBS_PLUS_STORE_SHARDS", "16"),
+        shared_datastore=e.get("PBS_PLUS_SHARED_DATASTORE", ""),
         delta_tier=e.get("PBS_PLUS_DELTA_TIER", "").lower()
         in ("1", "true", "yes"),
         delta_threshold=_int_env(e, "PBS_PLUS_DELTA_THRESHOLD", "14"),

@@ -1,0 +1,25 @@
+"""Tiny thread-safe counter bundle — the module-level METRICS pattern
+(`METRICS` dict + lock + `_count` + `metrics_snapshot`) that subsystem
+modules kept hand-rolling (ISSUE 15 review).  New subsystems hold one
+``Counters`` and export thin module-level wrappers; the older copies
+(pxar/chunkindex.py, pxar/chunkcache.py) predate this helper."""
+
+from __future__ import annotations
+
+import threading
+
+
+class Counters:
+    __slots__ = ("_lock", "_values")
+
+    def __init__(self, *names: str) -> None:
+        self._lock = threading.Lock()
+        self._values = {n: 0 for n in names}    # guarded-by: self._lock
+
+    def add(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._values[key] += n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._values)

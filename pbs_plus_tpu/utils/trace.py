@@ -96,6 +96,12 @@ SPANS = {
     "rpc.serve": None,
     "sidecar.call": None,
     "mux.write_frame": ("pbs_plus_mux_frame_write_seconds", None),
+    # per-service lock waits (server/services/, ISSUE 15): how long a
+    # caller queued on a service's own lock — the histogram where the
+    # old Server._prune_lock convoy would show up if the split ever
+    # regressed into one big lock again
+    "service.lock_wait": ("pbs_plus_service_lock_wait_seconds",
+                          {"service": "$service"}),
 }
 
 _ctx: "ContextVar[tuple[str, str] | None]" = ContextVar(

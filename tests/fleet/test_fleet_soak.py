@@ -132,6 +132,32 @@ def test_fleet_soak_full_profile(tmp_path):
     _soak(tmp_path, 500)
 
 
+def test_fleet_hostile_slow_reader_profile(tmp_path):
+    """ISSUE 15 satellite: hostile agents drive the PR 7 mux paths a
+    soak never exercised — the RX-credit reset (an agent floods DATA
+    past its advertised credit → server counts a flow violation and
+    resets the stream) and the write-deadline shed (an agent stops
+    draining its socket while demanding echo payloads → the server's
+    blocked write sheds the CONNECTION).  Both are counted server-side
+    and every legit agent still publishes."""
+    cfg = FleetConfig(n_agents=12, tenants=4, max_concurrent=4,
+                      max_queued=64, hostile_agents=2,
+                      mux_write_deadline_s=0.4)
+    rep = run_fleet(str(tmp_path / "ds"), cfg)
+    d = rep.to_dict()
+    # survivors: the whole legit fleet published despite the abuse
+    assert d["published"] == 12, rep.failures
+    assert not rep.failures
+    assert d["hostile_run"] == 2
+    # every hostile tripped the RX-credit bound exactly once (stream
+    # reset, bounded buffering) …
+    assert d["server_flow_violations"] >= 2
+    # … and at least one refused-drain connection was shed at the
+    # write deadline (the kernel may coalesce the two floods' timing,
+    # so ≥1 is the structural floor)
+    assert d["server_write_deadline_sheds"] >= 1
+
+
 def test_fleet_open_rate_causes_typed_rejects(tmp_path):
     """With a tight global opens/s bucket the connect storm is throttled:
     agents observe 429 rejects, retry with backoff, and the WHOLE fleet

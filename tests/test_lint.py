@@ -41,7 +41,7 @@ def test_registry_has_expected_rules():
         "failpoint-discipline", "cache-discipline",
         "bounded-queue-discipline", "index-discipline",
         "delta-discipline", "sync-discipline", "span-discipline",
-        "ingest-discipline",
+        "ingest-discipline", "service-discipline",
     }
     assert set(program_rule_names()) == {
         "guarded-by", "lock-order",
@@ -203,6 +203,70 @@ def test_sync_discipline_out_of_scope_clean():
         def has(self, digest):
             return self.index.contains(digest)
     """, path="pbs_plus_tpu/pxar/datastore.py", rules=["sync-discipline"])
+    assert v == []
+
+
+# ------------------------------------------------ service-discipline
+
+
+def test_service_discipline_flags_construction_outside_roots():
+    v = run_lint("""
+        from .services import PruneService
+
+        def make_sweeper(db, store):
+            return PruneService(datastore=store, policy_factory=dict,
+                                jobs_active=lambda: 0, db=db)
+    """, path="pbs_plus_tpu/server/web.py", rules=["service-discipline"])
+    assert names(v) == ["service-discipline"]
+    assert "composition roots" in v[0].message
+
+
+def test_service_discipline_roots_may_construct():
+    src = """
+        from .services import JobQueueService, PruneService
+
+        class Server:
+            def __init__(self, db):
+                self.job_queue = JobQueueService(db=db)
+                self.prune = PruneService(datastore=None,
+                                          policy_factory=dict,
+                                          jobs_active=lambda: 0, db=db)
+    """
+    for root in ("pbs_plus_tpu/server/store.py",
+                 "pbs_plus_tpu/server/fleetproc.py"):
+        assert run_lint(src, path=root,
+                        rules=["service-discipline"]) == []
+
+
+def test_service_discipline_flags_private_reach_through():
+    v = run_lint("""
+        async def snapshot_delete(server, ref):
+            async with server.prune._lock:
+                server.job_queue._admission_flushed.clear()
+    """, path="pbs_plus_tpu/server/web.py", rules=["service-discipline"])
+    assert names(v) == ["service-discipline", "service-discipline"]
+    assert "reaches through" in v[0].message
+
+
+def test_service_discipline_public_surface_clean():
+    # the delegating-property pattern the composition root uses, plus
+    # narrow public calls from anywhere, are the sanctioned surface
+    v = run_lint("""
+        async def route(server, ref):
+            await server.prune.delete_snapshot(ref)
+            return server.job_queue.live_progress, server.prune.gc_active
+    """, path="pbs_plus_tpu/server/web.py", rules=["service-discipline"])
+    assert v == []
+
+
+def test_service_discipline_service_owns_its_privates():
+    # inside server/services/ a service touches its own private state
+    v = run_lint("""
+        class PruneService:
+            def poke(self, sibling):
+                return sibling.prune._lock
+    """, path="pbs_plus_tpu/server/services/prune_service.py",
+        rules=["service-discipline"])
     assert v == []
 
 
@@ -2005,16 +2069,19 @@ def test_registry_env_doc_prefix_name_not_sufficient(tmp_path):
 
 
 def test_lock_order_startup_mu_vocab_site_enters_graph():
-    """The property-reached jobs.startup_mu acquisition in
-    server/store.py joins the static graph via its vocabulary name."""
+    """The property-reached jobs.startup_mu acquisition joins the
+    static graph via its vocabulary name — the site moved with the
+    enqueue path into the JobQueueService (ISSUE 15), and the fleet
+    worker's mirror site carries the same annotation."""
     prog, errors = build_program(
         [os.path.join(REPO_ROOT, "pbs_plus_tpu")], use_cache=False)
     assert errors == []
-    s = next(x for x in prog.files.values()
-             if x.path.endswith("server/store.py"))
-    vocabs = [a[3] for fn in s.functions.values()
-              for a in fn["acquires"]]
-    assert "jobs.startup-mu" in vocabs
+    for path in ("server/services/jobqueue.py", "server/fleetproc.py"):
+        s = next(x for x in prog.files.values()
+                 if x.path.endswith(path))
+        vocabs = [a[3] for fn in s.functions.values()
+                  for a in fn["acquires"]]
+        assert "jobs.startup-mu" in vocabs, path
 
 
 # ------------------------------------------------- span-discipline

@@ -27,6 +27,7 @@ from .bounded_queue import BoundedQueueDiscipline
 from .index_discipline import IndexDiscipline
 from .delta_discipline import DeltaDiscipline
 from .ingest_discipline import IngestDiscipline
+from .service_discipline import ServiceDiscipline
 from .span_discipline import SpanDiscipline
 from .sync_discipline import SyncDiscipline
 
@@ -48,6 +49,7 @@ RULE_CLASSES = [
     SyncDiscipline,
     SpanDiscipline,
     IngestDiscipline,
+    ServiceDiscipline,
 ]
 
 

@@ -1,0 +1,92 @@
+"""service-discipline — the Server god-object stays shattered.
+
+Invariants (ISSUE 15, ``pbs_plus_tpu/server/services/``):
+
+1. **Composition-root construction.**  The five service classes
+   (``CheckpointService``, ``ChunkCacheService``, ``JobQueueService``,
+   ``SyncStateService``, ``PruneService``) may be constructed ONLY in
+   the declared composition roots — ``server/store.py`` (the production
+   ``Server``) and ``server/fleetproc.py`` (the multiproc fleet
+   worker).  A service constructed anywhere else grows a second, silent
+   wiring of the jobs/GC planes whose locks and DB state the real
+   composition never sees.
+
+2. **No cross-service reach-through.**  Outside a service's own module,
+   no code may touch an underscore-private attribute through a
+   service-shaped receiver (``server.prune._lock``,
+   ``self.job_queue._admission_flushed``, ...).  Cross-service needs
+   are wired by the composition root as NARROW callables
+   (``gc_active=lambda: prune.fleet_gc_active()``); private reach-
+   through silently re-grows the one-big-object coupling the split
+   exists to kill.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+from ._util import call_name, dotted
+
+_SCOPE = "pbs_plus_tpu/"
+_SERVICES_PKG = "pbs_plus_tpu/server/services/"
+_COMPOSITION_ROOTS = ("pbs_plus_tpu/server/store.py",
+                      "pbs_plus_tpu/server/fleetproc.py")
+_SERVICE_CLASSES = frozenset({
+    "CheckpointService", "ChunkCacheService", "JobQueueService",
+    "SyncStateService", "PruneService",
+})
+# the composition attribute names services are reachable through (the
+# Server/Worker wiring vocabulary) — the reach-through check keys on
+# the receiver chain's LEAF, so `server.prune._lock` and a local
+# `prune._lock` both resolve
+_SERVICE_ATTRS = frozenset({
+    "prune", "job_queue", "checkpoints", "sync_state", "chunk_cache",
+    "prune_service", "jobqueue_service",
+})
+
+
+class ServiceDiscipline(Rule):
+    name = "service-discipline"
+    invariant = ("services are constructed only in the composition "
+                 "roots and never reached into through private "
+                 "attributes — the god-object split stays split")
+
+    def begin_file(self, ctx):
+        return ctx.path.startswith(_SCOPE)
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        name = call_name(node)
+        if name is None:
+            return
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf not in _SERVICE_CLASSES:
+            return
+        if ctx.path in _COMPOSITION_ROOTS:
+            return
+        ctx.report(self, node,
+                   f"`{leaf}` constructed outside the composition "
+                   "roots (server/store.py, server/fleetproc.py): a "
+                   "second wiring of the jobs/GC planes owns locks and "
+                   "DB state the real composition never sees — inject "
+                   "the root's instance (or a narrow callable) instead")
+
+    def visit_Attribute(self, ctx, node: ast.Attribute) -> None:
+        attr = node.attr
+        if not attr.startswith("_") or attr.startswith("__"):
+            return
+        recv = dotted(node.value)
+        if recv is None:
+            return
+        leaf = recv.rsplit(".", 1)[-1]
+        if leaf not in _SERVICE_ATTRS:
+            return
+        if ctx.path.startswith(_SERVICES_PKG):
+            return          # a service's own module owns its privates
+        ctx.report(self, node,
+                   f"`{recv}.{attr}` reaches through a service's "
+                   "private state from outside server/services/ — "
+                   "cross-service needs are wired by the composition "
+                   "root as narrow callables or public surface, never "
+                   "by private reach-through (the god-object split "
+                   "stays split)")
