@@ -746,6 +746,157 @@ def _dedup_index_bench(n: int | None = None, *,
     }
 
 
+def _dist_index_bench(n: int | None = None, *, batch: int = 8192,
+                      rounds: int = 50) -> dict:
+    """Distributed dedup index benchmark (ISSUE 16, docs/dist-index.md):
+    two in-process ``IndexShardServer`` nodes behind a
+    ``DistIndexClient`` vs a local single-process ``DedupIndex`` on the
+    SAME synthetic corpus (default 4*10^4 digests;
+    PBS_PLUS_BENCH_DIST_N overrides).  Reports the ISSUE 16 gates:
+
+    - structural wire accounting: one ``batch``-digest probe costs
+      <= shards HTTP requests (counted via the METRICS delta, not
+      timed);
+    - batched probe p99 over ``rounds`` rotating batches vs the local
+      index's p99 on identical batches, measured back-to-back within
+      each round so both paths see the same machine phases (<= 3x gate
+      — the fan-out amortizes the loopback round-trips across the
+      whole batch);
+    - live rebalance 2 -> 3 shards, then a digest-for-digest audit over
+      ``/digests`` of every node: full coverage, zero multi-owned,
+      zero held off-owner under the new map;
+    - restore equivalence: a dist-indexed and a local-indexed
+      ChunkStore fed the same chunk sequence return bit-identical
+      bytes for every digest."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from pbs_plus_tpu.parallel.dist_index import (
+        METRICS, DistIndexClient, IndexShardServer, ShardMap)
+    from pbs_plus_tpu.pxar.chunkindex import DedupIndex
+
+    n = n or int(os.environ.get("PBS_PLUS_BENCH_DIST_N", "40000"))
+    batch = min(batch, n)
+    rng = np.random.default_rng(16)
+    arr = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+    corpus = [arr[i].tobytes() for i in range(n)]
+
+    tmp = tempfile.mkdtemp(prefix="pbs-dist-bench-")
+    servers: list = []
+    client = None
+    try:
+        # the local baseline runs the SAME spillable engine a shard
+        # node runs — the ratio isolates the wire, not the index
+        local = DedupIndex(budget_mb=8, spill_dir=os.path.join(tmp, "local"),
+                           resident_mb=8)
+        local.mark_booted()
+        local.insert_many(corpus)
+
+        for sid in ("b0", "b1"):
+            idx = DedupIndex(budget_mb=8, spill_dir=os.path.join(tmp, sid),
+                             resident_mb=8)
+            idx.mark_booted()
+            srv = IndexShardServer(sid, idx)
+            srv.start()
+            servers.append(srv)
+        m = ShardMap([(s.shard_id, s.endpoint) for s in servers], epoch=1)
+        for s in servers:
+            s.install_map(m)
+        client = DistIndexClient(m)
+        for lo in range(0, n, batch):
+            client.insert_many(corpus[lo:lo + batch])
+
+        # structural wire accounting over one whole probe batch
+        before = METRICS.snapshot()
+        client.probe_batch(corpus[:batch] + corpus[:64])   # 64 intra dups
+        delta = {k: v - before[k] for k, v in METRICS.snapshot().items()}
+
+        # paired latency rounds: local and dist probe the SAME batch
+        # back to back, so scheduler noise on this one-core box hits
+        # both tails alike
+        local.probe_batch(corpus[:batch])                  # warm passes
+        client.probe_batch(corpus[:batch])
+        t_local: list = []
+        t_dist: list = []
+        for r in range(rounds):
+            lo = (r * batch) % n
+            b = corpus[lo:lo + batch]
+            if len(b) < batch:
+                b = b + corpus[:batch - len(b)]
+            t0 = time.perf_counter()
+            got = local.probe_batch(b)
+            t_local.append(time.perf_counter() - t0)
+            assert all(got), "local member probe missed"
+            t0 = time.perf_counter()
+            got = client.probe_batch(b)
+            t_dist.append(time.perf_counter() - t0)
+            assert all(got), "dist member probe missed"
+        local_p99 = float(np.percentile(t_local, 99))
+        dist_p99 = float(np.percentile(t_dist, 99))
+
+        # grow the ring under the running client: 2 -> 3
+        idx3 = DedupIndex(budget_mb=8, spill_dir=os.path.join(tmp, "b2"),
+                          resident_mb=8)
+        idx3.mark_booted()
+        s3 = IndexShardServer("b2", idx3)
+        s3.start()
+        servers.append(s3)
+        new_map = ShardMap([(s.shard_id, s.endpoint) for s in servers],
+                           epoch=2)
+        reb = client.rebalance(new_map)
+        holders: dict = {}
+        multi_owned = 0
+        misrouted = 0
+        for si, s in enumerate(servers):
+            for d in s.index.digests():
+                if d in holders:
+                    multi_owned += 1
+                holders[d] = si
+                if new_map.owner_of(d) != si:
+                    misrouted += 1
+
+        # restore equivalence through real stores, dist vs local index
+        from pbs_plus_tpu.pxar.datastore import ChunkStore
+        dist_store = ChunkStore(os.path.join(tmp, "ds"), index=client)
+        local_store = ChunkStore(os.path.join(tmp, "ls"), index_budget_mb=4)
+        restore_match = True
+        rchunks = []
+        for i in range(128):
+            data = arr[i % n].tobytes() * (8 + i % 5)
+            d = hashlib.sha256(data).digest()
+            rchunks.append((d, data))
+            dist_store.insert(d, data, verify=False)
+            local_store.insert(d, data, verify=False)
+        for d, data in rchunks:
+            if not (dist_store.get(d) == local_store.get(d) == data):
+                restore_match = False
+
+        return {
+            "digests": n,
+            "batch": batch,
+            "shards": 2,
+            "rounds": rounds,
+            "local_p99_ms": round(local_p99 * 1e3, 3),
+            "dist_p99_ms": round(dist_p99 * 1e3, 3),
+            "p99_ratio": round(dist_p99 / local_p99, 2),
+            "wire_requests_per_batch": delta["wire_requests"],
+            "batch_dedup_saved": delta["dedup_saved"],
+            "rebalance": reb,
+            "owners_covered": len(holders),
+            "multi_owned": multi_owned,
+            "misrouted": misrouted,
+            "restore_match": restore_match,
+        }
+    finally:
+        if client is not None:
+            client.close()
+        for s in servers:
+            s.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _digestlog_bench(n: int | None = None, *,
                      stat_sample: int = 20_000) -> dict:
     """Spillable exact-confirm tier benchmark (ISSUE 14,
@@ -1635,6 +1786,13 @@ def main() -> None:
         dedup_index = None
     if dedup_index is not None:
         result["detail"]["dedup_index"] = dedup_index
+    try:
+        dist_index = _dist_index_bench()
+    except Exception as e:
+        sys.stderr.write(f"[bench] dist index bench unavailable: {e}\n")
+        dist_index = None
+    if dist_index is not None:
+        result["detail"]["dist_index"] = dist_index
     try:
         dlog = _digestlog_bench()
     except Exception as e:

@@ -18,12 +18,17 @@ via __graft_entry__.dryrun_multichip.
 
 from .mesh import make_mesh, make_seq_mesh
 from .sp_chunker import sp_candidate_mask, sp_chunk_stream
-from .dist_index import ShardedCuckooIndex
+from .dist_index import (
+    DistIndexClient, DistIndexError, IndexShardServer, ShardMap,
+    ShardedCuckooIndex, parse_endpoints,
+)
 from .sharded_step import multichip_dedup_step, build_step_inputs
 
 __all__ = [
     "make_mesh", "make_seq_mesh",
     "sp_candidate_mask", "sp_chunk_stream",
     "ShardedCuckooIndex",
+    "DistIndexClient", "DistIndexError", "IndexShardServer",
+    "ShardMap", "parse_endpoints",
     "multichip_dedup_step", "build_step_inputs",
 ]

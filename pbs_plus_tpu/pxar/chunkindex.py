@@ -420,6 +420,62 @@ class DedupIndex:
     def discard_many(self, digests: Iterable[bytes]) -> int:
         return sum(1 for d in digests if self.discard(d))
 
+    def discard_many_acked(self, digests: Sequence[bytes]
+                           ) -> "list[bool]":
+        """Per-digest discard ACKS for the sweep's discard-before-unlink
+        protocol (ISSUE 16): True means the owning index has durably
+        PROCESSED the discard — including "was never present" — so the
+        caller may unlink the chunk file.  A local index can always ack;
+        the distributed client answers False for digests whose owning
+        shard did not confirm, and the sweep then leaves those files on
+        disk (a safe false negative, never a resurrectable entry)."""
+        for d in digests:
+            self.discard(d)
+        return [True] * len(digests)
+
+    # -- whole-segment handoff (ISSUE 16, docs/dist-index.md) --------------
+    def export_segments(self) -> "list[tuple[str, str, int]]":
+        """Freeze and describe the exact-confirm segments for a shard
+        handoff: ``(name, trailer_hex, count)`` oldest → newest (the
+        memtable flushes first, so the description covers everything).
+        Spill mode only — an all-RAM index has no immutable checksummed
+        artifact to ship."""
+        with self._lock:
+            if self._log is None:
+                raise RuntimeError("segment handoff requires a spillable "
+                                   "index (PBS_PLUS_DEDUP_RESIDENT_MB > 0)")
+            return self._log.export_segments()
+
+    def export_segment_bytes(self, name: str) -> bytes:
+        """One live segment's bytes, verbatim (see DigestLog)."""
+        with self._lock:
+            if self._log is None:
+                raise RuntimeError("segment handoff requires a spillable "
+                                   "index")
+            return self._log.export_segment_bytes(name)
+
+    def adopt_segment(self, raw: bytes, expected_trailer: bytes,
+                      keep) -> int:
+        """Adopt the owned subset of a shipped segment: the log
+        verifies the bytes against ``expected_trailer``, filters by the
+        vectorized ownership predicate ``keep``, and registers the kept
+        rows as its newest run; the filter front then learns the kept
+        LIVE digests via ``insert_fp_many`` (growth rebuilds keep
+        streaming from the log through the already-attached
+        ``attach_digest_source``).  Returns the number of live digests
+        adopted; raises ValueError on any verification defect."""
+        with self._lock:
+            if self._log is None:
+                raise RuntimeError("segment handoff requires a spillable "
+                                   "index")
+            live = self._log.adopt_segment(raw, expected_trailer, keep)
+            if len(live):
+                self._cuckoo.insert_fp_many(
+                    [live[i].tobytes() for i in range(len(live))])
+        if len(live):
+            METRICS.add("inserts", len(live))
+        return len(live)
+
     def rebuild(self, digests: Iterable[bytes]) -> int:
         """Reset to exactly ``digests`` (the boot-time shard scan).  In
         spill mode the stream lands straight in the log (spilling at

@@ -229,6 +229,20 @@ class ChunkStore:
                                    f"proc-{_inst}") if _inst \
             else os.path.join(base, ".chunkindex")
         index_explicit = index is not None
+        if index is None and _conf.env().dist_index_shards:
+            # distributed index (ISSUE 16, docs/dist-index.md): the
+            # membership surface moves to a DistIndexClient over the
+            # configured shard nodes; the local DedupIndex is not built
+            # at all.  The client is boot-free (`booted` is always
+            # True) — shard nodes own their spill/snapshot state.
+            from ..parallel.dist_index import (DistIndexClient,
+                                               parse_endpoints)
+            _env = _conf.env()
+            index = DistIndexClient(
+                endpoints=parse_endpoints(_env.dist_index_shards),
+                token=_env.dist_index_token,
+                timeout_s=_env.dist_index_timeout_s,
+                map_path=_env.dist_index_map)
         if index is None:
             mb = (_conf.env().dedup_index_mb
                   if index_budget_mb is None else index_budget_mb)
@@ -1188,64 +1202,92 @@ class ChunkStore:
                        before: float) -> tuple[int, int]:
         removed = 0
         freed = 0
+        idx = self.index
         for sub in subs:
             d = os.path.join(self.base, sub)
-            for name in os.listdir(d):
-                p = os.path.join(d, name)
-                if len(name) != 64:
-                    # not a chunk (e.g. a crashed writer's .tmp debris):
-                    # still reap when stale, but never count it in the
-                    # chunk accounting
-                    try:
-                        st = os.stat(p)
-                        if max(st.st_atime, st.st_mtime) < before:
-                            os.unlink(p)
-                    except OSError:
-                        pass
-                    continue
-                try:
-                    digest = bytes.fromhex(name)
-                except ValueError:
-                    continue         # 64-char non-hex stranger: leave it
-                # the stat/discard/unlink triple runs under the shard
-                # lock so a concurrent dedup hit cannot slip its utime
-                # in after our stat: the server serializes GC against
-                # jobs, but the store's own thread_safe contract must
-                # not depend on that (a hit landing mid-triple would
-                # publish a reference to a chunk this unlink deletes)
-                with self._shard_locks[self.shard_of(digest)]:
-                    try:
-                        st = os.stat(p)
-                        if max(st.st_atime, st.st_mtime) < before:
-                            with self._pin_lock:
-                                if digest in self._pinned_bases:
-                                    # a delta commit is mid-flight
-                                    # against this base: skipping is
-                                    # the only safe answer (the writer
-                                    # confirmed existence under this
-                                    # same mutex and utimes it)
-                                    continue
-                                if self.index is not None:
-                                    # discard BEFORE unlink: if the
-                                    # unlink then fails the chunk
-                                    # survives index-less (safe false
-                                    # negative), never the reverse
-                                    self.index.discard(digest)
-                                if self._sim is not None:
-                                    # same ordering for the sketch
-                                    # entry: a failed unlink leaves a
-                                    # chunk the tier merely stops
-                                    # offering as a base — never an
-                                    # offered base with no file
-                                    self._sim.discard(digest)
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            try:
+                shard = int(sub[:2], 16) % self.n_shards
+            except ValueError:
+                shard = 0
+            # the whole stat → discard → unlink pass for a subdir runs
+            # under its shard lock (every digest in a prefix dir shares
+            # its first byte) so a concurrent dedup hit cannot slip its
+            # utime in after our stat: the server serializes GC against
+            # jobs, but the store's own thread_safe contract must not
+            # depend on that (a hit landing mid-pass would publish a
+            # reference to a chunk this unlink deletes)
+            with self._shard_locks[shard]:
+                victims: "list[tuple[bytes, str, int]]" = []
+                for name in names:
+                    p = os.path.join(d, name)
+                    if len(name) != 64:
+                        # not a chunk (e.g. a crashed writer's .tmp
+                        # debris): still reap when stale, but never
+                        # count it in the chunk accounting
+                        try:
+                            st = os.stat(p)
+                            if max(st.st_atime, st.st_mtime) < before:
                                 os.unlink(p)
-                            # counted only after a successful unlink —
-                            # an EPERM failure must not inflate
-                            # bytes_freed
-                            freed += st.st_size
-                            removed += 1
+                        except OSError:
+                            pass
+                        continue
+                    try:
+                        digest = bytes.fromhex(name)
+                    except ValueError:
+                        continue     # 64-char non-hex stranger: leave it
+                    try:
+                        st = os.stat(p)
                     except OSError:
                         continue
+                    if max(st.st_atime, st.st_mtime) < before:
+                        victims.append((digest, p, st.st_size))
+                if not victims:
+                    continue
+                # discard BEFORE unlink, BATCHED: one per-digest-acked
+                # round to the index for the whole subdir — against a
+                # distributed index that is ≤1 wire request per owning
+                # shard instead of one HTTP probe per victim (ISSUE 16,
+                # docs/dist-index.md "Cross-process discard").  A
+                # digest the index did not ack keeps its file: the
+                # failure direction stays the safe false negative (a
+                # chunk on disk the index forgot re-stores
+                # idempotently), never a discarded entry whose unlink
+                # was skipped... which is why the unlink below only
+                # ever runs under an ack.
+                if idx is not None:
+                    acks = idx.discard_many_acked([v[0] for v in victims])
+                else:
+                    acks = [True] * len(victims)
+                for (digest, p, size), acked in zip(victims, acks):
+                    if not acked:
+                        continue
+                    with self._pin_lock:
+                        if digest in self._pinned_bases:
+                            # a delta commit is mid-flight against this
+                            # base: the file must survive.  The index
+                            # already forgot it — a safe false negative
+                            # (the base re-stores on next sight); the
+                            # pinned reassembly reads from disk, not
+                            # the index
+                            continue
+                        if self._sim is not None:
+                            # same ordering for the sketch entry: a
+                            # failed unlink leaves a chunk the tier
+                            # merely stops offering as a base — never
+                            # an offered base with no file
+                            self._sim.discard(digest)
+                        try:
+                            os.unlink(p)
+                        except OSError:
+                            continue
+                    # counted only after a successful unlink — an EPERM
+                    # failure must not inflate bytes_freed
+                    freed += size
+                    removed += 1
         return removed, freed
 
 

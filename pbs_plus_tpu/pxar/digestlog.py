@@ -287,6 +287,47 @@ def _write_segment_file(path: str, recs: np.ndarray) -> bytes:
     return trailer
 
 
+def parse_segment_bytes(raw: bytes,
+                        expected_trailer: "bytes | None" = None
+                        ) -> np.ndarray:
+    """Structurally verify a whole segment held in memory and return its
+    records as uint8[count, 33].  The distributed-index handoff path
+    (ISSUE 16): segments ship VERBATIM between index shards, and the
+    receiver must prove the bytes it got are the bytes the source's
+    manifest named before any record becomes live — header, size,
+    records sha, fence count, and the sha256 trailer over header+fence
+    section all check out, plus the out-of-band ``expected_trailer``
+    when the caller carries one.  Raises ValueError on any defect (a
+    rejected transfer is retried or dropped — a safe false negative,
+    never a torn adoption)."""
+    if len(raw) < _SEG_HDR.size + 32:
+        raise ValueError("segment bytes truncated (header)")
+    hdr = raw[:_SEG_HDR.size]
+    magic, ver, _flags, count, block_records, records_sha = \
+        _SEG_HDR.unpack(hdr)
+    if magic != SEG_MAGIC or ver != SEG_VERSION \
+            or block_records != BLOCK_RECORDS or count == 0:
+        raise ValueError("bad segment header")
+    n_blocks = (count + BLOCK_RECORDS - 1) // BLOCK_RECORDS
+    fence_off = _SEG_HDR.size + count * REC_SIZE
+    fence_len = _FENCE_HDR.size + n_blocks * 32 + 32
+    if len(raw) != fence_off + fence_len + 32:
+        raise ValueError("segment size mismatch")
+    records = raw[_SEG_HDR.size:fence_off]
+    if hashlib.sha256(records).digest() != records_sha:
+        raise ValueError("records sha mismatch")
+    fence_section = raw[fence_off:fence_off + fence_len]
+    trailer = raw[fence_off + fence_len:]
+    if hashlib.sha256(hdr + fence_section).digest() != trailer:
+        raise ValueError("trailer mismatch")
+    if expected_trailer is not None and trailer != expected_trailer:
+        raise ValueError("expected/actual trailer mismatch")
+    (got_blocks,) = _FENCE_HDR.unpack_from(fence_section)
+    if got_blocks != n_blocks:
+        raise ValueError("fence count mismatch")
+    return np.frombuffer(records, dtype=np.uint8).reshape(-1, REC_SIZE)
+
+
 def _open_segment(path: str, expected_trailer: "bytes | None" = None
                   ) -> "_Segment | None":
     """Open + structurally verify a segment: header, file size, and the
@@ -757,6 +798,62 @@ class DigestLog:
     def iter_live_digests(self) -> Iterator[bytes]:
         for d, _f in self.iter_live():
             yield d
+
+    # -- whole-segment handoff (ISSUE 16, docs/dist-index.md) --------------
+    def export_segments(self) -> "list[tuple[str, str, int]]":
+        """Freeze the live set into segments and describe them for a
+        shard handoff: flush the memtable first (so every record —
+        tombstones included — lives in an immutable checksummed file),
+        then return ``(name, trailer_hex, count)`` oldest → newest.
+        The order matters: the receiver adopts in this order so its
+        newest-wins lookup preserves the source's tombstone shadowing."""
+        self.flush()
+        with self._lock:
+            return [(s.name, s.trailer.hex(), s.count)
+                    for s in self._segs]
+
+    def export_segment_bytes(self, name: str) -> bytes:
+        """One live segment's file bytes, VERBATIM (the handoff ships
+        the immutable artifact the way sync ships chunks — the trailer
+        from ``export_segments`` lets every hop re-verify).  Raises
+        KeyError for names not in the live set: stray or compacted-away
+        files never cross the wire."""
+        with self._lock:
+            seg = next((s for s in self._segs if s.name == name), None)
+            if seg is None:
+                raise KeyError(f"segment {name!r} is not live")
+            path, count = seg.path, seg.count
+        n_blocks = (count + BLOCK_RECORDS - 1) // BLOCK_RECORDS
+        size = (_SEG_HDR.size + count * REC_SIZE
+                + _FENCE_HDR.size + n_blocks * 32 + 32 + 32)
+        with open(path, "rb") as f:
+            return f.read(size)
+
+    def adopt_segment(self, raw: bytes, expected_trailer: bytes,
+                      keep) -> np.ndarray:
+        """Adopt the subset of a shipped segment this log should own.
+        The raw bytes are fully re-verified against ``expected_trailer``
+        (``parse_segment_bytes``), then ``keep(arr)`` — a vectorized
+        uint8[N,32] → bool[N] ownership predicate — filters the records,
+        and the kept rows (flags intact, tombstones included so newer
+        kills keep shadowing older adoptions) are written as a NEW
+        immutable segment under this log's own name sequence.  Returns
+        the kept LIVE digests as uint8[K,32] so the caller can teach its
+        filter front.  Raises ValueError on any verification defect."""
+        recs = parse_segment_bytes(raw, expected_trailer)
+        mask = np.asarray(keep(recs[:, :32]), dtype=bool)
+        if mask.shape != (len(recs),):
+            raise ValueError("keep predicate arity mismatch")
+        kept = np.ascontiguousarray(recs[mask])
+        live_rows = kept[(kept[:, 32] & FLAG_TOMBSTONE) == 0]
+        with self._lock:
+            if len(kept):
+                seg = self._write_new_segment(kept)
+                self._segs.append(seg)
+            # moved ranges are disjoint from prior holdings (the source
+            # owned them), so kept-live counts straight onto _live
+            self._live += len(live_rows)
+        return np.ascontiguousarray(live_rows[:, :32])
 
     # -- manifest ----------------------------------------------------------
     def manifest_bytes(self) -> bytes:

@@ -64,7 +64,8 @@ class Worker:
         from . import database
         from .fleetsim import FleetConfig, FleetServer
         from .prune import PrunePolicy
-        from .services import JobQueueService, PruneService
+        from .services import (DistIndexService, JobQueueService,
+                               PruneService)
         from ..utils import conf
 
         self.proc_id = args.proc_id
@@ -88,6 +89,17 @@ class Worker:
                                   shared_instance=self.proc_id)
         self.job_queue.agents = self.server.agents
         self.job_queue.datastore = self.server.store
+        # distributed index (ISSUE 16): an explicit --dist-index spec
+        # routes this worker's membership surface through the shard
+        # fleet; without it, adopt any client the ChunkStore built from
+        # the PBS_PLUS_DIST_INDEX_SHARDS environment knob
+        self.dist_index = DistIndexService(
+            shards=args.dist_index, token=args.dist_index_token)
+        _chunks = self.server.store.datastore.chunks
+        if self.dist_index.enabled:
+            self.dist_index.attach(_chunks)
+        else:
+            self.dist_index.adopt(_chunks)
         self.prune = PruneService(
             datastore=self.server.store,
             policy_factory=PrunePolicy,
@@ -243,6 +255,7 @@ class Worker:
             "store": _pxds.metrics_snapshot(),
             "gc_lease": _prune_svc.metrics_snapshot(),
             "dedup_index": _chunkindex.metrics_snapshot(),
+            "dist_index": self.dist_index.stats(),
             "jobs": dict(self.job_queue.jobs.stats),
             "queue_counts": self.db.queue_counts(),
             "admission": self.db.admission_counters(),
@@ -285,6 +298,7 @@ class Worker:
                 t.cancel()
         await asyncio.gather(*self._bg, return_exceptions=True)
         await self.server.stop()
+        self.dist_index.close()
         self.job_queue.flush_admission()
         self.db.close()
         _emit({"event": "bye"})
@@ -301,6 +315,10 @@ def main(argv=None) -> None:
     ap.add_argument("--max-concurrent", type=int, default=4)
     ap.add_argument("--max-queued", type=int, default=512)
     ap.add_argument("--write-deadline", type=float, default=60.0)
+    ap.add_argument("--dist-index", default="",
+                    help="distributed index shard spec "
+                         "(s0=host:port,...); empty = local index")
+    ap.add_argument("--dist-index-token", default="")
     args = ap.parse_args(argv)
     asyncio.run(Worker(args).run())
 

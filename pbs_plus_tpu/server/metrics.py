@@ -788,6 +788,42 @@ class MetricsRegistry:
               [({}, float(s.db.queue_depth()))])
         gauge("pbs_plus_db_bytes", "SQLite database size",
               [({}, float(s.db.file_size()))])
+        # -- distributed dedup index (parallel/dist_index.py; ISSUE 16).
+        #    Gated on the module being ALREADY imported: a scrape must
+        #    never be the thing that pays the jax import — a process
+        #    that never configured a dist index reports zeros.
+        import sys as _sys
+        _dist = _sys.modules.get("pbs_plus_tpu.parallel.dist_index")
+        di = _dist.metrics_snapshot() if _dist is not None else {
+            "probes": 0, "wire_requests": 0, "batches": 0,
+            "dedup_saved": 0, "inserts": 0, "discards": 0, "errors": 0,
+            "rebalances": 0, "segments_shipped": 0, "map_reloads": 0}
+        gauge("pbs_plus_dist_index_probes_total",
+              "Digests probed through the distributed index client "
+              "(batched probes count one per digest)",
+              [({}, float(di["probes"]))])
+        gauge("pbs_plus_dist_index_wire_requests_total",
+              "HTTP requests issued to index shards (≤ shards per "
+              "batch — the O(batches×shards) witness)",
+              [({}, float(di["wire_requests"]))])
+        gauge("pbs_plus_dist_index_probe_batches_total",
+              "probe_batch fan-outs issued", [({}, float(di["batches"]))])
+        gauge("pbs_plus_dist_index_batch_dedup_saved_total",
+              "Intra-batch duplicate digests collapsed before the wire",
+              [({}, float(di["dedup_saved"]))])
+        gauge("pbs_plus_dist_index_errors_total",
+              "Shard requests that failed (their slice answered the "
+              "safe false negative)", [({}, float(di["errors"]))])
+        gauge("pbs_plus_dist_index_rebalances_total",
+              "Shard-map rebalances coordinated",
+              [({}, float(di["rebalances"]))])
+        gauge("pbs_plus_dist_index_segments_shipped_total",
+              "Checksummed digestlog segments shipped during handoff",
+              [({}, float(di["segments_shipped"]))])
+        gauge("pbs_plus_dist_index_map_reloads_total",
+              "Shard-map re-reads over the wire (bootstrap, reject "
+              "re-route, corrupt snapshot degradation)",
+              [({}, float(di["map_reloads"]))])
         gauge("pbs_plus_scrape_timestamp", "Scrape time", [({}, time.time())])
         # -- latency histograms (utils/trace.py span closes; ISSUE 12) ------
         hist_block = render_histograms()

@@ -22,6 +22,9 @@ service                   owns
 ``PruneService``          retention + GC: its own lock, the gc_active
                           gate, last-prune stats, the schedule loop,
                           and the cross-process GC leader lease
+``DistIndexService``      the distributed dedup-index client (ISSUE 16):
+                          construction from the shard spec, attachment
+                          to the chunk store, rebalance, stats
 ========================  ==============================================
 
 Construction discipline (pbslint rule ``service-discipline``): only the
@@ -35,6 +38,7 @@ re-grow the god-object this package exists to shatter.
 
 from .checkpoint_service import CheckpointService
 from .chunkcache_service import ChunkCacheService
+from .distindex_service import DistIndexService
 from .jobqueue import JobQueueService
 from .prune_service import GCLeaseHeldError, PruneService
 from .syncstate import SyncStateService
@@ -42,6 +46,7 @@ from .syncstate import SyncStateService
 __all__ = [
     "CheckpointService",
     "ChunkCacheService",
+    "DistIndexService",
     "GCLeaseHeldError",
     "JobQueueService",
     "PruneService",

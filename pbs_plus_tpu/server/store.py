@@ -37,7 +37,8 @@ from . import database
 from .backup_job import make_batch_hasher, make_chunker_factory
 from .scheduler import Scheduler
 from .services import (CheckpointService, ChunkCacheService,
-                       JobQueueService, PruneService, SyncStateService)
+                       DistIndexService, JobQueueService, PruneService,
+                       SyncStateService)
 
 
 def make_upid(kind: str, job_id: str) -> str:
@@ -164,6 +165,13 @@ class ServerConfig:
     # TTL (server/services/prune_service.py)
     shared_instance: str = ""
     gc_lease_ttl_s: float = 30.0
+    # distributed dedup index (ISSUE 16, docs/dist-index.md): shard
+    # spec "s0=host:port,s1=host:port" routes the membership surface
+    # through a DistIndexClient over those index nodes; "" falls back
+    # to PBS_PLUS_DIST_INDEX_SHARDS (which the ChunkStore reads
+    # itself), empty everywhere = local in-process index
+    dist_index_shards: str = ""
+    dist_index_token: str = ""
 
 
 class Server:
@@ -216,6 +224,20 @@ class Server:
             delta_max_chain=(None if config.delta_max_chain < 0
                              else config.delta_max_chain),
             shared_instance=shared)
+        # distributed index (ISSUE 16): an explicit config spec builds
+        # + attaches the client here; with only the environment knob
+        # set, the ChunkStore built it already and the service ADOPTS
+        # that one (never a second client beside it)
+        self.dist_index = DistIndexService(
+            shards=config.dist_index_shards,
+            token=config.dist_index_token or conf.env().dist_index_token,
+            timeout_s=conf.env().dist_index_timeout_s,
+            map_path=conf.env().dist_index_map)
+        _chunks = self.datastore.datastore.chunks
+        if self.dist_index.enabled:
+            self.dist_index.attach(_chunks)
+        else:
+            self.dist_index.adopt(_chunks)
         holder = f"{config.hostname}:{shared or os.getpid()}"
         self.prune = PruneService(
             datastore=self.datastore,

@@ -90,6 +90,10 @@ ENV_VARS = {
     "PBS_PLUS_LEADER_ELECT": "operator: lease-based leader election (0=off)",
     "PBS_PLUS_FEEDER_MESH": "models: multi-host feeder mesh (0=off)",
     "PBS_PLUS_FEEDER_LINGER_S": "models: feeder linger before teardown (s)",
+    "PBS_PLUS_DIST_INDEX_SHARDS": "distributed index shard spec ('' = off)",
+    "PBS_PLUS_DIST_INDEX_TOKEN": "distributed index bearer token",
+    "PBS_PLUS_DIST_INDEX_TIMEOUT_S": "distributed index per-request deadline",
+    "PBS_PLUS_DIST_INDEX_MAP": "shard-map snapshot path ('' = wire-only)",
 }
 
 
@@ -184,6 +188,17 @@ class Env:
     # per membership-negotiation batch — one vectorized destination
     # probe_batch (and at most one chunk transfer round) per batch
     sync_batch: int = 1024
+    # distributed dedup index (parallel/dist_index.py, docs/dist-index.md):
+    # a non-empty shard spec ("s0=host:port,s1=host:port,...") replaces
+    # the in-process DedupIndex with a DistIndexClient over those shard
+    # nodes; the token authenticates the /distidx/v1 wire, timeout_s
+    # bounds each fan-out request, and dist_index_map names the local
+    # shard-map snapshot (a corrupt/missing snapshot degrades to a wire
+    # re-read of shard epochs).  "" = local single-process index.
+    dist_index_shards: str = ""
+    dist_index_token: str = ""
+    dist_index_timeout_s: float = 30.0
+    dist_index_map: str = ""
     extra: dict = field(default_factory=dict)
 
 
@@ -243,6 +258,11 @@ def env() -> Env:
                                         "60"),
         max_queued_jobs=_int_env(e, "PBS_PLUS_MAX_QUEUED_JOBS", "1024"),
         sync_batch=_int_env(e, "PBS_PLUS_SYNC_BATCH", "1024"),
+        dist_index_shards=e.get("PBS_PLUS_DIST_INDEX_SHARDS", ""),
+        dist_index_token=e.get("PBS_PLUS_DIST_INDEX_TOKEN", ""),
+        dist_index_timeout_s=_float_env(e, "PBS_PLUS_DIST_INDEX_TIMEOUT_S",
+                                        "30"),
+        dist_index_map=e.get("PBS_PLUS_DIST_INDEX_MAP", ""),
     )
 
 

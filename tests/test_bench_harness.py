@@ -423,6 +423,41 @@ def test_bench_digestlog_at_1e7():
     assert res["spills"] > 0
 
 
+def test_bench_dist_index():
+    """Distributed dedup index gates (ISSUE 16 acceptance;
+    bench._dist_index_bench → detail.dist_index): (a) one whole probe
+    batch costs <= shards wire requests, counted structurally via the
+    METRICS delta; (b) 2-shard batched probe p99 <= 3x the local
+    single-process index on the same corpus, measured in paired
+    rounds; (c) a live 2 -> 3 rebalance leaves every digest on exactly
+    its new-map owner — full coverage, zero multi-owned, zero
+    misrouted; (d) a dist-indexed and a local-indexed store restore
+    bit-identical bytes."""
+    import bench
+
+    n = 100_000 if FULL else 40_000
+    res = bench._dist_index_bench(n=n, rounds=50 if FULL else 40)
+    print(f"\n  dist index n={n}: local p99 {res['local_p99_ms']:7.2f} ms"
+          f" | dist p99 {res['dist_p99_ms']:7.2f} ms"
+          f" ({res['p99_ratio']}x)"
+          f" | wire/batch {res['wire_requests_per_batch']}"
+          f" | rebalance shipped {res['rebalance']['segments_shipped']}"
+          f" adopted {res['rebalance']['adopted']}")
+    # (a) structural: the scatter/gather fan-out, not per-digest wire
+    assert res["wire_requests_per_batch"] <= res["shards"], res
+    assert res["batch_dedup_saved"] == 64, res   # intra-batch dedup held
+    # (b) the batched wire path stays within 3x of the in-process index
+    assert res["p99_ratio"] <= 3.0, res
+    # (c) exactly one owner per digest, digest for digest, after a live
+    # rebalance — nothing lost, nothing duplicated, nothing misrouted
+    assert res["owners_covered"] == n, res
+    assert res["multi_owned"] == 0, res
+    assert res["misrouted"] == 0, res
+    assert res["rebalance"]["segments_shipped"] > 0, res
+    # (d) restores are bit-identical dist vs local
+    assert res["restore_match"] is True, res
+
+
 def test_bench_commit_walk_refs(tmp_path):
     """Commit-walk with many unchanged files (ref coalescing — the
     B1/B4 'refs sort + coalescing' analog): re-commit of an untouched

@@ -1,0 +1,471 @@
+"""Distributed dedup index (ISSUE 16, docs/dist-index.md).
+
+Covers the batched scatter/gather client, the shard-map snapshot
+discipline, checksum-verified whole-segment handoff, exactly-one-owner
+under live rebalance with concurrent stale-map inserts, the
+cross-process discard-before-unlink ack gate, and zero
+lost/resurrected digests through a SIGKILLed index node.
+"""
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pbs_plus_tpu.parallel.dist_index import (
+    METRICS, DistIndexClient, IndexShardServer, ShardMap, parse_endpoints)
+from pbs_plus_tpu.pxar.chunkindex import DedupIndex
+from pbs_plus_tpu.pxar.datastore import ChunkStore
+from pbs_plus_tpu.pxar.digestlog import parse_segment_bytes
+
+
+def _digests(n, seed=0):
+    return [hashlib.sha256(f"{seed}:{i}".encode()).digest()
+            for i in range(n)]
+
+
+def _spill_index(tmp_path, name):
+    return DedupIndex(budget_mb=2, spill_dir=str(tmp_path / name),
+                      resident_mb=1)
+
+
+def _start_shards(tmp_path, sids, *, token="", epoch=1):
+    """N in-process shard nodes + an installed map; returns
+    (servers, shard_map)."""
+    servers = []
+    for sid in sids:
+        idx = _spill_index(tmp_path, f"spill-{sid}")
+        idx.mark_booted()
+        srv = IndexShardServer(sid, idx, token=token)
+        srv.start()
+        servers.append(srv)
+    m = ShardMap([(s.shard_id, s.endpoint) for s in servers], epoch=epoch)
+    for s in servers:
+        s.install_map(m)
+    return servers, m
+
+
+def _stop_all(servers):
+    for s in servers:
+        s.stop()
+
+
+# ------------------------------------------------------------ shard map
+
+
+def test_shard_map_total_single_owner_routing():
+    m = ShardMap([("s0", "http://h:1"), ("s1", "http://h:2"),
+                  ("s2", "http://h:3")], epoch=3)
+    digs = _digests(512)
+    arr = np.frombuffer(b"".join(digs), dtype=np.uint8).reshape(-1, 32)
+    own = m.owner_indices(arr)
+    assert own.shape == (512,)
+    assert set(np.unique(own)) <= {0, 1, 2}
+    # scalar and vector routing agree, and split() covers the batch
+    # exactly once through its permutation index
+    for i in (0, 17, 511):
+        assert m.owner_of(digs[i]) == int(own[i])
+    parts = m.split(digs)
+    seen = np.concatenate([perm for _d, perm in parts.values()])
+    assert sorted(seen.tolist()) == list(range(512))
+    for si, (part, perm) in parts.items():
+        assert part == [digs[i] for i in perm.tolist()]
+        assert (own[perm] == si).all()
+
+
+def test_shard_map_snapshot_roundtrip(tmp_path):
+    m = ShardMap([("s0", "http://h:1"), ("s1", "http://h:2")],
+                 epoch=9, points=32)
+    p = str(tmp_path / "map")
+    m.save(p)
+    got = ShardMap.load(p)
+    assert got is not None
+    assert (got.epoch, got.points, got.shards) == (9, 32, m.shards)
+    digs = _digests(128, seed=4)
+    assert [got.owner_of(d) for d in digs] == [m.owner_of(d) for d in digs]
+
+
+def test_shard_map_corrupt_or_truncated_loads_none(tmp_path):
+    m = ShardMap([("s0", "http://h:1")], epoch=2)
+    raw = m.to_bytes()
+    p = str(tmp_path / "map")
+    # one flipped byte anywhere — header, payload, trailer — kills it
+    for pos in (1, len(raw) // 2, len(raw) - 3):
+        bad = bytearray(raw)
+        bad[pos] ^= 0x40
+        with open(p, "wb") as fh:
+            fh.write(bytes(bad))
+        assert ShardMap.load(p) is None
+    # truncation at any boundary kills it
+    for cut in (0, 3, len(raw) - 1):
+        with open(p, "wb") as fh:
+            fh.write(raw[:cut])
+        assert ShardMap.load(p) is None
+    assert ShardMap.load(str(tmp_path / "nope")) is None
+    # the pristine bytes still load (the negatives above are not vacuous)
+    with open(p, "wb") as fh:
+        fh.write(raw)
+    assert ShardMap.load(p) is not None
+
+
+def test_client_corrupt_map_degrades_to_wire_epoch_read(tmp_path):
+    servers, m = _start_shards(tmp_path, ["s0", "s1"], epoch=7)
+    try:
+        map_path = str(tmp_path / "client.map")
+        with open(map_path, "wb") as fh:
+            fh.write(b"\x00garbage" * 8)       # corrupt snapshot on disk
+        cli = DistIndexClient(
+            endpoints=parse_endpoints(
+                ",".join(f"{s.shard_id}={s._host}:{s.port}"
+                         for s in servers)),
+            map_path=map_path)
+        try:
+            # never a guessed routing table: the wire re-read adopted
+            # the shards' installed epoch-7 map
+            assert cli.shard_map.epoch == 7
+            digs = _digests(64, seed=1)
+            assert cli.insert_many(digs) == 64
+            assert cli.probe_batch(digs) == [True] * 64
+        finally:
+            cli.close()
+    finally:
+        _stop_all(servers)
+
+
+# --------------------------------------------------- batched membership
+
+
+def test_insert_probe_discard_roundtrip_two_shards(tmp_path):
+    servers, m = _start_shards(tmp_path, ["s0", "s1"])
+    cli = DistIndexClient(m)
+    try:
+        digs = _digests(400, seed=2)
+        assert cli.insert_many(digs) == 400
+        assert len(cli) == 400
+        novel = _digests(100, seed=3)
+        verdict = cli.probe_batch(digs + novel)
+        assert verdict == [True] * 400 + [False] * 100
+        # both shards actually hold a share (the ring spreads the space)
+        assert all(len(s.index) > 0 for s in servers)
+        assert cli.discard_many_acked(digs) == [True] * 400
+        assert cli.probe_batch(digs) == [False] * 400
+        assert len(cli) == 0
+    finally:
+        cli.close()
+        _stop_all(servers)
+
+
+def test_probe_batch_dedup_permutation_and_wire_bound(tmp_path):
+    servers, m = _start_shards(tmp_path, ["s0", "s1"])
+    cli = DistIndexClient(m)
+    try:
+        present = _digests(150, seed=5)
+        absent = _digests(50, seed=6)
+        cli.insert_many(present)
+        # scrambled batch with heavy intra-batch duplication
+        batch = []
+        for i in range(600):
+            pool = present if i % 3 else absent
+            batch.append(pool[(i * 7) % len(pool)])
+        expected = [d in set(present) for d in batch]
+        before = METRICS.snapshot()
+        got = cli.probe_batch(batch)
+        delta = {k: v - before[k] for k, v in METRICS.snapshot().items()}
+        # bit-identical to the per-digest answer, duplicates re-expanded
+        # through the permutation index
+        assert got == expected
+        # ≤ 1 request per shard for the whole 600-digest batch
+        assert delta["wire_requests"] <= len(servers)
+        assert delta["batches"] == 1
+        uniq = len(set(batch))
+        assert delta["dedup_saved"] == 600 - uniq
+    finally:
+        cli.close()
+        _stop_all(servers)
+
+
+def test_unreachable_shard_is_safe_false_negative(tmp_path):
+    servers, m = _start_shards(tmp_path, ["s0", "s1"])
+    cli = DistIndexClient(m)
+    try:
+        digs = _digests(200, seed=7)
+        cli.insert_many(digs)
+        dead = servers[0]
+        dead.stop()
+        dead_idx = m.shard_index(dead.shard_id)
+        verdict = cli.probe_batch(digs)
+        acked = cli.discard_many_acked(digs)
+        for d, v, a in zip(digs, verdict, acked):
+            if m.owner_of(d) == dead_idx:
+                assert v is False          # dedup miss, never a skip
+                assert a is False          # no ack → file must survive
+            else:
+                assert v is True
+                assert a is True
+    finally:
+        cli.close()
+        _stop_all(servers)
+
+
+# ----------------------------------------------- whole-segment handoff
+
+
+def test_segment_handoff_checksum_verified(tmp_path):
+    src = _spill_index(tmp_path, "src")
+    src.mark_booted()
+    digs = _digests(300, seed=8)
+    src.insert_many(digs)
+    src.discard_many(digs[:20])           # tombstones travel too
+    segs = src.export_segments()
+    assert segs, "flush-on-export must freeze the memtable into segments"
+    name, trailer_hex, count = segs[-1]
+    raw = src.export_segment_bytes(name)
+    trailer = bytes.fromhex(trailer_hex)
+    assert len(parse_segment_bytes(raw, trailer)) == count
+    # any corrupt byte in transit is rejected before adoption
+    bad = bytearray(raw)
+    bad[len(raw) // 2] ^= 0x01
+    with pytest.raises(ValueError):
+        parse_segment_bytes(bytes(bad), trailer)
+    dst = _spill_index(tmp_path, "dst")
+    dst.mark_booted()
+    with pytest.raises(ValueError):
+        dst.adopt_segment(bytes(bad), trailer,
+                          lambda a: np.ones(len(a), dtype=bool))
+    assert len(dst) == 0                  # failed handoff adopted nothing
+    # the verbatim bytes adopt cleanly and the filter front learns them
+    for name, trailer_hex, _count in segs:
+        dst.adopt_segment(src.export_segment_bytes(name),
+                          bytes.fromhex(trailer_hex),
+                          lambda a: np.ones(len(a), dtype=bool))
+    assert dst.probe_batch(digs[20:]) == [True] * 280
+    assert dst.probe_batch(digs[:20]) == [False] * 20   # shadowed
+
+
+def test_rebalance_exactly_one_owner_with_concurrent_inserts(tmp_path):
+    servers, m = _start_shards(tmp_path, ["s0", "s1"])
+    cli = DistIndexClient(m)
+    base = _digests(600, seed=9)
+    cli.insert_many(base)
+    # grow the ring: a third node joins
+    extra_idx = _spill_index(tmp_path, "spill-s2")
+    extra_idx.mark_booted()
+    extra = IndexShardServer("s2", extra_idx)
+    extra.start()
+    servers.append(extra)
+    new_map = ShardMap([(s.shard_id, s.endpoint) for s in servers],
+                       epoch=m.epoch + 1)
+    # a second client keeps writing on the STALE map throughout: the
+    # map-install fence bounces mis-routed writes and the client
+    # re-routes them after one map refresh
+    stale = DistIndexClient(ShardMap(m.shards, epoch=m.epoch))
+    racing = _digests(300, seed=10)
+    raced = {"n": 0}
+
+    def race():
+        for i in range(0, len(racing), 30):
+            raced["n"] += stale.insert_many(racing[i:i + 30])
+            time.sleep(0.001)
+
+    t = threading.Thread(target=race)
+    t.start()
+    try:
+        res = cli.rebalance(new_map)
+        t.join(30)
+        assert not t.is_alive()
+        assert res["epoch"] == new_map.epoch
+        assert res["segments_shipped"] > 0
+        assert raced["n"] == len(racing)   # no write lost to the fence
+        # audit: every digest held by EXACTLY its new-map owner
+        holders = {}
+        for si, s in enumerate(servers):
+            assert s.current_map().epoch == new_map.epoch
+            for d in s.index.digests():
+                assert d not in holders, "digest on two shards"
+                holders[d] = si
+        everything = set(base) | set(racing)
+        assert set(holders) == everything
+        for d, si in holders.items():
+            assert new_map.owner_of(d) == si
+        # and the batched surface agrees, digest for digest
+        allofit = sorted(everything)
+        assert cli.probe_batch(allofit) == [True] * len(allofit)
+    finally:
+        stale.close()
+        cli.close()
+        _stop_all(servers)
+
+
+# ------------------------------------- cross-process discard ordering
+
+
+def test_sweep_unlinks_only_acked_discards(tmp_path):
+    servers, m = _start_shards(tmp_path, ["s0", "s1"])
+    cli = DistIndexClient(m)
+    store = ChunkStore(str(tmp_path / "store"), index=cli)
+    try:
+        chunks = {}
+        for i in range(40):
+            data = f"dist-sweep-{i}".encode() * 50
+            d = hashlib.sha256(data).digest()
+            assert store.insert(d, data)
+            chunks[d] = store._path(d)
+        dead = servers[1]
+        dead.stop()
+        dead_idx = m.shard_index(dead.shard_id)
+        removed, _freed = store.sweep(before=time.time() + 60)
+        live_owned = [d for d in chunks if m.owner_of(d) != dead_idx]
+        assert removed == len(live_owned)
+        for d, p in chunks.items():
+            if m.owner_of(d) == dead_idx:
+                # no ack from the dead shard → the file SURVIVES
+                assert os.path.exists(p)
+            else:
+                assert not os.path.exists(p)
+        # the surviving files are a safe false negative: the index
+        # forgot them (probe says miss → re-upload) but the bytes are
+        # still on disk, so the re-store is an idempotent no-op
+        survivors = [d for d in chunks if m.owner_of(d) == dead_idx]
+        assert store.probe_batch(survivors) == [False] * len(survivors)
+        for d in survivors:
+            assert store.on_disk(d)
+    finally:
+        cli.close()
+        _stop_all(servers)
+
+
+# --------------------------------------------- index-node kill (fleet)
+
+
+def _spawn_shard(tmp_path, sid, token=""):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pbs_plus_tpu.parallel.dist_index",
+         "--shard-id", sid, "--port", "0", "--token", token,
+         "--spill-dir", str(tmp_path / f"spill-{sid}"),
+         "--budget-mb", "2", "--resident-mb", "1",
+         "--snapshot", str(tmp_path / f"snap-{sid}")],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, env=env)
+    ready = {}
+
+    def pump():
+        line = proc.stdout.readline()
+        if line:
+            ready.update(json.loads(line))
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    t.join(60)
+    assert ready.get("event") == "ready", f"shard {sid} never came up"
+    return proc, ready["port"]
+
+
+def _end_shard(proc):
+    if proc.poll() is None:
+        try:
+            proc.stdin.write(b"exit\n")
+            proc.stdin.flush()
+        except OSError:
+            pass
+        try:
+            proc.wait(20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(20)
+
+
+def test_index_node_sigkill_zero_lost_zero_resurrected(tmp_path):
+    """/persist is the durability point: SIGKILL a shard node and
+    restart it from its snapshot — every persisted digest survives
+    (zero lost), every acked discard stays gone (zero resurrected),
+    and un-persisted inserts vanish in the SAFE direction only."""
+    p0, port0 = _spawn_shard(tmp_path, "k0")
+    p1, port1 = _spawn_shard(tmp_path, "k1")
+    cli = DistIndexClient(endpoints=[("k0", f"http://127.0.0.1:{port0}"),
+                                     ("k1", f"http://127.0.0.1:{port1}")])
+    m = cli.shard_map
+    try:
+        durable = _digests(240, seed=11)
+        assert cli.insert_many(durable) == 240
+        gone = durable[:40]
+        assert cli.discard_many_acked(gone) == [True] * 40
+        cli.save_snapshot("")              # broadcast /persist
+        ephemeral = _digests(60, seed=12)  # after the durability point
+        assert cli.insert_many(ephemeral) == 60
+
+        os.kill(p0.pid, signal.SIGKILL)
+        p0.wait(20)
+        k0 = m.shard_index("k0")
+
+        # dead window: the killed shard's slice degrades to the safe
+        # false negative, the surviving shard still answers exactly
+        for d, v in zip(durable[40:], cli.probe_batch(durable[40:])):
+            assert v is (m.owner_of(d) != k0)
+
+        # restart from the snapshot on the SAME port (the map still
+        # routes there)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        p0 = subprocess.Popen(
+            [sys.executable, "-m", "pbs_plus_tpu.parallel.dist_index",
+             "--shard-id", "k0", "--port", str(port0),
+             "--spill-dir", str(tmp_path / "spill-k0"),
+             "--budget-mb", "2", "--resident-mb", "1",
+             "--snapshot", str(tmp_path / "snap-k0")],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, env=env)
+        line = p0.stdout.readline()
+        assert json.loads(line).get("event") == "ready"
+        cli.close()                        # drop the dead connection
+        cli2 = DistIndexClient(m)
+        try:
+            # zero lost: everything persisted is still a hit
+            assert cli2.probe_batch(durable[40:]) == [True] * 200
+            # zero resurrected: acked discards stayed discarded
+            assert cli2.probe_batch(gone) == [False] * 40
+            # the un-persisted tail is lost only in the safe direction
+            # (forgotten on the killed shard → re-upload; the survivor
+            # kept its share)
+            for d, v in zip(ephemeral, cli2.probe_batch(ephemeral)):
+                if m.owner_of(d) != k0:
+                    assert v is True
+        finally:
+            cli2.close()
+    finally:
+        cli.close()
+        _end_shard(p0)
+        _end_shard(p1)
+
+
+# ------------------------------------------------- restore equivalence
+
+
+def test_restore_bit_identical_dist_vs_local(tmp_path):
+    servers, m = _start_shards(tmp_path, ["r0", "r1"])
+    cli = DistIndexClient(m)
+    dist_store = ChunkStore(str(tmp_path / "dist"), index=cli)
+    local_store = ChunkStore(str(tmp_path / "local"), n_shards=4,
+                             index_budget_mb=2)
+    try:
+        payloads = {}
+        for i in range(60):
+            data = (f"restore-{i % 20}-".encode() * (20 + i % 7))
+            d = hashlib.sha256(data).digest()
+            payloads[d] = data
+            # same sequence (with repeats → dedup hits) into both
+            dist_store.insert(d, data)
+            local_store.insert(d, data)
+        for d, data in payloads.items():
+            a = dist_store.get(d)
+            b = local_store.get(d)
+            assert a == b == data          # bit-identical restores
+    finally:
+        cli.close()
+        _stop_all(servers)

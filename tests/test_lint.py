@@ -42,6 +42,7 @@ def test_registry_has_expected_rules():
         "bounded-queue-discipline", "index-discipline",
         "delta-discipline", "sync-discipline", "span-discipline",
         "ingest-discipline", "service-discipline",
+        "dist-index-discipline",
     }
     assert set(program_rule_names()) == {
         "guarded-by", "lock-order",
@@ -435,6 +436,102 @@ def test_index_discipline_non_segment_open_clean():
                 return f.read()
     """, path="pbs_plus_tpu/server/restore_job.py",
         rules=["index-discipline"])
+    assert v == []
+
+
+# --------------------------------------------- dist-index-discipline
+
+
+def test_dist_index_discipline_flags_per_digest_contains():
+    v = run_lint("""
+        def check(self, d):
+            return self.dist_index.contains(d)
+    """, path="pbs_plus_tpu/pxar/datastore.py",
+        rules=["dist-index-discipline"])
+    assert names(v) == ["dist-index-discipline"]
+    assert "probe_batch" in v[0].message
+
+
+def test_dist_index_discipline_flags_per_digest_insert():
+    v = run_lint("""
+        def learn(dist_client, d):
+            dist_client.insert(d)
+    """, path="pbs_plus_tpu/server/sync_job.py",
+        rules=["dist-index-discipline"])
+    assert names(v) == ["dist-index-discipline"]
+
+
+def test_dist_index_discipline_flags_per_digest_discard_and_has():
+    v = run_lint("""
+        def gc(dist_index_client, d):
+            if dist_index_client.has(d):
+                dist_index_client.discard(d)
+    """, path="pbs_plus_tpu/server/gc.py",
+        rules=["dist-index-discipline"])
+    assert names(v) == ["dist-index-discipline", "dist-index-discipline"]
+
+
+def test_dist_index_discipline_flags_handrolled_wire_call():
+    v = run_lint("""
+        def probe(conn, body):
+            conn.request("POST", "/distidx/v1/probe", body)
+            return conn.getresponse().read()
+    """, path="pbs_plus_tpu/pxar/syncwire.py",
+        rules=["dist-index-discipline"])
+    assert names(v) == ["dist-index-discipline"]
+    assert "DistIndexClient" in v[0].message
+
+
+def test_dist_index_discipline_flags_datablob_flag_per_digest():
+    v = run_lint("""
+        def tag(index_client, d):
+            index_client.mark_datablob(d)
+    """, path="pbs_plus_tpu/pxar/remote.py",
+        rules=["dist-index-discipline"])
+    assert names(v) == ["dist-index-discipline"]
+
+
+def test_dist_index_discipline_clean_on_batched_surface():
+    v = run_lint("""
+        def probe(dist_index, batch):
+            hits = dist_index.probe_batch(batch)
+            dist_index.insert_many([d for d, h in zip(batch, hits)
+                                    if not h])
+            return dist_index.discard_many_acked(batch)
+    """, path="pbs_plus_tpu/pxar/datastore.py",
+        rules=["dist-index-discipline"])
+    assert v == []
+
+
+def test_dist_index_discipline_module_itself_exempt():
+    # the client implements the wire; its own endpoint strings and
+    # per-digest convenience shims are sanctioned
+    v = run_lint("""
+        def request(self, conn, body):
+            conn.request("POST", "/distidx/v1/insert", body)
+        def contains(self, d):
+            return self.dist_index.contains(d)
+    """, path="pbs_plus_tpu/parallel/dist_index.py",
+        rules=["dist-index-discipline"])
+    assert v == []
+
+
+def test_dist_index_discipline_local_index_receiver_clean():
+    # per-digest calls on the LOCAL in-process index are index-discipline
+    # territory, not this rule's
+    v = run_lint("""
+        def check(store, d):
+            return store.index.contains(d)
+    """, path="pbs_plus_tpu/pxar/datastore.py",
+        rules=["dist-index-discipline"])
+    assert v == []
+
+
+def test_dist_index_discipline_out_of_scope_clean():
+    v = run_lint("""
+        def poke(dist_index, d):
+            return dist_index.contains(d)
+    """, path="tests/helpers.py", rules=["dist-index-discipline"])
     assert v == []
 
 

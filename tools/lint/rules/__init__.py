@@ -25,6 +25,7 @@ from .failpoint_discipline import FailpointDiscipline
 from .cache_discipline import CacheDiscipline
 from .bounded_queue import BoundedQueueDiscipline
 from .index_discipline import IndexDiscipline
+from .dist_index_discipline import DistIndexDiscipline
 from .delta_discipline import DeltaDiscipline
 from .ingest_discipline import IngestDiscipline
 from .service_discipline import ServiceDiscipline
@@ -45,6 +46,7 @@ RULE_CLASSES = [
     CacheDiscipline,
     BoundedQueueDiscipline,
     IndexDiscipline,
+    DistIndexDiscipline,
     DeltaDiscipline,
     SyncDiscipline,
     SpanDiscipline,

@@ -1,0 +1,104 @@
+"""dist-index-discipline — remote index membership stays batched.
+
+Invariant (ISSUE 16, parallel/dist_index.py, docs/dist-index.md): the
+distributed dedup index is reachable ONLY through the batched
+``DistIndexClient`` surface — ``probe_batch`` / ``insert_many`` /
+``discard_many`` / ``discard_many_acked`` — which costs ≤1 HTTP request
+per shard per batch.  A per-digest call against a distributed index
+(``dist_index.contains(d)`` in a loop, or a hand-rolled HTTP request to
+a ``/distidx`` endpoint) pays one wire round-trip per digest: exactly
+the O(digests) cost the batched scatter/gather fan-out exists to
+eliminate, and at restore/GC scale it turns one negotiation round into
+millions.
+
+The rule flags, everywhere in the product tree EXCEPT the client
+module itself (``pbs_plus_tpu/parallel/dist_index.py``, which owns the
+wire):
+
+- any call whose argument text mentions the ``/distidx`` wire prefix —
+  hand-rolled requests to the shard protocol bypass the fan-out,
+  the permutation regather, and the ownership re-route protocol;
+- per-digest membership attribute calls (``contains`` / ``has`` /
+  ``insert`` / ``discard`` / ``is_datablob`` / ``mark_datablob``) on a
+  dist-index-shaped receiver (``dist_index`` / ``dist_client`` /
+  ``index_client`` ... — the composition vocabulary for the
+  distributed client).
+
+A plain local index receiver (``store.index``, ``self._index``) is not
+flagged: per-digest calls on an IN-PROCESS index are a hash probe, not
+a round trip, and the local surface keeps them.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+from ._util import call_name
+
+_SCOPE = "pbs_plus_tpu/"
+_CLIENT_MODULE = "pbs_plus_tpu/parallel/dist_index.py"
+_WIRE_MARKERS = ("/distidx",)
+_RECEIVERS = frozenset({
+    "dist_index", "distindex", "dist_client", "dist_index_client",
+    "index_client",
+})
+_PER_DIGEST = frozenset({
+    "contains", "has", "insert", "discard", "is_datablob",
+    "mark_datablob",
+})
+
+
+def _receiver_leaf(node: ast.expr) -> "str | None":
+    """Leaf name of a receiver chain: ``self.server.dist_index`` →
+    ``dist_index``; ``dist_client`` → ``dist_client``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class DistIndexDiscipline(Rule):
+    name = "dist-index-discipline"
+    invariant = ("remote index membership goes through the batched "
+                 "DistIndexClient surface only — no per-digest calls "
+                 "on a distributed index, no hand-rolled /distidx "
+                 "requests outside the client module")
+
+    def begin_file(self, ctx):
+        return ctx.path.startswith(_SCOPE) and ctx.path != _CLIENT_MODULE
+
+    def visit_Call(self, ctx, node: ast.Call) -> None:
+        # hand-rolled wire access: any call carrying the /distidx
+        # prefix in an argument (conn.request("POST", "/distidx/v1/
+        # probe", ...), urlopen(f"{url}/distidx/..."), ...)
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            try:
+                src = ast.unparse(arg)
+            except Exception:
+                continue
+            if any(m in src for m in _WIRE_MARKERS):
+                ctx.report(self, node,
+                           f"`{call_name(node) or '<call>'}` talks to "
+                           "the /distidx wire directly: the shard "
+                           "protocol is owned by DistIndexClient "
+                           "(parallel/dist_index.py) — its fan-out, "
+                           "permutation regather, and ownership "
+                           "re-route are what keep a batch at ≤1 "
+                           "request per shard (docs/dist-index.md)")
+                return
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return
+        if func.attr not in _PER_DIGEST:
+            return
+        leaf = _receiver_leaf(func.value)
+        if leaf is None or leaf.lstrip("_") not in _RECEIVERS:
+            return
+        ctx.report(self, node,
+                   f"per-digest `.{func.attr}(...)` on distributed "
+                   f"index receiver `{leaf}`: one HTTP round-trip per "
+                   "digest — batch it through probe_batch / "
+                   "insert_many / discard_many_acked "
+                   "(docs/dist-index.md)")

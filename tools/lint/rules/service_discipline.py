@@ -34,7 +34,7 @@ _COMPOSITION_ROOTS = ("pbs_plus_tpu/server/store.py",
                       "pbs_plus_tpu/server/fleetproc.py")
 _SERVICE_CLASSES = frozenset({
     "CheckpointService", "ChunkCacheService", "JobQueueService",
-    "SyncStateService", "PruneService",
+    "SyncStateService", "PruneService", "DistIndexService",
 })
 # the composition attribute names services are reachable through (the
 # Server/Worker wiring vocabulary) — the reach-through check keys on
@@ -42,7 +42,8 @@ _SERVICE_CLASSES = frozenset({
 # `prune._lock` both resolve
 _SERVICE_ATTRS = frozenset({
     "prune", "job_queue", "checkpoints", "sync_state", "chunk_cache",
-    "prune_service", "jobqueue_service",
+    "prune_service", "jobqueue_service", "dist_index",
+    "dist_index_service",
 })
 
 
