@@ -55,6 +55,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ._compat import shard_map
 
 from ..ops.cuckoo import SLOTS, _MIX, CuckooIndex, _digest_words
+from ..utils import atomicio, fswitness
 from ..utils.log import L
 
 
@@ -351,12 +352,9 @@ class ShardMap:
             return None
 
     def save(self, path: str) -> None:
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as fh:
-            fh.write(self.to_bytes())
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, path)
+        # fsync'd: the shard map is the rebalance fence — a published
+        # map that vanishes in a crash would re-route writes backwards
+        atomicio.replace_bytes(path, self.to_bytes(), fsync=True)
 
     @classmethod
     def load(cls, path: str) -> "ShardMap | None":
@@ -972,6 +970,11 @@ class DistIndexClient:
         METRICS.add("discards", total)
         self._datablobs.difference_update(
             d for d in digests if acked.get(d, False))
+        for d in digests:
+            if acked.get(d, False):
+                # only ACKED digests fence the sweep's unlink — an
+                # un-acked digest keeps its file, so no event for it
+                fswitness.note("index.discard", d.hex())
         return [acked.get(d, False) for d in digests]
 
     def discard(self, digest: bytes) -> None:
@@ -1077,11 +1080,9 @@ class DistIndexClient:
             by_url.setdefault(url, sid)
         # 1. fence everywhere first — a shard that misses the map would
         #    keep accepting writes it is about to retire, so this step
-        #    is all-or-nothing
-        payload = new_map.to_bytes()
-        for url in by_url:
-            self._conn(url).request("POST", "/map", payload)
-            METRICS.add("wire_requests")
+        #    is all-or-nothing (map-install-before-retire,
+        #    docs/protocols.md)
+        self._install_map_on_all(by_url, new_map)
         with self._lock:
             self._map = new_map
         shipped = 0
@@ -1110,14 +1111,33 @@ class DistIndexClient:
                     shipped += 1
                     METRICS.add("segments_shipped")
         # 3. retire: every old shard drops what it no longer owns
-        dropped = 0
-        for sid, url in old_map.shards:
-            res = json.loads(self._conn(url).request("POST", "/retire"))
-            dropped += int(res.get("dropped", 0))
+        dropped = self._retire_from_old(old_map)
         if self.map_path:
             new_map.save(self.map_path)
         return {"epoch": new_map.epoch, "segments_shipped": shipped,
                 "adopted": adopted, "dropped": dropped}
+
+    def _install_map_on_all(self, urls: "Iterable[str]",
+                            new_map: ShardMap) -> None:
+        """Step 1 of the rebalance protocol: POST the new map to every
+        shard (old ∪ new) before anything else moves — the static
+        ordering-discipline rule anchors on this call preceding
+        ``_retire_from_old`` on every path."""
+        payload = new_map.to_bytes()
+        for url in urls:
+            self._conn(url).request("POST", "/map", payload)
+            METRICS.add("wire_requests")
+            fswitness.note("map.install", url)
+
+    def _retire_from_old(self, old_map: ShardMap) -> int:
+        """Step 3: every old shard drops the digests it no longer owns
+        under the (already installed) new map."""
+        dropped = 0
+        for _sid, url in old_map.shards:
+            fswitness.note("shard.retire", url)
+            res = json.loads(self._conn(url).request("POST", "/retire"))
+            dropped += int(res.get("dropped", 0))
+        return dropped
 
     def close(self) -> None:
         with self._lock:

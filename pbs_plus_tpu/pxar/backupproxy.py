@@ -24,7 +24,7 @@ from dataclasses import dataclass
 
 from ..chunker import ChunkerParams
 from ..utils.log import L
-from ..utils import validate
+from ..utils import atomicio, validate
 from .datastore import (
     Datastore, SnapshotRef, format_backup_time, parse_backup_type,
 )
@@ -148,7 +148,7 @@ class BackupSession:
             if ds.pbs_format:
                 self._write_pbs_manifest(ds, midx, pidx)
             os.makedirs(os.path.dirname(self._final_dir), exist_ok=True)
-            os.replace(self._tmp_dir, self._final_dir)
+            atomicio.publish_staged(self._tmp_dir, self._final_dir)
         except BaseException:
             self._done = True
             try:
@@ -177,8 +177,8 @@ class BackupSession:
         ).replace(tzinfo=_dt.timezone.utc).timestamp()
         doc = manifest_json(self.ref.backup_type, self.ref.backup_id,
                             int(t), files)
-        with open(os.path.join(self._tmp_dir, ds.MANIFEST_PBS), "wb") as f:
-            f.write(blob_encode(doc))
+        atomicio.write_bytes(os.path.join(self._tmp_dir, ds.MANIFEST_PBS),
+                             blob_encode(doc))
 
     def abort(self) -> None:
         if not self._done:

@@ -72,6 +72,7 @@ from typing import Iterable, Iterator, Sequence
 
 import numpy as np
 
+from ..utils import atomicio, fswitness
 from .digestlog import FLAG_DATABLOB as _DATABLOB
 from .digestlog import FLAG_TOMBSTONE as _TOMB
 from .digestlog import MAN_MAGIC as _MAN_MAGIC
@@ -409,6 +410,7 @@ class DedupIndex:
                 # direction stays a safe false negative either way
                 self._log.discard(digest)
                 self._cuckoo.discard_fp(digest)
+                fswitness.note("filter.remove", digest.hex())
                 gone = True
             else:
                 gone = self._cuckoo.discard(digest)
@@ -431,6 +433,9 @@ class DedupIndex:
         disk (a safe false negative, never a resurrectable entry)."""
         for d in digests:
             self.discard(d)
+            # the ack IS the discard-before-unlink fence: the witness
+            # pairs this event against the sweep's chunk unlink
+            fswitness.note("index.discard", d.hex())
         return [True] * len(digests)
 
     # -- whole-segment handoff (ISSUE 16, docs/dist-index.md) --------------
@@ -568,12 +573,9 @@ class DedupIndex:
                                      len(known), len(blob))
                 body = hdr + payload + \
                     hashlib.sha256(hdr + payload).digest()
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(body)
-            if sketches is not None:
-                f.write(self._sketch_section(sketches))
-        os.replace(tmp, path)
+        if sketches is not None:
+            body += self._sketch_section(sketches)
+        atomicio.replace_bytes(path, body)
         METRICS.add("snapshot_saves")
 
     def load_snapshot(self, path: str) -> bool:

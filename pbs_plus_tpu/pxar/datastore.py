@@ -34,7 +34,7 @@ try:
 except ImportError:                 # image lacks the wheel; ctypes shim
     from ..utils import zstdshim as zstandard
 
-from ..utils import failpoints, validate
+from ..utils import atomicio, failpoints, fswitness, validate
 from ..utils.counters import Counters
 from ..utils.log import L
 
@@ -358,6 +358,10 @@ class ChunkStore:
             loaded = self._index.load_snapshot(self._index_snap)
         finally:
             try:
+                # consume-once snapshot, not a chunk: no index entry
+                # pairs with this unlink — going stale is the hazard,
+                # not ordering
+                # pbslint: disable=ordering-discipline
                 os.unlink(self._index_snap)
             except OSError:
                 pass
@@ -811,30 +815,15 @@ class ChunkStore:
     def _write_payload(self, p: str, payload: bytes) -> None:
         """tmp+rename an already-encoded on-disk payload into place."""
         self._ensure_dir(os.path.dirname(p))
-        tmp = f"{p}.tmp.{os.getpid()}.{threading.get_ident()}"
-        with open(tmp, "wb") as f:
-            f.write(payload)
-        os.replace(tmp, p)
+        atomicio.replace_bytes(p, payload, per_thread=True)
 
     def _claim_payload(self, p: str, payload: bytes) -> bool:
-        """tmp + ``os.link`` CAS: the final path is CREATED, never
-        replaced, so exactly one process's write wins (EEXIST = lost
-        claim).  The tmp name carries pid+tid, so co-resident writers
-        and sibling processes never collide on the staging file."""
+        """tmp + ``os.link`` CAS via atomicio: the final path is
+        CREATED, never replaced, so exactly one process's write wins
+        (EEXIST = lost claim).  The staging name carries pid+tid, so
+        co-resident writers and sibling processes never collide."""
         self._ensure_dir(os.path.dirname(p))
-        tmp = f"{p}.tmp.{os.getpid()}.{threading.get_ident()}"
-        with open(tmp, "wb") as f:
-            f.write(payload)
-        try:
-            os.link(tmp, p)
-        except FileExistsError:
-            return False
-        finally:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-        return True
+        return atomicio.claim_bytes(p, payload)
 
     def _note_datablob_hit(self, digest: bytes, p: str, shard: int) -> None:
         """pbs-format dedup hit: a hit against a NATIVE raw-zstd chunk
@@ -880,10 +869,9 @@ class ChunkStore:
         if is_datablob(raw):
             return
         data = self._dctx.decompress(raw, max_output_size=1 << 30)
-        tmp = f"{p}.tmp.{os.getpid()}.{threading.get_ident()}"
-        with open(tmp, "wb") as f:
-            f.write(blob_encode(data, cctx=self._shard_cctx[shard]))
-        os.replace(tmp, p)
+        atomicio.replace_bytes(
+            p, blob_encode(data, cctx=self._shard_cctx[shard]),
+            per_thread=True)
 
     # absolute ceiling on a delta chain while REASSEMBLING — far above
     # any configurable max_chain; purely a corruption guard so a
@@ -1121,9 +1109,10 @@ class ChunkStore:
         if self._delta_marked:
             return True
         try:
-            with open(self._delta_marker_path(), "w") as f:
-                f.write("delta blobs present; GC mark must close over "
-                        "bases (docs/data-plane.md Similarity tier)\n")
+            atomicio.replace_bytes(
+                self._delta_marker_path(),
+                b"delta blobs present; GC mark must close over "
+                b"bases (docs/data-plane.md Similarity tier)\n")
         except OSError as e:
             L.warning("delta-tier marker unwritable (%s); storing full "
                       "blobs", e)
@@ -1231,6 +1220,9 @@ class ChunkStore:
                         try:
                             st = os.stat(p)
                             if max(st.st_atime, st.st_mtime) < before:
+                                # non-chunk debris (crashed writer's
+                                # .tmp): no digest, nothing to discard
+                                # pbslint: disable=ordering-discipline
                                 os.unlink(p)
                         except OSError:
                             pass
@@ -1364,10 +1356,7 @@ class DynamicIndex:
                 [(int(e), self.digests[i].tobytes())
                  for i, e in enumerate(self.ends)],
                 self.uuid, self.ctime_ns // 1_000_000_000)
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "wb") as f:
-                f.write(data)
-            os.replace(tmp, path)
+            atomicio.replace_bytes(path, data)
             return
         arr = np.empty(len(self.ends), dtype=_REC_DTYPE)
         arr["end"] = self.ends
@@ -1375,11 +1364,9 @@ class DynamicIndex:
             np.dtype("V32")).reshape(-1)
         hdr = _HDR.pack(DIDX_MAGIC, DIDX_VERSION, 0, self.uuid,
                         self.ctime_ns, len(self.ends))
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
+        with atomicio.atomic_write(path) as f:
             f.write(hdr)
             f.write(arr.tobytes())
-        os.replace(tmp, path)
 
     @classmethod
     def parse(cls, path: str) -> "DynamicIndex":

@@ -68,7 +68,7 @@ from typing import Iterable, Iterator, Sequence
 
 import numpy as np
 
-from ..utils import failpoints, trace
+from ..utils import atomicio, failpoints, fswitness, trace
 from ..utils.log import L
 
 SEG_MAGIC = b"TPXG"
@@ -277,13 +277,11 @@ def _write_segment_file(path: str, recs: np.ndarray) -> bytes:
     fence_section = (_FENCE_HDR.pack(len(fences)) + fences.tobytes()
                      + recs[-1, :32].tobytes())
     trailer = hashlib.sha256(hdr + fence_section).digest()
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
+    with atomicio.atomic_write(path) as f:
         f.write(hdr)
         f.write(records)
         f.write(fence_section)
         f.write(trailer)
-    os.replace(tmp, path)
     return trailer
 
 
@@ -615,6 +613,9 @@ class DigestLog:
             else:
                 self._mem[digest] = FLAG_TOMBSTONE
                 self._maybe_spill()
+        # tombstone recorded BEFORE the caller drops the filter
+        # fingerprint — the witness pairs these two events
+        fswitness.note("digestlog.tombstone", digest.hex())
 
     # -- spill / flush -----------------------------------------------------
     def _maybe_spill(self) -> None:

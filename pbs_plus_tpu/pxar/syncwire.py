@@ -53,14 +53,13 @@ import hmac
 import http.client
 import json
 import os
-import shutil
 import struct
 import threading
 import time
 import urllib.parse
 from typing import Iterable, Sequence
 
-from ..utils import conf, failpoints, trace, validate
+from ..utils import atomicio, conf, failpoints, trace, validate
 from ..utils.log import L
 from .datastore import Datastore, DynamicIndex, SnapshotRef, \
     parse_snapshot_ref
@@ -295,26 +294,17 @@ class LocalSyncDest:
         final = self.ds.snapshot_dir(ref)
         if os.path.exists(final):
             return
-        tmp = f"{final}.tmp.{os.getpid()}.{threading.get_ident()}"
-        os.makedirs(tmp)
-        try:
+        # tolerate_existing: a concurrent publisher may win the rename
+        # race (two sync jobs mirroring one group) — identical content,
+        # so the loser just drops its staging dir
+        with atomicio.staged_dir(
+                final,
+                tmp=f"{final}.tmp.{os.getpid()}.{threading.get_ident()}",
+                tolerate_existing=True) as tmp:
             for name, blob in files.items():
                 if "/" in name or "\\" in name or name in ("", ".", ".."):
                     raise SyncError(f"unsafe snapshot file name {name!r}")
-                with open(os.path.join(tmp, name), "wb") as f:
-                    f.write(blob)
-            try:
-                os.replace(tmp, final)
-            except OSError:
-                # concurrent publisher won the rename race (two sync
-                # jobs mirroring one group): identical content, so the
-                # loser just drops its staging dir
-                if not os.path.isdir(final):
-                    raise
-                shutil.rmtree(tmp, ignore_errors=True)
-        except BaseException:
-            shutil.rmtree(tmp, ignore_errors=True)
-            raise
+                atomicio.write_bytes(os.path.join(tmp, name), blob)
 
 
 # -- durable progress state --------------------------------------------------
@@ -360,11 +350,7 @@ class SyncState:
 
     def save(self) -> None:
         self.data["updated_unix"] = time.time()
-        os.makedirs(os.path.dirname(self.path), exist_ok=True)
-        tmp = f"{self.path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(self.data, f, indent=1, sort_keys=True)
-        os.replace(tmp, self.path)
+        atomicio.replace_json(self.path, self.data, makedirs=True)
 
 
 def state_path(state_root: str, job_id: str) -> str:

@@ -35,7 +35,7 @@ from typing import Callable, Iterator
 
 from ..chunker import ChunkerParams, CpuChunker
 from ..chunker import spec as _spec
-from ..utils import trace
+from ..utils import atomicio, trace
 from ..utils.log import L
 from .datastore import ChunkStore, Datastore, DynamicIndex, SnapshotRef
 from .format import Entry, KIND_DIR, KIND_FILE, decode_entries
@@ -1098,8 +1098,5 @@ def write_manifest(path: str, *, ref: SnapshotRef, midx: DynamicIndex,
     }
     if extra:
         manifest.update(extra)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(manifest, f, indent=1, sort_keys=True)
-    os.replace(tmp, path)
+    atomicio.replace_json(path, manifest)
     return manifest

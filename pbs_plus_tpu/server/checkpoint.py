@@ -50,7 +50,7 @@ from ..pxar.datastore import BACKUP_TYPES, Datastore, DynamicIndex, SnapshotRef
 from ..pxar.format import KIND_FILE
 from ..pxar.transfer import SplitReader
 from ..chunker import spec as _spec
-from ..utils import failpoints
+from ..utils import atomicio, failpoints
 from ..utils.log import L
 
 CKPT_DIR = ".ckpt"
@@ -235,9 +235,10 @@ class Checkpointer:
         }
         seq, self._seq = self._seq, self._seq + 1
         os.makedirs(self._dir, exist_ok=True)
-        tmp = os.path.join(self._dir, f".tmp-{seq:08d}.{os.getpid()}")
-        os.makedirs(tmp)
-        try:
+        with atomicio.staged_dir(
+                os.path.join(self._dir, f"ck-{seq:08d}"),
+                tmp=os.path.join(self._dir,
+                                 f".tmp-{seq:08d}.{os.getpid()}")) as tmp:
             now_ns = time.time_ns()
             DynamicIndex.from_records(list(writer.meta.records),
                                       ctime_ns=now_ns).write(
@@ -245,13 +246,10 @@ class Checkpointer:
             DynamicIndex.from_records(list(writer.payload.records),
                                       ctime_ns=now_ns).write(
                 os.path.join(tmp, PAYLOAD_IDX))
-            spath = os.path.join(tmp, STATE_JSON)
-            with open(spath, "w") as f:
-                json.dump(state, f, indent=1, sort_keys=True)
-            os.replace(tmp, os.path.join(self._dir, f"ck-{seq:08d}"))
-        except BaseException:
-            shutil.rmtree(tmp, ignore_errors=True)
-            raise
+            atomicio.write_bytes(
+                os.path.join(tmp, STATE_JSON),
+                json.dumps(state, indent=1, sort_keys=True)
+                .encode("utf-8"))
         # the new checkpoint supersedes every older one in the group —
         # EXCEPT the one this session is resuming from: its indexes are
         # the only GC protection for files the plan has not spliced yet,

@@ -24,6 +24,7 @@ import time
 from dataclasses import dataclass, field
 
 from ..pxar.datastore import Datastore, SnapshotRef
+from ..utils import fswitness
 from ..utils.log import L
 
 GC_GRACE_S = 24 * 3600.0      # PBS-style safety window for in-flight data
@@ -200,10 +201,12 @@ def run_prune(ds: Datastore, policy: PrunePolicy, *,
         # touched immediately after it (live-chunk loss)
         mark_start = _file_clock_now(ds.chunks.base)
         mark_live_chunks(ds, live=live)
+        fswitness.note("gc.mark", ds.chunks.base)
         # sweep only chunks last touched before BOTH the mark and the
         # grace cutoff — a just-inserted chunk of an in-flight session
         # is always newer than the cutoff
         cutoff = min(mark_start, time.time() - gc_grace_s)
+        fswitness.note("gc.sweep", ds.chunks.base)
         report.chunks_removed, report.bytes_freed = \
             ds.chunks.sweep(before=cutoff)
     L.info("prune: removed %d kept %d (dry_run=%s, %d chunks, %d bytes)",

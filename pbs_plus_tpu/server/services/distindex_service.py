@@ -67,7 +67,8 @@ class DistIndexService:
         two are mutually exclusive by operational contract
         (docs/dist-index.md failure matrix)."""
         if self.client is None:
-            raise RuntimeError("distributed index is not enabled")
+            from ...parallel.dist_index import DistIndexError
+            raise DistIndexError("distributed index is not enabled")
         return self.client.rebalance(new_map)
 
     def stats(self) -> dict:

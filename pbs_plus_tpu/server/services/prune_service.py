@@ -49,6 +49,13 @@ class GCLeaseHeldError(RuntimeError):
     """Another live process holds the GC lease — this cycle is theirs."""
 
 
+class PruneDeferredError(RuntimeError):
+    """GC yielded to active/running backup jobs; retry after they drain.
+    A RuntimeError subclass so the scheduler/web/fleetproc retry
+    catchers keep working — but typed, so callers stop string-matching
+    (pbslint ``typed-error-discipline``)."""
+
+
 class _LeaseHeartbeat(threading.Thread):
     """ttl/3 lease renewer on its OWN thread: an asyncio-loop stall
     (long GIL-held kernel, blocking DB call) cannot starve the
@@ -176,7 +183,7 @@ class PruneService:
                 # before each job's session starts).
                 active = self._jobs_active()
                 if active:
-                    raise RuntimeError(
+                    raise PruneDeferredError(
                         f"prune deferred: {active} job(s) active")
                 if self._db is not None:
                     # lease FIRST (advertises GC fleet-wide through the
@@ -194,7 +201,7 @@ class PruneService:
                     if running:
                         await loop.run_in_executor(
                             None, self._db.release_gc_lease, self.holder)
-                        raise RuntimeError(
+                        raise PruneDeferredError(
                             f"prune deferred: {running} job(s) running "
                             "fleet-wide")
                     heartbeat = _LeaseHeartbeat(

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import os
 
+from ..utils import atomicio
+
 MARK_BEGIN = "<!-- pbs-plus-tpu:begin -->"
 MARK_END = "<!-- pbs-plus-tpu:end -->"
 
@@ -56,10 +58,7 @@ def inject_into_index(index_path: str, script: str) -> bool:
         new = html + "\n" + block + "\n"
     if new == html:
         return False
-    tmp = f"{index_path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        f.write(new)
-    os.replace(tmp, index_path)
+    atomicio.replace_bytes(index_path, new.encode("utf-8"))
     return True
 
 

@@ -42,7 +42,7 @@ from typing import TYPE_CHECKING
 
 from aiohttp import web
 
-from ..utils import fsio, trace
+from ..utils import atomicio, fsio, trace
 from ..utils.log import L
 from ..utils.singleflight import SingleFlight
 from . import database
@@ -1344,10 +1344,7 @@ def _signer_keys(server) -> tuple[bytes, bytes]:
                 serialization.Encoding.PEM,
                 serialization.PublicFormat.SubjectPublicKeyInfo)
             if not os.path.exists(pub_p):
-                tmp = f"{pub_p}.tmp.{os.getpid()}"
-                with open(tmp, "wb") as f:
-                    f.write(pub)
-                os.replace(tmp, pub_p)
+                atomicio.replace_bytes(pub_p, pub)
             return priv, pub
         key = ed25519.Ed25519PrivateKey.generate()
         priv = key.private_bytes(serialization.Encoding.PEM,
@@ -1357,12 +1354,10 @@ def _signer_keys(server) -> tuple[bytes, bytes]:
             serialization.Encoding.PEM,
             serialization.PublicFormat.SubjectPublicKeyInfo)
         for path, data in ((pub_p, pub), (key_p, priv)):
-            tmp = f"{path}.tmp.{os.getpid()}"
-            fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-            os.write(fd, data)
-            os.close(fd)
-            os.replace(tmp, path)      # priv lands LAST: its presence
-        return priv, pub               # implies the pub is complete
+            # 0o600 from the first byte; priv lands LAST: its presence
+            # implies the pub is complete
+            atomicio.replace_bytes(path, data, mode_bits=0o600)
+        return priv, pub
 
 
 _RELEASE_TTL_S = 30.0
@@ -1432,7 +1427,7 @@ def _build_agent_pyz(state_dir: str) -> str:
             tmp = f"{out}.tmp.{_uuid.uuid4().hex[:8]}"
             zipapp.create_archive(stage, tmp,
                                   interpreter="/usr/bin/env python3")
-            os.replace(tmp, out)
+            atomicio.publish_staged(tmp, out)
         finally:
             shutil.rmtree(stage, ignore_errors=True)
         return out

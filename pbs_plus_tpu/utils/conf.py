@@ -84,6 +84,7 @@ ENV_VARS = {
     "PBS_PLUS_FAILPOINTS": "arm failpoints at import (site=action@trig;…)",
     "PBS_PLUS_TRACE_RING": "trace ring capacity (closed spans retained)",
     "PBS_PLUS_LOCKWATCH": "runtime lock-order witness (utils/lockwatch.py)",
+    "PBS_PLUS_FSWITNESS": "runtime fs-protocol witness (utils/fswitness.py)",
     "PBS_PLUS_BOOTSTRAP_URL": "operator: agent bootstrap endpoint",
     "PBS_PLUS_BOOTSTRAP_TOKEN": "operator: bootstrap bearer token",
     "PBS_PLUS_AGENT_IMAGE": "operator: agent container image",

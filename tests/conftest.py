@@ -28,9 +28,37 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def fs_witness(request):
+    """Runtime fs-protocol witness (utils/fswitness.py,
+    docs/protocols.md): records every rename/link/unlink/open plus the
+    product tree's ``fswitness.note`` events and fails the test on a
+    torn durable write, a non-staged publish, or a declared-ordering
+    inversion.  The chaos/crash batteries wire this autouse (crashes
+    are exactly when publish ordering interleaves); ``PBS_PLUS_FSWITNESS=0``
+    opts out globally, ``@pytest.mark.no_fswitness`` per test (for
+    tests that deliberately write torn files to prove the READER
+    rejects them)."""
+    from pbs_plus_tpu.utils import fswitness
+    if os.environ.get(fswitness.ENV_VAR, "1") == "0" or \
+            request.node.get_closest_marker("no_fswitness"):
+        yield None
+        return
+    with fswitness.watching() as w:
+        yield w
+    w.assert_clean()
+
 
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: fleet-scale soak profiles (N=500; runs in the default "
         "loop, deselect with -m 'not slow' for a quick pass)")
+    config.addinivalue_line(
+        "markers",
+        "no_fswitness: opt a test out of the default-on fs-protocol "
+        "witness (utils/fswitness.py) — for tests that deliberately "
+        "write torn files to prove the READER rejects them")

@@ -19,7 +19,7 @@ import os
 
 from pbs_plus_tpu.server.fleetsim import (FleetConfig, run_fleet,
                                           synthetic_tree)
-from pbs_plus_tpu.utils import lockwatch
+from pbs_plus_tpu.utils import fswitness, lockwatch
 
 N = 500 if os.environ.get("PBS_PLUS_FLEET") else 100
 
@@ -43,6 +43,35 @@ def _lock_witness():
     # the acyclicity assertion proves nothing
     assert any("datastore.py" in a or "datastore.py" in b
                for a, b in watch.edges()), watch.edges()
+
+
+@contextlib.contextmanager
+def _fs_witness():
+    """Runtime fs-protocol witness (docs/protocols.md), `_lock_witness`'s
+    twin for the crash-consistency invariants: every chunk/snapshot/index
+    publish during the run must be a staged atomic rename/link, and the
+    declared orderings (index discard before chunk unlink, GC mark before
+    sweep, ...) must hold per key.  Same default-on rationale — a 10%
+    hard-kill run is exactly when torn publishes and ordering inversions
+    would interleave; PBS_PLUS_FSWITNESS=0 opts out."""
+    if os.environ.get(fswitness.ENV_VAR, "1") == "0":
+        yield None
+        return
+    with fswitness.watching() as w:
+        yield w
+    w.assert_clean()
+    # the witness must have actually seen the data plane publish chunks,
+    # or the cleanliness assertion proves nothing
+    assert any("/.chunks/" in p for op, p in w.fs_ops
+               if op in ("rename", "replace", "link")), \
+        "fswitness saw no chunk publishes"
+
+
+@contextlib.contextmanager
+def _witnesses():
+    """Both runtime witnesses composed (lock order + fs protocols)."""
+    with _lock_witness(), _fs_witness() as w:
+        yield w
 
 
 def _cfg(**kw) -> FleetConfig:
@@ -78,7 +107,7 @@ def _snapshot_views(store, cns):
 
 def test_fleet_chaos_composition(tmp_path):
     cfg = _cfg(kill_fraction=0.10, kill_after_reads=2)
-    with _lock_witness():
+    with _witnesses():
         rep = run_fleet(str(tmp_path / "ds-chaos"), cfg)
     d = rep.to_dict()
 
@@ -165,7 +194,7 @@ def test_fleet_chaos_gc_dedup_index_coherent(tmp_path):
 
     n = 20
     cfg = _cfg(n_agents=n, kill_fraction=0.10, kill_after_reads=2)
-    with _lock_witness():
+    with _witnesses():
         rep = run_fleet(str(tmp_path / "ds"), cfg)
         assert rep.to_dict()["published"] == n, rep.failures
         assert len(rep.killed) == max(1, int(n * cfg.kill_fraction))
@@ -240,7 +269,7 @@ def test_fleet_chaos_gc_coherent_with_spilled_confirm_tier(
     try:
         n = 12
         cfg = _cfg(n_agents=n, kill_fraction=0.10, kill_after_reads=2)
-        with _lock_witness():
+        with _witnesses():
             rep = run_fleet(str(tmp_path / "ds"), cfg)
             assert rep.to_dict()["published"] == n, rep.failures
 

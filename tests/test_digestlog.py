@@ -10,6 +10,7 @@ import os
 import time
 
 import numpy as np
+import pytest
 
 from pbs_plus_tpu.pxar import chunkindex, digestlog
 from pbs_plus_tpu.pxar.chunkindex import DedupIndex
@@ -32,6 +33,15 @@ def _chunk(i: int, size: int = 512) -> tuple[bytes, bytes]:
 
 def _confirm_reads() -> int:
     return digestlog.metrics_snapshot()["confirm_reads"]
+
+
+@pytest.fixture(autouse=True)
+def _battery_fs_witness(fs_witness):
+    """Default-on fs-protocol witness (docs/protocols.md): segment and
+    snapshot publishes in this battery must stay atomic and the
+    tombstone-before-fingerprint ordering must hold even under the
+    crash/compaction faults injected here."""
+    yield fs_witness
 
 
 # ------------------------------------------------------------ DigestLog

@@ -1,0 +1,217 @@
+"""fswitness battery: the runtime fs-protocol witness
+(pbs_plus_tpu/utils/fswitness.py, docs/protocols.md) — atomic-publish
+detection, declared-ordering pass/violation, nested staged-directory
+renames, install/uninstall hygiene — plus the declared-protocol sync
+check (the witness's runtime faces must match tools/lint/protocols.py
+verbatim) and the deliberately-broken writer fixture that must be
+caught BOTH ways: by the witness at runtime and by pbslint's
+durable-write-discipline rule statically."""
+
+import builtins
+import json
+import os
+import textwrap
+
+import pytest
+
+from pbs_plus_tpu.utils import atomicio, fswitness
+
+DIGEST = "ab" * 32
+
+
+def _chunk_path(tmp_path):
+    d = tmp_path / "store" / ".chunks" / "abcd"
+    d.mkdir(parents=True, exist_ok=True)
+    return str(d / DIGEST)
+
+
+# ---------------------------------------------------- atomic publish
+
+
+def test_staged_replace_on_family_path_is_clean(tmp_path):
+    p = _chunk_path(tmp_path)
+    with fswitness.watching() as w:
+        atomicio.replace_bytes(p, b"payload")
+    w.assert_clean()
+    assert any("/.chunks/" in path for op, path in w.fs_ops
+               if op == "replace")
+
+
+def test_torn_write_open_on_family_path_flags(tmp_path):
+    p = str(tmp_path / "snap" / "manifest.json")
+    os.makedirs(os.path.dirname(p))
+    with fswitness.watching() as w:
+        with open(p, "w") as f:
+            f.write("{}")
+    with pytest.raises(AssertionError, match="torn durable write"):
+        w.assert_clean()
+
+
+def test_non_staged_rename_onto_family_path_flags(tmp_path):
+    p = _chunk_path(tmp_path)
+    src = str(tmp_path / "plain-source")          # no staging marker
+    with open(src, "wb") as f:
+        f.write(b"x")
+    with fswitness.watching() as w:
+        os.replace(src, p)
+    with pytest.raises(AssertionError, match="non-staged publish"):
+        w.assert_clean()
+
+
+def test_nested_rename_of_staged_directory_is_clean(tmp_path):
+    # files written INSIDE a staged directory are staged (whole-path
+    # scan), and the directory's own rename publishes them atomically
+    ck = tmp_path / "ds" / ".ckpt"
+    stage = ck / "stage-42"
+    stage.mkdir(parents=True)
+    with fswitness.watching() as w:
+        with open(stage / "manifest.json", "w") as f:
+            f.write("{}")
+        os.replace(str(stage), str(ck / "ck-00000042"))
+    w.assert_clean()
+
+
+def test_read_open_and_non_family_paths_ignored(tmp_path):
+    p = _chunk_path(tmp_path)
+    atomicio.replace_bytes(p, b"payload")
+    scratch = str(tmp_path / "notes.txt")
+    with fswitness.watching() as w:
+        with open(p, "rb") as f:
+            f.read()
+        with open(scratch, "w") as f:             # not a family path
+            f.write("hi")
+    w.assert_clean()
+
+
+# ------------------------------------------------- declared orderings
+
+
+def test_discard_before_unlink_pass(tmp_path):
+    p = _chunk_path(tmp_path)
+    atomicio.replace_bytes(p, b"payload")
+    with fswitness.watching() as w:
+        fswitness.note("index.discard", DIGEST)
+        os.unlink(p)
+    w.assert_clean()
+    assert w.saw("chunk.unlink")
+
+
+def test_unlink_without_discard_flags_once_protocol_live(tmp_path):
+    p = _chunk_path(tmp_path)
+    atomicio.replace_bytes(p, b"payload")
+    with fswitness.watching() as w:
+        fswitness.note("index.discard", "ff" * 32)   # other key: live
+        os.unlink(p)
+    with pytest.raises(AssertionError, match="discard-before-unlink"):
+        w.assert_clean()
+
+
+def test_unlink_with_no_discard_protocol_at_all_is_clean(tmp_path):
+    # an index-less store legitimately unlinks chunks: the ordering is
+    # enforced only once its before-event has been observed at all
+    p = _chunk_path(tmp_path)
+    atomicio.replace_bytes(p, b"payload")
+    with fswitness.watching() as w:
+        os.unlink(p)
+    w.assert_clean()
+
+
+def test_mark_before_sweep_pass_and_inversion():
+    with fswitness.watching() as w:
+        fswitness.note("gc.mark", "/ds")
+        fswitness.note("gc.sweep", "/ds")
+    w.assert_clean()
+    with fswitness.watching() as w:
+        fswitness.note("gc.sweep", "/ds")
+        fswitness.note("gc.mark", "/ds")
+    with pytest.raises(AssertionError, match="mark-before-sweep"):
+        w.assert_clean()
+
+
+def test_failed_unlink_records_no_ordering_event(tmp_path):
+    p = _chunk_path(tmp_path)                     # never created
+    with fswitness.watching() as w:
+        fswitness.note("index.discard", "ff" * 32)
+        with pytest.raises(FileNotFoundError):
+            os.unlink(p)
+    w.assert_clean()
+    assert not w.saw("chunk.unlink")
+
+
+# ------------------------------------------------ install / uninstall
+
+
+def test_install_uninstall_restores_builtins(tmp_path):
+    real_open, real_replace = builtins.open, os.replace
+    with fswitness.watching():
+        assert builtins.open is not real_open
+        with fswitness.watching() as inner:       # nested: depth-counted
+            assert fswitness.install() is inner or True
+            fswitness.uninstall()
+            assert builtins.open is not real_open
+    assert builtins.open is real_open
+    assert os.replace is real_replace
+
+
+def test_note_is_noop_without_witness():
+    fswitness.note("index.discard", DIGEST)       # must not raise
+
+
+# ------------------------------------- declared-protocol sync (lint ↔ rt)
+
+
+def test_witness_families_match_declared_protocols():
+    from tools.lint import protocols
+    declared = {f["key"]: f["runtime_re"] for f in protocols.FAMILIES}
+    runtime = {f["key"]: f["re"] for f in fswitness.DEFAULT_FAMILIES}
+    assert declared == runtime
+
+
+def test_witness_orderings_match_declared_protocols():
+    from tools.lint import protocols
+    declared = [(o["name"], o["runtime"]["before"], o["runtime"]["after"])
+                for o in protocols.ORDERINGS]
+    runtime = [(o["key"], o["before"], o["after"])
+               for o in fswitness.DEFAULT_ORDERINGS]
+    assert declared == runtime
+
+
+# ----------------------------------- broken writer: caught BOTH ways
+
+
+BROKEN_WRITER = """
+    import json
+    import os
+
+    def publish_manifest(path, entries):
+        # BROKEN: writes the final name directly — a crash mid-write
+        # leaves a torn manifest a reader will choke on
+        with open(path, "w") as f:
+            json.dump(entries, f)
+"""
+
+
+def test_broken_writer_caught_by_witness(tmp_path):
+    ns = {}
+    exec(textwrap.dedent(BROKEN_WRITER), ns)
+    p = str(tmp_path / "snap" / "manifest.json")
+    os.makedirs(os.path.dirname(p))
+    with fswitness.watching() as w:
+        ns["publish_manifest"](p, {"files": []})
+    assert json.load(open(p)) == {"files": []}    # behavior unchanged
+    with pytest.raises(AssertionError, match="torn durable write"):
+        w.assert_clean()
+
+
+def test_broken_writer_caught_by_static_rule(tmp_path):
+    from tools.lint.graph import build_program
+    from tools.lint.rules import build_program_rules
+    mod = tmp_path / "pbs_plus_tpu" / "pxar" / "backupproxy.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(textwrap.dedent(BROKEN_WRITER))
+    prog, errors = build_program([str(tmp_path)], root=str(tmp_path),
+                                 use_cache=False)
+    assert errors == []
+    [rule] = build_program_rules({"durable-write-discipline"})
+    vs = rule.analyze(prog)
+    assert len(vs) == 1 and "write-mode open" in vs[0].message

@@ -47,6 +47,8 @@ def test_registry_has_expected_rules():
     assert set(program_rule_names()) == {
         "guarded-by", "lock-order",
         "no-blocking-in-async-transitive", "registry-consistency",
+        "durable-write-discipline", "ordering-discipline",
+        "typed-error-discipline",
     }
     # a --rules subset may name rules from either registry
     assert build_rules({"guarded-by"}) == []
@@ -1843,6 +1845,253 @@ def test_registry_live_tree_is_closed():
     assert rule.analyze(prog) == []
 
 
+# ------------------------------------------- durable-write-discipline
+
+
+def test_durable_write_flags_raw_replace(tmp_path):
+    v = _analyze(tmp_path, {"pbs_plus_tpu/pxar/datastore.py": """
+        import os
+        def publish(tmp, final):
+            os.replace(tmp, final)
+    """}, "durable-write-discipline")
+    assert len(v) == 1 and "atomicio" in v[0].message
+    assert "os.replace" in v[0].message
+
+
+def test_durable_write_flags_write_open_and_shutil_move(tmp_path):
+    v = _analyze(tmp_path, {"pbs_plus_tpu/pxar/chunkindex.py": """
+        import shutil
+        def snap(path):
+            with open(path, "wb") as f:
+                f.write(b"x")
+        def mv(a, b):
+            shutil.move(a, b)
+    """}, "durable-write-discipline")
+    assert len(v) == 2
+    assert any("write-mode open" in x.message for x in v)
+    assert any("shutil.move" in x.message for x in v)
+
+
+def test_durable_write_flags_helper_publishing_on_behalf(tmp_path):
+    # the interprocedural leg: the raw op hides one (and two) calls away
+    v = _analyze(tmp_path, {
+        "pbs_plus_tpu/pxar/digestlog.py": """
+            from pbs_plus_tpu.helpers import swap
+            def flush(tmp, final):
+                swap(tmp, final)
+        """,
+        "pbs_plus_tpu/helpers.py": """
+            import os
+            def swap(a, b):
+                _inner(a, b)
+            def _inner(a, b):
+                os.rename(a, b)
+        """}, "durable-write-discipline")
+    assert len(v) == 1
+    assert v[0].path.endswith("digestlog.py")
+    assert "on behalf" in v[0].message
+
+
+def test_durable_write_atomicio_calls_and_deletes_clean(tmp_path):
+    # atomicio IS the sanctioned raw-fs user: calling it never taints,
+    # and deletions/read-opens are not publishes
+    v = _analyze(tmp_path, {
+        "pbs_plus_tpu/pxar/datastore.py": """
+            import os
+            from pbs_plus_tpu.utils import atomicio
+            def publish(path, data):
+                atomicio.replace_bytes(path, data)
+            def reap(p):
+                os.unlink(p)
+            def read(p):
+                with open(p, "rb") as f:
+                    return f.read()
+        """,
+        "pbs_plus_tpu/utils/atomicio.py": """
+            import os
+            def replace_bytes(path, data):
+                tmp = path + ".tmp.x"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+        """}, "durable-write-discipline")
+    assert v == []
+
+
+def test_durable_write_scoped_to_durable_modules(tmp_path):
+    # a raw publish in a module outside DURABLE_MODULES (with no durable
+    # caller) is out of scope for this rule
+    v = _analyze(tmp_path, {"pbs_plus_tpu/server/web.py": """
+        import os
+        def rotate(a, b):
+            os.replace(a, b)
+    """}, "durable-write-discipline")
+    assert v == []
+
+
+# ----------------------------------------------- ordering-discipline
+
+
+def test_ordering_flags_unlink_without_discard(tmp_path):
+    v = _analyze(tmp_path, {"pbs_plus_tpu/pxar/datastore.py": """
+        import os
+        def sweep(paths):
+            for p in paths:
+                os.unlink(p)
+    """}, "ordering-discipline")
+    assert len(v) == 1
+    assert "discard-before-unlink" in v[0].message
+
+
+def test_ordering_flags_inverted_lexical_order(tmp_path):
+    v = _analyze(tmp_path, {"pbs_plus_tpu/pxar/datastore.py": """
+        import os
+        def sweep(self, p, digests):
+            os.unlink(p)
+            self.index.discard_many_acked(digests)
+    """}, "ordering-discipline")
+    assert len(v) == 1 and "discard-before-unlink" in v[0].message
+
+
+def test_ordering_flags_sweep_without_mark_and_retire_without_install(
+        tmp_path):
+    v = _analyze(tmp_path, {
+        "pbs_plus_tpu/server/prune.py": """
+            def gc(self, ds):
+                ds.chunks.sweep(before=0)
+        """,
+        "pbs_plus_tpu/parallel/dist_index.py": """
+            def rebalance(self):
+                self._retire_from_old()
+                self._install_map_on_all()
+            def _retire_from_old(self):
+                pass
+            def _install_map_on_all(self):
+                pass
+        """}, "ordering-discipline")
+    msgs = sorted(x.message for x in v)
+    assert any("mark-before-sweep" in m for m in msgs)
+    assert any("map-install-before-retire" in m for m in msgs)
+    assert len(v) == 2
+
+
+def test_ordering_in_function_order_satisfies(tmp_path):
+    v = _analyze(tmp_path, {"pbs_plus_tpu/pxar/chunkindex.py": """
+        def discard(self, d, fp):
+            self._log.discard(d)
+            self._cuckoo.discard_fp(fp)
+    """}, "ordering-discipline")
+    assert v == []
+
+
+def test_ordering_caller_domination_satisfies(tmp_path):
+    # the after-site lives in a helper; EVERY caller performs the
+    # before-event ahead of the call site, so the helper is dominated
+    v = _analyze(tmp_path, {"pbs_plus_tpu/pxar/datastore.py": """
+        import os
+        class Store:
+            def sweep(self, digests, paths):
+                self.index.discard_many_acked(digests)
+                self._reap(paths)
+            def _reap(self, paths):
+                for p in paths:
+                    os.unlink(p)
+    """}, "ordering-discipline")
+    assert v == []
+
+
+def test_ordering_undominated_second_caller_flags(tmp_path):
+    # same helper, but a second caller reaches it WITHOUT the discard:
+    # domination fails and the after-site is flagged
+    v = _analyze(tmp_path, {"pbs_plus_tpu/pxar/datastore.py": """
+        import os
+        class Store:
+            def sweep(self, digests, paths):
+                self.index.discard_many_acked(digests)
+                self._reap(paths)
+            def wipe(self, paths):
+                self._reap(paths)
+            def _reap(self, paths):
+                for p in paths:
+                    os.unlink(p)
+    """}, "ordering-discipline")
+    assert len(v) == 1 and "discard-before-unlink" in v[0].message
+
+
+def test_ordering_inline_disable_honored(tmp_path):
+    v = _analyze(tmp_path, {"pbs_plus_tpu/pxar/datastore.py": """
+        import os
+        def reap_debris(p):
+            # consume-once debris, no index entry pairs with this
+            # pbslint: disable=ordering-discipline
+            os.unlink(p)
+    """}, "ordering-discipline")
+    assert v == []
+
+
+# --------------------------------------------- typed-error-discipline
+
+
+def test_typed_error_flags_runtime_error_at_boundary(tmp_path):
+    v = _analyze(tmp_path, {"pbs_plus_tpu/pxar/syncwire.py": """
+        class SyncError(Exception): pass
+        class SyncWireError(SyncError): pass
+        def pull(ok):
+            if not ok:
+                raise RuntimeError("peer rejected")
+    """}, "typed-error-discipline")
+    assert len(v) == 1
+    assert "raise RuntimeError" in v[0].message
+    assert "SyncError" in v[0].message        # taxonomy named in the fix
+
+
+def test_typed_error_flags_bare_exception_and_dotted(tmp_path):
+    v = _analyze(tmp_path, {"pbs_plus_tpu/server/web.py": """
+        import builtins
+        def handler(req):
+            raise Exception("bad request")
+        def other(req):
+            raise builtins.RuntimeError("oops")
+    """}, "typed-error-discipline")
+    assert len(v) == 2
+    assert all("web" in x.message for x in v)
+
+
+def test_typed_error_missing_declared_class_flags(tmp_path):
+    # TYPED_ERRORS declares SyncError at syncwire.py; renaming it away
+    # must fail the build
+    v = _analyze(tmp_path, {"pbs_plus_tpu/pxar/syncwire.py": """
+        class SyncWireError(Exception): pass
+    """}, "typed-error-discipline")
+    assert any("SyncError" in x.message and "no such class" in x.message
+               for x in v)
+
+
+def test_typed_error_taxonomy_and_reraise_clean(tmp_path):
+    # raising FROM the taxonomy, other typed errors, and bare re-raise
+    # are all legal; RuntimeError outside a boundary is out of scope
+    v = _analyze(tmp_path, {
+        "pbs_plus_tpu/pxar/syncwire.py": """
+            class SyncError(Exception): pass
+            class SyncWireError(SyncError): pass
+            def pull(ok):
+                if not ok:
+                    raise SyncWireError("peer rejected")
+                try:
+                    return 1
+                except OSError:
+                    raise
+            def check(v):
+                if v < 0:
+                    raise ValueError(v)
+        """,
+        "pbs_plus_tpu/pxar/other.py": """
+            def internal():
+                raise RuntimeError("not a boundary")
+        """}, "typed-error-discipline")
+    assert v == []
+
+
 # ------------------------------------------------ engine: graph + cache
 
 
@@ -1930,6 +2179,40 @@ def test_graph_cache_corrupt_or_stale_version_ignored(tmp_path):
     p, errors = build_program([str(tmp_path)], root=str(tmp_path),
                               use_cache=True, cache_path=str(cache))
     assert errors == [] and "m" in p.by_module
+
+
+def test_graph_cache_keyed_on_rule_set_hash(tmp_path):
+    """An edited rule (or protocols.py declaration) must force
+    re-analysis even though the ANALYZED files' hashes are unchanged:
+    the cache is keyed on ``rules_fingerprint()`` over the engine's own
+    sources.  Simulated by poisoning a cached summary and flipping the
+    stored fingerprint — a stale fingerprint must drop the whole cache
+    (the poison vanishes), a current one must honor it."""
+    from tools.lint.graph import rules_fingerprint
+    (tmp_path / "m.py").write_text("import time\n\ndef f():\n"
+                                   "    time.sleep(1)\n")
+    cache = tmp_path / "cache.json"
+    build_program([str(tmp_path)], root=str(tmp_path),
+                  use_cache=True, cache_path=str(cache))
+    data = json.loads(cache.read_text())
+    fp = rules_fingerprint()
+    assert data["rules"] == fp == rules_fingerprint()   # stable key
+    # poison the cached summary; same fingerprint → cache honored, the
+    # poisoned record round-trips (proving the cache really was read)
+    data["files"]["m.py"]["summary"]["functions"]["f"]["calls"] = []
+    cache.write_text(json.dumps(data))
+    p, _ = build_program([str(tmp_path)], root=str(tmp_path),
+                         use_cache=True, cache_path=str(cache))
+    assert p.by_module["m"].functions["f"]["calls"] == []
+    # stale fingerprint (an edited rule file) → full re-extract: the
+    # poison is gone and the rewritten cache carries the current key
+    data["rules"] = "stale" + fp[:8]
+    cache.write_text(json.dumps(data))
+    p, _ = build_program([str(tmp_path)], root=str(tmp_path),
+                         use_cache=True, cache_path=str(cache))
+    assert [c[0] for c in p.by_module["m"].functions["f"]["calls"]] == \
+        ["time.sleep"]
+    assert json.loads(cache.read_text())["rules"] == fp
 
 
 def test_graph_subset_run_does_not_evict_cache(tmp_path):
@@ -2024,8 +2307,34 @@ def test_cli_sarif_output(tmp_path):
     loc = results[0]["locations"][0]["physicalLocation"]
     assert loc["artifactLocation"]["uri"].endswith("seeded.py")
     assert loc["region"]["startLine"] == 1
-    assert any(rr["id"] == "mutable-default"
-               for rr in run["tool"]["driver"]["rules"])
+    [rr] = [rr for rr in run["tool"]["driver"]["rules"]
+            if rr["id"] == "mutable-default"]
+    # per-rule metadata round-trips: the invariant as shortDescription
+    # and a helpUri anchored into the rule's docs section
+    assert rr["helpUri"] == "docs/static-analysis.md#mutable-default"
+    assert "default" in rr["shortDescription"]["text"]
+
+
+def test_sarif_program_rule_metadata_roundtrip(tmp_path):
+    # program-rule findings carry the same metadata shape: invariant as
+    # shortDescription, per-rule docs anchor as helpUri
+    from tools.lint.cli import _sarif
+    vs = _analyze(tmp_path, {"pbs_plus_tpu/pxar/datastore.py": """
+        import os
+        def sweep(p):
+            os.unlink(p)
+    """}, "ordering-discipline")
+    assert vs
+    [rule] = build_program_rules({"ordering-discipline"})
+    doc = _sarif(vs, [], rule_index={rule.name: rule})
+    run = doc["runs"][0]
+    assert run["results"][0]["ruleId"] == "ordering-discipline"
+    [rr] = run["tool"]["driver"]["rules"]
+    assert rr["helpUri"] == \
+        "docs/static-analysis.md#ordering-discipline"
+    assert rr["shortDescription"]["text"] == rule.invariant
+    assert "happens-before" in rr["shortDescription"]["text"]
+    json.loads(json.dumps(doc))                # serializable round-trip
 
 
 def test_cli_sarif_clean_tree_empty_results(tmp_path):

@@ -42,6 +42,14 @@ def _clean_failpoints():
     failpoints.disarm_all()
 
 
+@pytest.fixture(autouse=True)
+def _battery_fs_witness(fs_witness):
+    """Default-on fs-protocol witness (docs/protocols.md): snapshot
+    publishes and `.sync/<job>/state.json` must stay atomic even when
+    the transfer faults injected here kill a sync mid-flight."""
+    yield fs_witness
+
+
 def make_snapshot(store: LocalStore, files: dict[str, bytes], *,
                   backup_id: str = "a", backup_time: float | None = None):
     sess = store.start_session(backup_type="host", backup_id=backup_id,
@@ -540,7 +548,8 @@ def test_sync_job_row_validation(tmp_path):
 # ------------------------------------------------------- state format
 
 
-def test_sync_state_roundtrip_and_corruption(tmp_path):
+@pytest.mark.no_fswitness      # deliberately writes a torn state.json to
+def test_sync_state_roundtrip_and_corruption(tmp_path):  # prove the READER rejects it
     path = os.path.join(str(tmp_path), ".sync", "j", "state.json")
     st = syncwire.SyncState.load(path)
     assert not st.resuming

@@ -72,11 +72,22 @@ def _git_changed() -> "set[str] | None":
             (diff.stdout + untracked.stdout).splitlines() if ln.strip()}
 
 
-def _sarif(new, errors) -> dict:
-    """Minimal SARIF 2.1.0: one run, one result per new violation."""
+def _sarif(new, errors, rule_index: "dict | None" = None) -> dict:
+    """SARIF 2.1.0: one run, one result per new violation; per-rule
+    metadata (shortDescription = the rule's invariant, helpUri = its
+    docs/static-analysis.md anchor) so CI annotations link back to the
+    rationale instead of just a rule id."""
     by_rule: dict[str, str] = {}
     for v in new:
         by_rule.setdefault(v.rule, v.message)
+    rules_meta = []
+    for r in sorted(by_rule):
+        ent: dict = {"id": r,
+                     "helpUri": f"docs/static-analysis.md#{r}"}
+        rule = (rule_index or {}).get(r)
+        if rule is not None and rule.invariant:
+            ent["shortDescription"] = {"text": rule.invariant}
+        rules_meta.append(ent)
     return {
         "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
         "version": "2.1.0",
@@ -85,7 +96,7 @@ def _sarif(new, errors) -> dict:
                 "name": "pbslint",
                 "informationUri":
                     "docs/static-analysis.md",
-                "rules": [{"id": r} for r in sorted(by_rule)],
+                "rules": rules_meta,
             }},
             "results": [{
                 "ruleId": v.rule,
@@ -264,7 +275,10 @@ def main(argv: "list[str] | None" = None) -> int:
     ok = not new and not result.errors and not orphans
 
     if args.fmt == "sarif":
-        print(json.dumps(_sarif(new, result.errors), indent=2))
+        rule_index = {r.name: r for r in
+                      list(rules) + list(program_rules)}
+        print(json.dumps(_sarif(new, result.errors, rule_index),
+                         indent=2))
     elif args.fmt == "json":
         print(json.dumps({
             "files": result.files,

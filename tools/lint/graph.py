@@ -46,9 +46,17 @@ from dataclasses import dataclass, field
 
 from .core import REPO_ROOT, Violation, iter_py_files
 
-CACHE_VERSION = 5
+CACHE_VERSION = 6
 CACHE_PATH = os.path.join(REPO_ROOT, "build", "pbslint",
                           "graph-cache.json")
+
+# fs mutations the durable-write / ordering rules care about, recorded
+# per function as ["fsops"] entries (op, line, argument text)
+_FS_OPS = {
+    "os.replace", "os.rename", "os.link", "os.unlink", "os.remove",
+    "shutil.move",
+}
+_OPEN_WRITE_RE = re.compile(r"[wax+]")
 
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([\w.\[\]]+)")
 _LOCK_ORDER_RE = re.compile(r"#\s*pbslint:\s*lock-order\s+([\w.\-]+)")
@@ -99,6 +107,8 @@ def _dotted(node: ast.AST) -> "str | None":
 #   "writes":  [[attr, line, [held...]], ...],   # self.<attr> stores
 #   "greads"/"gwrites": same for annotated module globals,
 #   "blocking":[[prim, line], ...],              # direct blocking calls
+#   "fsops":   [[op, line, argtext], ...],       # os.replace/... + open(w)
+#   "raises":  [[name, line, has_cause], ...],   # raise X(...) [from e]
 # }
 
 
@@ -258,6 +268,7 @@ class _Extractor(ast.NodeVisitor):
             and not self.func_stack else None,
             "calls": [], "acquires": [], "reads": [], "writes": [],
             "greads": [], "gwrites": [], "blocking": [],
+            "fsops": [], "raises": [],
         }
         if self.cls_stack and not self.func_stack:
             self.s.classes[self.cls_stack[-1]]["methods"].append(node.name)
@@ -414,11 +425,48 @@ class _Extractor(ast.NodeVisitor):
         if isinstance(node.ctx, ast.Load):
             self._record_attr(node, "reads")
 
+    def _arg_text(self, node: ast.Call) -> str:
+        try:
+            return ", ".join(ast.unparse(a) for a in node.args)
+        except Exception:           # unparse is best-effort display text
+            return ""
+
+    def _open_write_mode(self, node: ast.Call) -> bool:
+        mode = None
+        if len(node.args) >= 2:
+            mode = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            return bool(_OPEN_WRITE_RE.search(mode.value))
+        return False        # default "r" / dynamic mode: not a write
+
+    def visit_Raise(self, node: ast.Raise) -> None:
+        fn = self._fn()
+        if fn is not None and node.exc is not None:
+            exc = node.exc
+            if isinstance(exc, ast.Call):
+                exc = exc.func
+            name = _dotted(exc)
+            if name:
+                fn["raises"].append(
+                    [name, node.lineno, node.cause is not None])
+        self.generic_visit(node)
+
     def visit_Call(self, node: ast.Call) -> None:
         fn = self._fn()
         name = _dotted(node.func)
         if name and fn is not None:
             fn["calls"].append([name, node.lineno, list(self.held)])
+        if fn is not None:
+            if name in _FS_OPS:
+                fn["fsops"].append(
+                    [name, node.lineno, self._arg_text(node)])
+            elif name in ("open", "io.open") and \
+                    self._open_write_mode(node):
+                fn["fsops"].append(
+                    ["open-write", node.lineno, self._arg_text(node)])
         if name == "gauge" and node.args and \
                 self.s.path.endswith("server/metrics.py"):
             first = node.args[0]
@@ -470,23 +518,53 @@ def summarize_source(source: str, relpath: str) -> FileSummary:
 
 # -- cache ------------------------------------------------------------------
 
-def _load_cache(path: str = CACHE_PATH) -> dict:
+def rules_fingerprint() -> str:
+    """sha256 over the lint engine's own sources (tools/lint/**/*.py).
+    A cache entry is only as good as the extractor and the rule set that
+    consume it — an edited rule (or protocols.py declaration) must force
+    re-analysis even though the ANALYZED files' hashes are unchanged, so
+    the fingerprint joins CACHE_VERSION in the cache key."""
+    h = hashlib.sha256()
+    lint_dir = os.path.dirname(os.path.abspath(__file__))
+    for dirpath, dirnames, filenames in os.walk(lint_dir):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, fn)
+            rel = os.path.relpath(p, lint_dir).replace(os.sep, "/")
+            h.update(rel.encode("utf-8"))
+            h.update(b"\0")
+            try:
+                with open(p, "rb") as fh:
+                    h.update(fh.read())
+            except OSError:
+                pass
+            h.update(b"\0")
+    return h.hexdigest()
+
+
+def _load_cache(path: str = CACHE_PATH,
+                rules_sha: "str | None" = None) -> dict:
     try:
         with open(path, "r", encoding="utf-8") as fh:
             data = json.load(fh)
-        if data.get("version") == CACHE_VERSION:
+        if data.get("version") == CACHE_VERSION and (
+                rules_sha is None or data.get("rules") == rules_sha):
             return data.get("files", {})
     except (OSError, ValueError):
         pass
     return {}
 
 
-def _save_cache(files: dict, path: str = CACHE_PATH) -> None:
+def _save_cache(files: dict, path: str = CACHE_PATH,
+                rules_sha: "str | None" = None) -> None:
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump({"version": CACHE_VERSION, "files": files}, fh)
+            json.dump({"version": CACHE_VERSION, "rules": rules_sha,
+                       "files": files}, fh)
         os.replace(tmp, path)
     except OSError:
         pass                # cache is an optimization, never a failure
@@ -697,7 +775,8 @@ def build_program(paths: "list[str]", *, root: str = REPO_ROOT,
     """Summarize every .py under ``paths`` (cache-assisted) and link.
     Returns (program, errors) — errors are unparseable files, reported
     like core parse errors."""
-    cached = _load_cache(cache_path) if use_cache else {}
+    rules_sha = rules_fingerprint() if use_cache else None
+    cached = _load_cache(cache_path, rules_sha) if use_cache else {}
     fresh: dict[str, dict] = {}
     summaries: list[FileSummary] = []
     errors: list[str] = []
@@ -735,7 +814,7 @@ def build_program(paths: "list[str]", *, root: str = REPO_ROOT,
         if len(merged) > 4096:
             merged = fresh
         if merged != cached:
-            _save_cache(merged, cache_path)
+            _save_cache(merged, cache_path, rules_sha)
     return Program(summaries, root=root), errors
 
 

@@ -1,0 +1,155 @@
+"""Declared crash-consistency / boundary protocols — the shared fact
+base for pbslint's three interprocedural discipline rules
+(``durable-write-discipline``, ``ordering-discipline``,
+``typed-error-discipline``), for the runtime witness
+(``pbs_plus_tpu/utils/fswitness.py``, which carries its own copy of the
+runtime faces so the shipped package never imports ``tools/``), and for
+the human catalog ``docs/protocols.md``.
+
+Three declaration groups:
+
+- ``FAMILIES`` + ``DURABLE_MODULES``: the durability path families and
+  the modules that own them.  Inside a durable module, publishing to
+  disk happens ONLY through ``pbs_plus_tpu/utils/atomicio.py`` — a raw
+  ``os.replace``/``os.rename``/``os.link`` or a write-mode ``open`` is
+  a torn-write hazard the rule flags.
+
+- ``ORDERINGS``: keyed happens-before pairs.  Each has a static face
+  (call/fsop matchers the ordering rule anchors on, scoped to the
+  modules that own the protocol) and a runtime face (the
+  ``fswitness.note`` event names product code emits).
+
+- ``BOUNDARIES`` + ``TYPED_ERRORS``: wire/service surfaces must raise
+  from their declared typed taxonomy — a ``raise RuntimeError`` there
+  strands the caller with string-matching; the taxonomy classes
+  themselves are declared so the rule can fail when one is renamed
+  away.
+
+``registry-consistency`` keeps this module and ``docs/protocols.md`` in
+bidirectional sync (every declaration documented, nothing documented
+that is not declared), and a lint-battery test asserts the runtime
+faces here match ``fswitness``'s defaults verbatim.
+"""
+
+from __future__ import annotations
+
+ATOMICIO_MODULE = "pbs_plus_tpu/utils/atomicio.py"
+
+# modules owning durability families: every on-disk publish inside them
+# must go through atomicio (the witness module itself is the one place
+# allowed to touch the raw fs APIs)
+DURABLE_MODULES = (
+    "pbs_plus_tpu/pxar/chunkindex.py",
+    "pbs_plus_tpu/pxar/digestlog.py",
+    "pbs_plus_tpu/pxar/datastore.py",
+    "pbs_plus_tpu/pxar/syncwire.py",
+    "pbs_plus_tpu/pxar/transfer.py",
+    "pbs_plus_tpu/pxar/backupproxy.py",
+    "pbs_plus_tpu/parallel/dist_index.py",
+    "pbs_plus_tpu/server/checkpoint.py",
+)
+
+# durability path families.  ``runtime_re`` is the witness's path
+# classifier (fswitness.DEFAULT_FAMILIES mirrors these verbatim);
+# ``key`` must match the witness family key.
+FAMILIES = (
+    {"key": "chunk-file",
+     "runtime_re": r"/\.chunks/[0-9a-f]{4}/(?P<key>[0-9a-f]{64})$",
+     "doc": "chunk payloads under `<store>/.chunks/<hh hh>/<digest>`"},
+    {"key": "index-snapshot",
+     "runtime_re": r"/\.chunkindex/(?:proc-[^/]+/)?snapshot(?:-[^/]+)?$",
+     "doc": "dedup-index snapshots under `.chunkindex/`"},
+    {"key": "digestlog-segment",
+     "runtime_re": r"/\.chunkindex/(?:[^/]+/)*[0-9]+\.seg$",
+     "doc": "digestlog sorted segments (`<seq>.seg`)"},
+    {"key": "checkpoint",
+     "runtime_re": r"/\.ckpt/ck-[0-9]{8}(?:/|$)",
+     "doc": "backup checkpoints (`.ckpt/ck-<seq>/`)"},
+    {"key": "sync-state",
+     "runtime_re": r"/\.sync/[^/]+/state\.json$",
+     "doc": "sync job progress state (`.sync/<job>/state.json`)"},
+    {"key": "shard-map",
+     "runtime_re": r"\.shardmap$",
+     "doc": "distributed-index shard-map snapshots"},
+    {"key": "snapshot-manifest",
+     "runtime_re": r"/manifest\.json$",
+     "doc": "snapshot manifests"},
+)
+
+# keyed happens-before pairs.  Static face: "before"/"after" matchers
+# over the whole-program graph's per-function facts — "calls" entries
+# are regexes over recorded dotted call names, "fsops" entries name the
+# recorded fs operations (optionally filtered by "arg_exclude" over the
+# call's argument text).  Runtime face: fswitness event names.
+ORDERINGS = (
+    {"name": "discard-before-unlink",
+     "modules": ("pbs_plus_tpu/pxar/datastore.py",),
+     "before": {"calls": (r"(^|\.)discard_many_acked$",)},
+     "after": {"fsops": ("os.unlink", "os.remove")},
+     "runtime": {"before": "index.discard", "after": "chunk.unlink"},
+     "doc": "the dedup index acks a digest's discard before the chunk "
+            "file is unlinked — the failure direction stays a chunk on "
+            "disk the index forgot (re-stored idempotently), never an "
+            "index entry whose payload is gone"},
+    {"name": "tombstone-before-fingerprint",
+     "modules": ("pbs_plus_tpu/pxar/chunkindex.py",),
+     "before": {"calls": (r"(^|\.)_log\.discard$",)},
+     "after": {"calls": (r"(^|\.)_cuckoo\.discard_fp$",)},
+     "runtime": {"before": "digestlog.tombstone", "after": "filter.remove"},
+     "doc": "the digestlog tombstone lands before the cuckoo filter "
+            "fingerprint is dropped — a crash between the two leaves a "
+            "filter false positive (harmless probe), never a resurrected "
+            "digest"},
+    {"name": "map-install-before-retire",
+     "modules": ("pbs_plus_tpu/parallel/dist_index.py",),
+     "before": {"calls": (r"(^|\.)_install_map_on_all$",)},
+     "after": {"calls": (r"(^|\.)_retire_from_old$",)},
+     "runtime": {"before": "map.install", "after": "shard.retire"},
+     "doc": "rebalance installs the new shard map on every node before "
+            "any old-map shard is retired — a probe mid-rebalance routes "
+            "via some map that still answers"},
+    {"name": "mark-before-sweep",
+     "modules": ("pbs_plus_tpu/server/prune.py",),
+     "before": {"calls": (r"(^|\.)mark_live_chunks$",)},
+     "after": {"calls": (r"(^|\.)chunks\.sweep$",)},
+     "runtime": {"before": "gc.mark", "after": "gc.sweep"},
+     "doc": "GC phase 1 (atime mark of every live chunk) completes "
+            "before phase 2 sweeps — sweeping unmarked is live-chunk "
+            "loss"},
+)
+
+# wire/service boundaries and the typed taxonomy each must raise from.
+# "banned" raises inside the scoped modules are flagged unless the
+# raised name (or its recorded local base chain) lands in the taxonomy.
+BANNED_RAISES = ("Exception", "BaseException", "RuntimeError")
+
+BOUNDARIES = (
+    {"name": "syncwire",
+     "modules": ("pbs_plus_tpu/pxar/syncwire.py",),
+     "taxonomy": ("SyncError", "SyncWireError", "ValidationError")},
+    {"name": "dist-index",
+     "modules": ("pbs_plus_tpu/parallel/dist_index.py",
+                 "pbs_plus_tpu/server/services/distindex_service.py"),
+     "taxonomy": ("DistIndexError",)},
+    {"name": "fleet-services",
+     "modules": ("pbs_plus_tpu/server/fleetproc.py",
+                 "pbs_plus_tpu/server/services/prune_service.py"),
+     "taxonomy": ("GCLeaseHeldError", "PruneDeferredError",
+                  "QueueFullError")},
+    {"name": "web",
+     "modules": ("pbs_plus_tpu/server/web.py",),
+     "taxonomy": ("ValidationError", "QueueFullError")},
+)
+
+# taxonomy declarations: "path::ClassName" — typed-error-discipline
+# verifies each class still exists at its declared home, so renaming
+# one away fails the build instead of silently widening a boundary
+TYPED_ERRORS = (
+    "pbs_plus_tpu/pxar/syncwire.py::SyncError",
+    "pbs_plus_tpu/pxar/syncwire.py::SyncWireError",
+    "pbs_plus_tpu/parallel/dist_index.py::DistIndexError",
+    "pbs_plus_tpu/server/services/prune_service.py::GCLeaseHeldError",
+    "pbs_plus_tpu/server/services/prune_service.py::PruneDeferredError",
+    "pbs_plus_tpu/server/jobs.py::QueueFullError",
+    "pbs_plus_tpu/utils/validate.py::ValidationError",
+)

@@ -31,6 +31,9 @@ from .ingest_discipline import IngestDiscipline
 from .service_discipline import ServiceDiscipline
 from .span_discipline import SpanDiscipline
 from .sync_discipline import SyncDiscipline
+from .durable_write import DurableWriteDiscipline
+from .ordering_discipline import OrderingDiscipline
+from .typed_errors import TypedErrorDiscipline
 
 RULE_CLASSES = [
     NoSilentSwallow,
@@ -62,6 +65,9 @@ PROGRAM_RULE_CLASSES = [
     LockOrder,
     TransitiveBlockingInAsync,
     RegistryConsistency,
+    DurableWriteDiscipline,
+    OrderingDiscipline,
+    TypedErrorDiscipline,
 ]
 
 

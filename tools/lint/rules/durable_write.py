@@ -1,0 +1,98 @@
+"""durable-write-discipline — durable modules publish through atomicio.
+
+Invariant, whole-program: inside the modules that own durability path
+families (``tools/lint/protocols.py`` ``DURABLE_MODULES`` — chunk
+payloads, index snapshots, digestlog segments, checkpoints, sync state,
+shard maps, manifests), on-disk state is published ONLY through
+``pbs_plus_tpu/utils/atomicio.py``.  Two legs:
+
+- **direct**: a raw ``os.replace`` / ``os.rename`` / ``os.link`` /
+  ``shutil.move`` or a write-mode ``open`` in a durable module is a
+  torn-write hazard — a crash mid-write leaves a half-file under the
+  final name, and every one of these used to be a hand-rolled copy of
+  the tmp+rename idiom that drifted (some fsynced, some didn't; some
+  cleaned up their tmp on error, some leaked it).
+
+- **interprocedural**: calling OUT of a durable module into a helper
+  that performs the raw publish on the module's behalf is the same
+  hazard wearing a function call; raw-publisher-ness propagates up the
+  call graph (atomicio itself is the one sanctioned raw-fs user and
+  never taints its callers).
+
+Deletions (``os.unlink`` / ``os.remove``) are not publishes — the
+ordering rule owns those.  The runtime twin of this rule is
+``utils/fswitness.py``'s torn-write / non-staged-publish detection.
+"""
+
+from __future__ import annotations
+
+from .. import protocols
+from ..graph import Program, ProgramRule
+
+_RAW_PUBLISH = ("os.replace", "os.rename", "os.link", "shutil.move",
+                "open-write")
+
+
+class DurableWriteDiscipline(ProgramRule):
+    name = "durable-write-discipline"
+    invariant = ("durable modules publish on-disk state only through "
+                 "utils/atomicio.py — no raw rename/link or write-mode "
+                 "open, directly or through a helper")
+
+    def analyze(self, program: Program):
+        out = []
+        durable = {p for p in protocols.DURABLE_MODULES
+                   if p in program.files}
+        if not durable:
+            return out
+        raw = self._raw_publishers(program, durable)
+        for path in sorted(durable):
+            s = program.files[path]
+            for qual, fn in s.functions.items():
+                for op, line, arg in fn.get("fsops", ()):
+                    if op in _RAW_PUBLISH:
+                        what = "write-mode open" if op == "open-write" \
+                            else f"`{op}`"
+                        program.report(
+                            out, self, s.path, line,
+                            f"raw {what} ({arg or '...'}) in durable "
+                            f"module — publish through utils/atomicio.py "
+                            "(replace_bytes / atomic_write / staged_dir; "
+                            "docs/protocols.md)")
+                fid = f"{s.path}::{qual}"
+                for callee, line, _held in program.calls.get(fid, ()):
+                    if callee in raw:
+                        cs = program.func_file[callee]
+                        program.report(
+                            out, self, s.path, line,
+                            f"call into `{callee}` performs a raw "
+                            "rename/link/write publish on behalf of a "
+                            "durable module — route it through "
+                            "utils/atomicio.py (docs/protocols.md)")
+        return out
+
+    def _raw_publishers(self, program: Program,
+                        durable: "set[str]") -> "set[str]":
+        """fids outside the durable modules that (transitively) perform
+        a raw publish op.  atomicio is exempt (it IS the sanctioned
+        path) and durable-module functions are excluded — their own raw
+        ops are flagged directly, so an intra-module call must not
+        double-report."""
+        def exempt(fid: str) -> bool:
+            p = program.func_file[fid].path
+            return p == protocols.ATOMICIO_MODULE or p in durable
+
+        raw = {fid for fid, fn in program.funcs.items()
+               if not exempt(fid)
+               and any(op in _RAW_PUBLISH
+                       for op, _l, _a in fn.get("fsops", ()))}
+        changed = True
+        while changed:
+            changed = False
+            for fid, callees in program.calls.items():
+                if fid in raw or exempt(fid):
+                    continue
+                if any(c in raw for c, _l, _h in callees):
+                    raw.add(fid)
+                    changed = True
+        return raw

@@ -1,0 +1,131 @@
+"""ordering-discipline — declared happens-before pairs hold on every path.
+
+Invariant, whole-program: for each ordering declared in
+``tools/lint/protocols.py`` (index discard acked before the chunk
+file's unlink, digestlog tombstone before the filter fingerprint drop,
+shard map installed everywhere before any old shard retires, GC mark
+before sweep), every site matching the ordering's **after** matcher is
+dominated by a site matching its **before** matcher:
+
+- satisfied in-function when a before-site precedes it lexically; else
+- satisfied through the call graph when EVERY resolved caller performs
+  the before-event ahead of the call site, or is itself dominated the
+  same way (the ``guarded-by`` optimistic fixpoint, reused: assume all
+  functions dominated, demote until stable; a function with no resolved
+  callers is an entry point and never dominated).
+
+The lexical-order approximation is deliberate (same limit as the other
+program rules, docs/static-analysis.md): a before-site above an
+after-site in source counts even if control flow could skip it —
+pbslint stays an anti-hazard tripwire, not a model checker; the runtime
+witness (``utils/fswitness.py``) closes the gap by checking the same
+pairs, keyed per digest/url/store, on real executions in the chaos
+batteries.  After-sites with no pairing protocol (non-chunk debris
+reaping, a consume-once snapshot's unlink) carry inline disables with
+their rationale.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .. import protocols
+from ..graph import Program, ProgramRule
+
+
+def _matcher(spec: dict):
+    """Compile a before/after matcher spec into fn-record scanners."""
+    call_res = [re.compile(p) for p in spec.get("calls", ())]
+    fsops = set(spec.get("fsops", ()))
+    arg_excl = spec.get("arg_exclude")
+    arg_excl_re = re.compile(arg_excl) if arg_excl else None
+
+    def lines(fn: dict) -> "list[int]":
+        hits: list[int] = []
+        if call_res:
+            for name, line, _held in fn.get("calls", ()):
+                if any(r.search(name) for r in call_res):
+                    hits.append(line)
+        if fsops:
+            for op, line, arg in fn.get("fsops", ()):
+                if op in fsops and not (
+                        arg_excl_re and arg_excl_re.search(arg)):
+                    hits.append(line)
+        return hits
+    return lines
+
+
+class OrderingDiscipline(ProgramRule):
+    name = "ordering-discipline"
+    invariant = ("declared happens-before pairs (protocols.py: discard "
+                 "before unlink, tombstone before fingerprint drop, map "
+                 "install before retire, mark before sweep) dominate "
+                 "every after-site")
+
+    def analyze(self, program: Program):
+        out = []
+        for o in protocols.ORDERINGS:
+            scoped = [program.files[p] for p in o["modules"]
+                      if p in program.files]
+            if not scoped:
+                continue
+            before_of = _matcher(o["before"])
+            after_of = _matcher(o["after"])
+            # before-sites are collected program-wide: the caller-
+            # domination leg must see a before-event in a caller that
+            # lives OUTSIDE the ordering's own modules
+            before: dict[str, list[int]] = {}
+            for s in program.files.values():
+                for qual, fn in s.functions.items():
+                    hits = before_of(fn)
+                    if hits:
+                        before[f"{s.path}::{qual}"] = sorted(hits)
+            dominated = self._dominated(program, before)
+            for s in scoped:
+                for qual, fn in s.functions.items():
+                    fid = f"{s.path}::{qual}"
+                    bl = before.get(fid, ())
+                    for line in after_of(fn):
+                        if any(b < line for b in bl):
+                            continue
+                        if dominated.get(fid):
+                            continue
+                        program.report(
+                            out, self, s.path, line,
+                            f"`{o['name']}`: this site must be preceded "
+                            f"by {self._desc(o['before'])} on every "
+                            f"path — {o['doc']} (docs/protocols.md)")
+        return out
+
+    @staticmethod
+    def _desc(spec: dict) -> str:
+        bits = list(spec.get("calls", ())) + list(spec.get("fsops", ()))
+        return " / ".join(f"`{b}`" for b in bits)
+
+    def _dominated(self, program: Program,
+                   before: "dict[str, list[int]]") -> "dict[str, bool]":
+        """fid -> every path into the function passed a before-site
+        first.  Optimistic fixpoint: start all True, demote functions
+        with no resolved callers (entry points) or any caller whose
+        call site is neither preceded in-caller nor itself dominated."""
+        dominated = {fid: True for fid in program.funcs}
+        changed = True
+        while changed:
+            changed = False
+            for fid in program.funcs:
+                if not dominated[fid]:
+                    continue
+                callers = program.callers.get(fid, ())
+                ok = bool(callers)
+                for caller, line, _held in callers:
+                    bl = before.get(caller, ())
+                    if any(b < line for b in bl):
+                        continue
+                    if dominated.get(caller):
+                        continue
+                    ok = False
+                    break
+                if not ok:
+                    dominated[fid] = False
+                    changed = True
+        return dominated

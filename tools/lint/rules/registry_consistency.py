@@ -33,6 +33,7 @@ from __future__ import annotations
 import os
 import re
 
+from .. import protocols
 from ..graph import Program, ProgramRule
 
 CONF_SUFFIX = "utils/conf.py"
@@ -42,6 +43,8 @@ PRODUCT_PREFIX = "pbs_plus_tpu/"
 ENV_DOC = os.path.join("docs", "configuration.md")
 METRICS_DOC = os.path.join("docs", "metrics.md")
 SPAN_DOC = os.path.join("docs", "observability.md")
+PROTOCOLS_DOC = os.path.join("docs", "protocols.md")
+PROTOCOLS_PATH = "tools/lint/protocols.py"
 
 _METRIC_ROW_RE = re.compile(r"^\|\s*`(pbs_plus_[a-z0-9_]+)`")
 # span-table rows: backticked lowercase dotted-or-plain names that are
@@ -50,6 +53,10 @@ _SPAN_ROW_RE = re.compile(r"^\|\s*`([a-z][a-z0-9_.]*)`")
 # exact backticked occurrences only: a plain-text substring must not
 # count (PBS_PLUS_CHUNKER would otherwise ride on _CHUNKER_BACKEND's row)
 _ENV_DOC_RE = re.compile(r"`(PBS_PLUS_[A-Z0-9_]+)`")
+# docs/protocols.md catalog rows: kebab names in the first column
+# (family keys, ordering names, boundary names — never the CamelCase
+# taxonomy classes or dotted runtime event names)
+_PROTO_ROW_RE = re.compile(r"^\|\s*`([a-z][a-z0-9-]*)`")
 
 
 class RegistryConsistency(ProgramRule):
@@ -83,7 +90,52 @@ class RegistryConsistency(ProgramRule):
                        and s.path.startswith(PRODUCT_PREFIX)), None)
         if tracer is not None:
             self._check_spans(program, tracer, out)
+        if PROTOCOLS_PATH in program.files:
+            # protocols↔docs sync runs when the lint engine itself is
+            # in scope (the tools/lint leg of verify_lint.sh), so the
+            # findings land on a linted file
+            self._check_protocols(program, out)
         return out
+
+    # -- protocols ---------------------------------------------------------
+    def _check_protocols(self, program: Program, out) -> None:
+        """tools/lint/protocols.py ↔ docs/protocols.md, both ways:
+        every declared family/ordering/boundary/taxonomy entry is
+        documented, every catalog row in the doc is declared."""
+        doc = self._doc_text(program, PROTOCOLS_DOC)
+        if doc is None:
+            program.report(
+                out, self, PROTOCOLS_PATH, 1,
+                "docs/protocols.md is missing — every declared protocol "
+                "must be cataloged there")
+            return
+        declared = (
+            {f["key"] for f in protocols.FAMILIES}
+            | {o["name"] for o in protocols.ORDERINGS}
+            | {b["name"] for b in protocols.BOUNDARIES})
+        for name in sorted(declared):
+            if f"`{name}`" not in doc:
+                program.report(
+                    out, self, PROTOCOLS_PATH, 1,
+                    f"protocols.py declares `{name}` but "
+                    "docs/protocols.md does not catalog it")
+        for decl in protocols.TYPED_ERRORS:
+            cls = decl.partition("::")[2]
+            if f"`{cls}`" not in doc:
+                program.report(
+                    out, self, PROTOCOLS_PATH, 1,
+                    f"TYPED_ERRORS declares `{cls}` but "
+                    "docs/protocols.md does not catalog it")
+        doc_rows = set()
+        for line in doc.splitlines():
+            m = _PROTO_ROW_RE.match(line.strip())
+            if m:
+                doc_rows.add(m.group(1))
+        for name in sorted(doc_rows - declared):
+            program.report(
+                out, self, PROTOCOLS_PATH, 1,
+                f"docs/protocols.md catalogs `{name}` but protocols.py "
+                "declares no such family/ordering/boundary")
 
     # -- env ---------------------------------------------------------------
     def _check_env(self, program: Program, conf, out) -> None:

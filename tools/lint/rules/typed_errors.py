@@ -1,0 +1,81 @@
+"""typed-error-discipline — boundaries raise from their declared taxonomy.
+
+Invariant, whole-program: inside the wire/service boundary modules
+declared in ``tools/lint/protocols.py`` (``BOUNDARIES``: syncwire,
+dist-index, fleet services, web), ``raise Exception`` /
+``BaseException`` / ``RuntimeError`` is banned — an untyped raise
+strands every caller on string-matching (the repo already grew
+``except RuntimeError`` + message sniffing around two of them), and a
+boundary's failure modes are API surface.  Raise from the boundary's
+declared taxonomy instead.
+
+The taxonomy itself is closed both ways: every ``TYPED_ERRORS``
+declaration (``path::ClassName``) must still exist as a class in its
+declared module — renaming an error class away fails the build instead
+of silently widening a boundary — and every taxonomy name a boundary
+references must be declared, so the registry cannot drift into naming
+classes nobody audits.
+
+Re-raising a caught exception unchanged (bare ``raise``) and raising
+OTHER typed errors (``ValueError`` subclasses, ``OSError``) stay legal:
+the ban is on the three catch-all classes, not on exception use.
+"""
+
+from __future__ import annotations
+
+from .. import protocols
+from ..graph import Program, ProgramRule
+
+
+class TypedErrorDiscipline(ProgramRule):
+    name = "typed-error-discipline"
+    invariant = ("boundary modules (protocols.py BOUNDARIES) never "
+                 "raise bare Exception/BaseException/RuntimeError — "
+                 "they raise from their declared typed taxonomy, and "
+                 "every declared taxonomy class exists")
+
+    def analyze(self, program: Program):
+        out = []
+        declared: "set[str]" = set()
+        for decl in protocols.TYPED_ERRORS:
+            path, _, cls = decl.partition("::")
+            declared.add(cls)
+            s = program.files.get(path)
+            if s is not None and cls not in s.classes:
+                program.report(
+                    out, self, path, 1,
+                    f"protocols.py TYPED_ERRORS declares `{cls}` here "
+                    "but no such class exists — re-home the declaration "
+                    "or restore the class")
+        for b in protocols.BOUNDARIES:
+            for cls in b["taxonomy"]:
+                if cls not in declared:
+                    # anchor at the boundary's first present module so
+                    # the finding lands where someone will look
+                    for path in b["modules"]:
+                        if path in program.files:
+                            program.report(
+                                out, self, path, 1,
+                                f"boundary `{b['name']}` references "
+                                f"taxonomy class `{cls}` that "
+                                "protocols.py TYPED_ERRORS does not "
+                                "declare — add the declaration")
+                            break
+            for path in b["modules"]:
+                s = program.files.get(path)
+                if s is None:
+                    continue
+                for qual, fn in s.functions.items():
+                    for name, line, _cause in fn.get("raises", ()):
+                        base = name.rpartition(".")[2]
+                        if base in protocols.BANNED_RAISES:
+                            program.report(
+                                out, self, s.path, line,
+                                f"`raise {base}` at the `{b['name']}` "
+                                "boundary — callers can only string-"
+                                "match it; raise from the declared "
+                                "taxonomy ("
+                                + ", ".join(f"`{c}`"
+                                            for c in b["taxonomy"])
+                                + "; docs/protocols.md)")
+        return out

@@ -36,9 +36,19 @@ if [ -n "${PBSLINT_SARIF:-}" ]; then
 fi
 
 echo "== pbslint (per-file + whole-program: guarded-by, lock-order,"
-echo "   no-blocking-in-async-transitive, registry-consistency) =="
+echo "   no-blocking-in-async-transitive, registry-consistency,"
+echo "   durable-write/ordering/typed-error discipline) =="
 # shellcheck disable=SC2086
 python -m tools.lint $CHANGED pbs_plus_tpu
+
+# the declared-protocol rules again, alone and loud: a protocols.py or
+# docs/protocols.md drift fails HERE with only protocol findings in the
+# output, not buried in a full-tree run (docs/protocols.md)
+echo "== pbslint protocols leg (docs/protocols.md) =="
+# shellcheck disable=SC2086
+python -m tools.lint $CHANGED \
+    --rules durable-write-discipline,ordering-discipline,typed-error-discipline \
+    pbs_plus_tpu
 
 # lint the linter: the analysis suite holds itself to the same rules
 echo "== pbslint over tools/lint =="
