@@ -24,8 +24,10 @@ from .mux import MuxConnection, MuxStream, MuxError
 from .call import Request, Response, Session, STATUS_OK, STATUS_ERROR, STATUS_RAW_STREAM
 from .router import Router, HandlerError
 from .transport import connect_to_server, serve, TlsServerConfig, TlsClientConfig
-from .agents_manager import AdmissionRejected, AgentsManager, ClientSession
-from .binary_stream import send_data_from_reader, receive_data_into, MAX_FRAME
+from .agents_manager import (AdmissionDeadlineError, AdmissionRejected,
+                             AgentsManager, ClientSession)
+from .binary_stream import (send_data_from_reader, receive_data_into,
+                            MAX_FRAME, StreamLengthError)
 
 __all__ = [
     "MuxConnection", "MuxStream", "MuxError",
@@ -33,6 +35,8 @@ __all__ = [
     "STATUS_OK", "STATUS_ERROR", "STATUS_RAW_STREAM",
     "Router", "HandlerError",
     "connect_to_server", "serve", "TlsServerConfig", "TlsClientConfig",
-    "AdmissionRejected", "AgentsManager", "ClientSession",
+    "AdmissionDeadlineError", "AdmissionRejected", "AgentsManager",
+    "ClientSession",
     "send_data_from_reader", "receive_data_into", "MAX_FRAME",
+    "StreamLengthError",
 ]

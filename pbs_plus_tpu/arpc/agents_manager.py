@@ -35,8 +35,16 @@ _BUCKET_CAP = 8192
 
 # admitted-but-unregistered ceiling reservations expire after this long
 # (the transport handshake times out at 15s, so a reservation older than
-# this belongs to a connection that died before register())
+# this belongs to a connection that died before register() — including
+# a slowloris that admitted and then simply never registered)
 _ADMIT_RESERVATION_TTL_S = 20.0
+
+# deadline-admission wait queue bound (docs/fleet.md "Admission"): a
+# handshake arriving at the session ceiling with a deadline configured
+# waits here for capacity; past this many queued waiters the verdict is
+# an immediate typed reject (kind=admission_queue_full) — the wait queue
+# itself must hold the bounded-queue discipline it fronts for
+_ADMIT_QUEUE_CAP = 1024
 
 
 class AdmissionRejected(ConnectionError):
@@ -52,6 +60,18 @@ class AdmissionRejected(ConnectionError):
         self.code = code
         self.reason = reason
         self.kind = kind
+
+
+class AdmissionDeadlineError(AdmissionRejected):
+    """Deadline admission timed out: the handshake queued for capacity
+    (PBS_PLUS_ADMISSION_DEADLINE_MS) and its per-request deadline
+    expired before a session slot freed.  Subclasses AdmissionRejected
+    so transport.serve converts it into the same 503 wire rejection
+    frame; the distinct ``kind`` keeps deadline expiries countable apart
+    from queue-full and plain ceiling rejects."""
+
+    def __init__(self, reason: str, *, kind: str = "admission_deadline"):
+        super().__init__(503, reason, kind)
 
 
 def client_id_from(cn: str, headers: dict[str, str]) -> str:
@@ -101,7 +121,9 @@ class AgentsManager:
                  rate: float | None = None,
                  burst: int | None = None,
                  max_sessions: int | None = None,
-                 open_rate: float | None = None):
+                 open_rate: float | None = None,
+                 admission_deadline_ms: float | None = None,
+                 admit_queue_cap: int = _ADMIT_QUEUE_CAP):
         e = conf.env()
         self._sessions: dict[str, ClientSession] = {}
         self._expected_ids: set[str] = set()         # Expect() one-shots
@@ -128,15 +150,44 @@ class AgentsManager:
         # sail past it wholesale.  A reservation whose connection died
         # before register() expires after the handshake deadline.
         self._admit_reservations: deque[float] = deque()
+        # deadline admission (docs/fleet.md "Admission"): >0 turns the
+        # session-ceiling fast-fail into a bounded wait of at most this
+        # many seconds (per request) for capacity; the waiter queue is
+        # itself bounded at admit_queue_cap
+        deadline_ms = (e.admission_deadline_ms
+                       if admission_deadline_ms is None
+                       else admission_deadline_ms)
+        self.admission_deadline_s = max(0.0, deadline_ms / 1000.0)
+        self.admit_queue_cap = admit_queue_cap
+        self._admit_waiters: deque[asyncio.Future] = deque()
+        # reservation TTL sweep: reservations used to be reaped only
+        # lazily inside the NEXT admit() call, so a slowloris handshake
+        # (admit, then never register) pinned ceiling capacity until
+        # fresh traffic arrived.  A self-terminating sweeper task —
+        # spawned when reservations/waiters exist, exiting when both
+        # drain — reaps expired reservations on the idle-bucket prune
+        # cadence and wakes deadline waiters into the freed capacity.
+        self.reservation_ttl_s = _ADMIT_RESERVATION_TTL_S
+        self.reservations_reaped = 0
+        self._sweeper: asyncio.Task | None = None
+        # observability counters kept OUT of _admission_counts: that
+        # dict's non-"admitted" keys sum into admission_rejected, and
+        # neither a wait that later admitted nor a newest-wins eviction
+        # is a reject
+        self.admission_waits = 0      # deadline waiters ever queued
+        self.evictions = 0            # duplicate sessions evicted
         # cumulative admission verdicts, keyed by AdmissionRejected.kind
         # (plus "admitted") — rendered by server/metrics.py
         self._admission_counts: dict[str, int] = {"admitted": 0}
 
+    def _reject(self, exc: AdmissionRejected) -> AdmissionRejected:
+        self._admission_counts[exc.kind] = \
+            self._admission_counts.get(exc.kind, 0) + 1
+        return exc
+
     def _count_reject(self, code: int, reason: str,
                       kind: str) -> AdmissionRejected:
-        self._admission_counts[kind] = self._admission_counts.get(kind,
-                                                                  0) + 1
-        return AdmissionRejected(code, reason, kind)
+        return self._reject(AdmissionRejected(code, reason, kind))
 
     def admission_stats(self) -> dict[str, int]:
         """{"admitted": n, "<reject kind>": n, ...} — cumulative."""
@@ -189,13 +240,51 @@ class AgentsManager:
             # handshakes: registration happens awaits after this check,
             # so without the reservation a connect storm would overshoot
             # the ceiling by exactly the storm size
-            if len(self._sessions) + self._reservations(now) >= \
+            deadline = now + self.admission_deadline_s
+            while len(self._sessions) + self._reservations(now) >= \
                     self.max_sessions:
-                raise self._count_reject(
-                    503, f"session limit reached ({self.max_sessions})",
-                    "session_limit")
+                if self.admission_deadline_s <= 0:
+                    raise self._count_reject(
+                        503,
+                        f"session limit reached ({self.max_sessions})",
+                        "session_limit")
+                # deadline admission: queue (bounded) for capacity
+                # instead of fast-failing; the two reject flavors stay
+                # distinguishable by kind
+                if len(self._admit_waiters) >= self.admit_queue_cap:
+                    raise self._count_reject(
+                        503,
+                        f"admission wait queue full "
+                        f"({self.admit_queue_cap})",
+                        "admission_queue_full")
+                remaining = deadline - now
+                if remaining <= 0:
+                    raise self._reject(AdmissionDeadlineError(
+                        f"admission deadline "
+                        f"({self.admission_deadline_s:g}s) expired at "
+                        f"the session ceiling ({self.max_sessions})"))
+                fut: asyncio.Future = \
+                    asyncio.get_running_loop().create_future()
+                self._admit_waiters.append(fut)
+                self.admission_waits += 1
+                self._ensure_sweeper()
+                try:
+                    await asyncio.wait_for(fut, remaining)
+                except asyncio.TimeoutError:
+                    raise self._reject(AdmissionDeadlineError(
+                        f"admission deadline "
+                        f"({self.admission_deadline_s:g}s) expired at "
+                        f"the session ceiling ({self.max_sessions})")
+                    ) from None
+                finally:
+                    try:
+                        self._admit_waiters.remove(fut)
+                    except ValueError:
+                        pass        # already consumed by a wake
+                now = time.monotonic()
             self._admit_reservations.append(now)
             reserved = True
+            self._ensure_sweeper()
         try:
             if self._open_bucket is not None and \
                     not self._open_bucket.allow():
@@ -231,11 +320,67 @@ class AgentsManager:
 
     def _reservations(self, now: float) -> int:
         """Live admitted-but-unregistered count (expired ones belong to
-        connections that died between admit() and register())."""
+        connections that died between admit() and register() — or to a
+        slowloris that never intended to register)."""
         q = self._admit_reservations
-        while q and now - q[0] > _ADMIT_RESERVATION_TTL_S:
+        while q and now - q[0] > self.reservation_ttl_s:
             q.popleft()
+            self.reservations_reaped += 1
         return len(q)
+
+    def _wake_admit_waiters(self) -> None:
+        """Hand freed ceiling capacity to queued deadline waiters (FIFO).
+        A woken waiter re-checks the ceiling in its admit() loop, so an
+        overshoot here only costs one extra wait round, never a slot."""
+        if not self._admit_waiters:
+            return
+        now = time.monotonic()
+        free = (self.max_sessions - len(self._sessions)
+                - self._reservations(now))
+        while free > 0 and self._admit_waiters:
+            fut = self._admit_waiters.popleft()
+            if fut.done():
+                continue
+            fut.set_result(None)
+            free -= 1
+
+    def _ensure_sweeper(self) -> None:
+        """Spawn the reservation-TTL sweeper if pending state needs it.
+        Self-terminating: the task exits once no reservations or
+        deadline waiters remain, so an idle manager carries no task."""
+        if self._sweeper is not None and not self._sweeper.done():
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:        # constructed outside a loop
+            return
+        self._sweeper = loop.create_task(self._sweep_loop(),
+                                         name="admit-reservation-sweep")
+
+    async def _sweep_loop(self) -> None:
+        """Reap expired admit reservations WITHOUT fresh traffic, on the
+        same cadence family as the idle-bucket prune (which it also
+        piggybacks): a slowloris holding a reservation frees its ceiling
+        slot one TTL after admit even if no further admit() ever runs,
+        and any queued deadline waiters are woken into the freed
+        capacity."""
+        try:
+            while self._admit_reservations or self._admit_waiters:
+                now = time.monotonic()
+                if self._admit_reservations:
+                    wait = (self.reservation_ttl_s
+                            - (now - self._admit_reservations[0]))
+                else:
+                    wait = self.reservation_ttl_s
+                await asyncio.sleep(
+                    min(max(wait, 0.01), _BUCKET_PRUNE_INTERVAL_S))
+                now = time.monotonic()
+                self._reservations(now)         # reap expired heads
+                self._maybe_prune_buckets(now)  # piggybacked idle prune
+                if self.max_sessions > 0:
+                    self._wake_admit_waiters()
+        finally:
+            self._sweeper = None
 
     def expect(self, client_id: str) -> None:
         """Announce an upcoming job session (reference: Expect(streamID),
@@ -261,6 +406,7 @@ class AgentsManager:
             waiters = self._waiters.pop(cid, [])
         if old is not None and not old.conn.closed:
             L.info("evicting duplicate session", )
+            self.evictions += 1
             await old.conn.close()       # duplicate eviction: newest wins
         for f in waiters:
             if not f.done():
@@ -276,6 +422,10 @@ class AgentsManager:
         for f in watchers:
             if not f.done():
                 f.set_result(sess)
+        if self.max_sessions > 0:
+            # a departing session is freed ceiling capacity: hand it to
+            # queued deadline waiters immediately, not at the next sweep
+            self._wake_admit_waiters()
 
     def watch_disconnect(self, sess: ClientSession) -> asyncio.Future:
         """Future resolved when this exact session unregisters (its

@@ -20,6 +20,20 @@ MAX_FRAME = conf.MAX_FRAME_SIZE            # 1 GiB
 _IO_CHUNK = 1 << 20
 
 
+class StreamLengthError(MuxError):
+    """Declared-vs-actual length violation on a framed binary transfer:
+    the header promised ``declared`` bytes but the stream delivered (or
+    the reader produced) only ``actual`` before EOF.  Receive-side
+    violations are counted in the per-connection
+    ``stats["stream_length_violations"]`` — a peer lying about stream
+    lengths is an abuse signal, not a generic transport hiccup."""
+
+    def __init__(self, msg: str, *, declared: int, actual: int):
+        super().__init__(msg)
+        self.declared = declared
+        self.actual = actual
+
+
 async def send_data_from_reader(stream: MuxStream, reader,
                                 total_len: int) -> int:
     """Send exactly ``total_len`` bytes read from ``reader`` (object with
@@ -31,7 +45,9 @@ async def send_data_from_reader(stream: MuxStream, reader,
     if isinstance(reader, (bytes, bytearray, memoryview)):
         data = memoryview(reader)[:total_len]
         if len(data) < total_len:
-            raise MuxError("reader shorter than declared length")
+            raise StreamLengthError(
+                f"reader holds {len(data)} bytes of declared {total_len}",
+                declared=total_len, actual=len(data))
         sent = 0
         while sent < total_len:
             n = min(_IO_CHUNK, total_len - sent)
@@ -42,7 +58,9 @@ async def send_data_from_reader(stream: MuxStream, reader,
     while sent < total_len:
         block = reader.read(min(_IO_CHUNK, total_len - sent))
         if not block:
-            raise MuxError(f"reader EOF at {sent}/{total_len}")
+            raise StreamLengthError(
+                f"reader EOF at {sent}/{total_len}",
+                declared=total_len, actual=sent)
         await stream.write(block)
         sent += len(block)
     return sent
@@ -69,7 +87,14 @@ async def receive_data_into(stream: MuxStream,
     while got < length:
         block = await stream.read(min(_IO_CHUNK, length - got))
         if not block:
-            raise MuxError(f"stream EOF at {got}/{length}")
+            # declared-vs-actual accounting: the sender promised
+            # ``length`` bytes and FINed early — a lying peer, counted
+            # per connection so fleet soaks can assert the abuse was
+            # SEEN, not just survived
+            stream.conn.stats["stream_length_violations"] += 1
+            raise StreamLengthError(
+                f"stream EOF at {got}/{length}",
+                declared=length, actual=got)
         take = max(0, min(len(block), keep - got))
         if take:
             if isinstance(sink, bytearray):

@@ -231,7 +231,8 @@ class MuxConnection:
         self.stats = {"frames_tx": 0, "frames_rx": 0,
                       "bytes_tx": 0, "bytes_rx": 0,
                       "write_deadline_sheds": 0, "syn_rejects": 0,
-                      "flow_violations": 0}
+                      "flow_violations": 0,
+                      "stream_length_violations": 0}
 
     def start(self) -> None:
         self._tasks.append(asyncio.create_task(self._read_loop()))

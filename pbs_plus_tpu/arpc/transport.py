@@ -179,6 +179,11 @@ async def serve(host: str, port: int, tls: TlsServerConfig | None, *,
                                                 "reason": reason})
                     writer.close()
                     return
+            # between admit() (ceiling reservation held) and register()
+            # (reservation consumed): a peer that dies inside this window
+            # strands its reservation until the TTL sweep — the site lets
+            # chaos runs widen the window deterministically
+            await failpoints.ahit("arpc.handshake.accept")
             await _write_frame(writer, {"ok": True})
             conn = MuxConnection(reader, writer, is_client=False,
                                  keepalive_s=keepalive_s,

@@ -233,6 +233,14 @@ _MIGRATIONS: list[str] = [
         sweeping INTEGER NOT NULL DEFAULT 1
     );
     """,
+    # 010 — weighted-fair tenant shares (docs/fleet.md "Fairness"):
+    # Job.weight rides the shared queue row like kind/tenant, so every
+    # process sharing this database sees the same fair-share input the
+    # enqueuing process used (the DB-plumbed half of the weight pair;
+    # PBS_PLUS_TENANT_WEIGHTS is the operator override).
+    """
+    ALTER TABLE job_queue ADD COLUMN weight INTEGER NOT NULL DEFAULT 1;
+    """,
 ]
 
 
@@ -768,7 +776,8 @@ class Database:
     # bound and the queue's observability, not the grant order.
 
     def queue_admit(self, job_id: str, kind: str, tenant: str,
-                    owner: str, *, max_queued: int = 0) -> str:
+                    owner: str, *, max_queued: int = 0,
+                    weight: int = 1) -> str:
         """Admit ``job_id`` into the shared queue.  Returns
         ``"admitted"``, ``"full"`` (DB-wide 'queued' count at
         ``max_queued`` — the caller raises the typed QueueFullError),
@@ -802,12 +811,14 @@ class Database:
                         return "full"
                 self._conn.execute(
                     """INSERT INTO job_queue (id,kind,tenant,owner,status,
-                       enqueued_at) VALUES (?,?,?,?, 'queued', ?)
+                       enqueued_at,weight) VALUES (?,?,?,?, 'queued', ?,?)
                        ON CONFLICT(id) DO UPDATE SET kind=excluded.kind,
                          tenant=excluded.tenant, owner=excluded.owner,
                          status='queued', enqueued_at=excluded.enqueued_at,
-                         started_at=NULL, finished_at=NULL, error=''""",
-                    (job_id, kind, tenant, owner, time.time()))
+                         started_at=NULL, finished_at=NULL, error='',
+                         weight=excluded.weight""",
+                    (job_id, kind, tenant, owner, time.time(),
+                     max(1, int(weight))))
                 self._conn.execute("COMMIT")
             except BaseException:
                 try:

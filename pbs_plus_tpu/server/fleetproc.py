@@ -10,6 +10,12 @@ stderr, so stdout stays a clean event stream):
     stdin commands                  stdout events
     ------------------------------  ---------------------------------
     {"cmd":"backup","cn","job_id"}  {"event":"done","job_id","ok",...}
+    {"cmd":"restore","cn","job_id"} {"event":"done",...,"tree_hash"}
+    {"cmd":"verify","cn","job_id"}  {"event":"done",...,"checked"}
+    {"cmd":"sync","job_id",
+     "mirror_dir"}                  {"event":"done",...,"chunks"}
+    {"cmd":"fair_probe","tenants"}  {"event":"fair_probe","order"}
+    {"cmd":"failpoint","site",...}  {"event":"failpoint","armed"}
     {"cmd":"gc","grace","slow"}     {"event":"gc_running"} →
                                     {"event":"gc_started"} (lease won)
                                     → {"event":"gc_result","outcome"}
@@ -18,6 +24,15 @@ stderr, so stdout stays a clean event stream):
     {"cmd":"metrics"}               {"event":"metrics",...}
     {"cmd":"exit"}                  {"event":"bye"}
                                     {"event":"ready","port","pid"}
+
+Mixed-traffic lanes (ISSUE 19): ``restore``/``verify``/``sync`` ride
+the same shared bounded queue and fairness lanes as ``backup`` and all
+answer with a ``done`` event, so the driver can interleave every kind
+in one choreography and consume one ``done`` per submitted job.
+``fair_probe`` is the deterministic weighted-fair witness (plug the
+slots, backlog K jobs per tenant, report the contended grant order);
+``failpoint`` arms/disarms a named site (the slowloris admit→register
+window) inside THIS process.
 
 This module is the multiproc worker's COMPOSITION ROOT (the second of
 the two modules pbslint's ``service-discipline`` rule allows to
@@ -44,6 +59,14 @@ import time
 
 from ..utils import trace
 from ..utils.log import L
+
+
+class FleetLaneError(Exception):
+    """A mixed-traffic lane (restore read-back, verify spot-check)
+    failed its own invariant — a missing published snapshot or detected
+    corruption.  Part of the `fleet-services` typed taxonomy so the
+    driver's `done` events carry a matchable name instead of a bare
+    RuntimeError string (docs/protocols.md)."""
 
 
 def _emit(obj: dict) -> None:
@@ -75,7 +98,9 @@ class Worker:
             n_agents=args.max_agents, chunk_avg=args.chunk_avg,
             max_concurrent=args.max_concurrent,
             max_queued=args.max_queued,
-            mux_write_deadline_s=args.write_deadline)
+            mux_write_deadline_s=args.write_deadline,
+            admission_deadline_ms=args.admission_deadline_ms,
+            reservation_ttl_s=args.reservation_ttl)
         # composition (the store.py pattern, minus TLS/web): job queue
         # first, its JobsManager injected into the data plane, prune
         # last — cross-service needs as narrow late-bound callables
@@ -83,7 +108,9 @@ class Worker:
             db=self.db,
             gc_active=lambda: self.prune.fleet_gc_active(),
             max_concurrent=args.max_concurrent,
-            max_queued=args.max_queued, owner=self.proc_id)
+            max_queued=args.max_queued, owner=self.proc_id,
+            tenant_weights=(conf.parse_tenant_weights(args.tenant_weights)
+                            if args.tenant_weights else None))
         self.server = FleetServer(args.datastore, cfg,
                                   jobs=self.job_queue.jobs,
                                   shared_instance=self.proc_id)
@@ -125,6 +152,7 @@ class Worker:
         from .jobs import Job, QueueFullError
         cn, job_id = msg["cn"], msg["job_id"]
         tenant = msg.get("tenant", cn)
+        weight = max(1, int(msg.get("weight", 1)))
 
         result_box: dict = {}
 
@@ -157,11 +185,199 @@ class Worker:
         try:
             self.job_queue.submit(Job(
                 id=f"backup:{cn}:{job_id}", kind="backup", tenant=tenant,
+                weight=weight, execute=execute, on_success=on_success,
+                on_error=on_error))
+        except QueueFullError as e:
+            _emit({"event": "done", "job_id": job_id, "ok": False,
+                   "error": f"QueueFullError: {e}"})
+
+    # -- mixed-traffic lanes (ISSUE 19): restore read-back, verify ---------
+    # spot-check and replication ride the SAME shared bounded queue and
+    # fairness lanes as the backups; every lane answers with a `done`
+    # event so the driver can interleave all kinds in one choreography
+    def _latest_ref(self, cn: str):
+        ds = self.server.store.datastore
+        refs = [r for r in ds.list_snapshots(all_namespaces=True)
+                if r.backup_id == cn]
+        if not refs:
+            raise FleetLaneError(f"no published snapshot for {cn}")
+        return max(refs, key=lambda r: r.backup_time)
+
+    def cmd_restore(self, msg: dict) -> None:
+        from .jobs import Job, QueueFullError
+        cn, job_id = msg["cn"], msg["job_id"]
+        box: dict = {}
+
+        async def execute():
+            import hashlib
+
+            from ..pxar.transfer import SplitReader
+            ds = self.server.store.datastore
+            ref = self._latest_ref(cn)
+
+            def _read_back():
+                reader = SplitReader.open_snapshot(ds, ref)
+                files = []
+                for entry in reader.entries():
+                    if entry.is_file:
+                        files.append((entry.path.lstrip("/"),
+                                      reader.read_file(entry)))
+                h = hashlib.sha256()
+                for rel, data in sorted(files):
+                    h.update(rel.encode() + b"\0" + data + b"\0")
+                return len(files), h.hexdigest()
+
+            n, tree_hash = await asyncio.get_running_loop() \
+                .run_in_executor(None, trace.wrap(_read_back))
+            box["n"], box["hash"] = n, tree_hash
+
+        async def on_success():
+            _emit({"event": "done", "job_id": job_id, "ok": True,
+                   "entries": box["n"], "tree_hash": box["hash"]})
+
+        async def on_error(exc: BaseException):
+            _emit({"event": "done", "job_id": job_id, "ok": False,
+                   "error": f"{type(exc).__name__}: {exc}"})
+
+        try:
+            self.job_queue.submit(Job(
+                id=f"restore:{job_id}", kind="restore", tenant="restore",
                 execute=execute, on_success=on_success,
                 on_error=on_error))
         except QueueFullError as e:
             _emit({"event": "done", "job_id": job_id, "ok": False,
                    "error": f"QueueFullError: {e}"})
+
+    def cmd_verify(self, msg: dict) -> None:
+        from .jobs import Job, QueueFullError
+        cn, job_id = msg["cn"], msg["job_id"]
+        seed = int(msg.get("seed", 0))
+        box: dict = {}
+
+        async def execute():
+            import numpy as np
+
+            from ..models.verify import VerifyPipeline
+            from ..pxar.transfer import SplitReader
+            ds = self.server.store.datastore
+            ref = self._latest_ref(cn)
+
+            def _spot_check():
+                reader = SplitReader.open_snapshot(ds, ref)
+                return VerifyPipeline().verify_snapshot(
+                    reader, sample_rate=1.0,
+                    rng=np.random.default_rng(seed))
+
+            res = await asyncio.get_running_loop().run_in_executor(
+                None, trace.wrap(_spot_check))
+            if not res.ok:
+                raise FleetLaneError(
+                    f"verify found corruption: {res.corrupt_paths}")
+            box["checked"] = res.checked
+
+        async def on_success():
+            _emit({"event": "done", "job_id": job_id, "ok": True,
+                   "checked": box["checked"]})
+
+        async def on_error(exc: BaseException):
+            _emit({"event": "done", "job_id": job_id, "ok": False,
+                   "error": f"{type(exc).__name__}: {exc}"})
+
+        try:
+            self.job_queue.submit(Job(
+                id=f"verify:{job_id}", kind="verify", tenant="verify",
+                execute=execute, on_success=on_success,
+                on_error=on_error))
+        except QueueFullError as e:
+            _emit({"event": "done", "job_id": job_id, "ok": False,
+                   "error": f"QueueFullError: {e}"})
+
+    def cmd_sync(self, msg: dict) -> None:
+        from .jobs import Job, QueueFullError
+        job_id, mirror_dir = msg["job_id"], msg["mirror_dir"]
+        box: dict = {}
+
+        async def execute():
+            from ..pxar.datastore import Datastore
+            from ..pxar.syncwire import (LocalSyncDest, LocalSyncSource,
+                                         run_sync)
+            box["res"] = await asyncio.get_running_loop().run_in_executor(
+                None, trace.wrap(lambda: run_sync(
+                    LocalSyncSource(self.server.store.datastore),
+                    LocalSyncDest(Datastore(mirror_dir)),
+                    job_id=job_id, state_root=mirror_dir)))
+
+        async def on_success():
+            res = box["res"]
+            _emit({"event": "done", "job_id": job_id, "ok": True,
+                   "chunks": res["chunks_transferred"],
+                   "bytes_wire": res["bytes_wire"]})
+
+        async def on_error(exc: BaseException):
+            _emit({"event": "done", "job_id": job_id, "ok": False,
+                   "error": f"{type(exc).__name__}: {exc}"})
+
+        try:
+            self.job_queue.submit(Job(
+                id=f"sync:{job_id}", kind="sync", tenant="sync",
+                execute=execute, on_success=on_success,
+                on_error=on_error))
+        except QueueFullError as e:
+            _emit({"event": "done", "job_id": job_id, "ok": False,
+                   "error": f"QueueFullError: {e}"})
+
+    async def cmd_fair_probe(self, msg: dict) -> None:
+        """Deterministic DRR measurement (docs/fleet.md "Fairness"):
+        plug every execution slot, enqueue K jobs per tenant carrying
+        the requested weights, release the plugs, and report the order
+        in which the backlogged tenants won slot grants.  Every grant
+        in that order is CONTENDED, so its all-backlogged prefix must
+        split ∝ the weights (±10% — the driver's assertion)."""
+        from .jobs import Job
+        jobs = self.job_queue.jobs
+        tenants: dict = msg.get("tenants", {})
+        k = int(msg.get("jobs_per_tenant", 12))
+        release = asyncio.Event()
+        n_plugs = jobs.max_concurrent
+
+        async def plug():
+            await release.wait()
+
+        for p in range(n_plugs):
+            jobs.enqueue(Job(id=f"fairprobe:plug:{p}", kind="probe",
+                             tenant="fairprobe-plug", execute=plug))
+        while jobs.running_count < n_plugs:
+            await asyncio.sleep(0)
+        order: list = []
+        total = len(tenants) * k
+        all_done = asyncio.Event()
+
+        async def granted(t: str):
+            order.append(t)
+            if len(order) >= total:
+                all_done.set()
+
+        for t, wgt in sorted(tenants.items()):
+            for j in range(k):
+                jobs.enqueue(Job(id=f"fairprobe:{t}:{j}", kind="probe",
+                                 tenant=t, weight=max(1, int(wgt)),
+                                 execute=(lambda t=t: granted(t))))
+        release.set()
+        await asyncio.wait_for(all_done.wait(), 60)
+        _emit({"event": "fair_probe", "order": order})
+
+    def cmd_failpoint(self, msg: dict) -> None:
+        from ..utils import failpoints
+        site = msg["site"]
+        if msg.get("disarm"):
+            failpoints.disarm(site)
+        else:
+            kw = {}
+            if msg.get("arg") is not None:
+                kw["arg"] = msg["arg"]
+            failpoints.arm(site, msg["action"], **kw)
+        _emit({"event": "failpoint", "site": site,
+               "armed": not msg.get("disarm", False)})
 
     async def cmd_gc(self, msg: dict) -> None:
         from ..utils import failpoints
@@ -249,6 +465,7 @@ class Worker:
                   "count": h.snapshot().get(
                       (("service", svc),), {}).get("count", 0)}
             for svc in ("prune", "jobqueue")}
+        eh = _metrics.HISTOGRAMS["pbs_plus_job_enqueue_to_publish_seconds"]
         _emit({
             "event": "metrics",
             "proc": self.proc_id,
@@ -257,8 +474,19 @@ class Worker:
             "dedup_index": _chunkindex.metrics_snapshot(),
             "dist_index": self.dist_index.stats(),
             "jobs": dict(self.job_queue.jobs.stats),
+            "tenant_grants": dict(self.job_queue.jobs.tenant_grants),
             "queue_counts": self.db.queue_counts(),
             "admission": self.db.admission_counters(),
+            "admission_extra": {
+                "reservations_reaped":
+                    self.server.agents.reservations_reaped,
+                "evictions": self.server.agents.evictions,
+                "admission_waits": self.server.agents.admission_waits,
+            },
+            "enqueue_to_publish": {
+                "p50": eh.quantile(0.50, {"kind": "backup"}),
+                "p99": eh.quantile(0.99, {"kind": "backup"}),
+            },
             "mux": self.server.mux_stats(),
             "service_lock_wait": lock_wait,
         })
@@ -280,6 +508,17 @@ class Worker:
             cmd = msg.get("cmd", "")
             if cmd == "backup":
                 self.cmd_backup(msg)
+            elif cmd == "restore":
+                self.cmd_restore(msg)
+            elif cmd == "verify":
+                self.cmd_verify(msg)
+            elif cmd == "sync":
+                self.cmd_sync(msg)
+            elif cmd == "fair_probe":
+                self._bg.append(
+                    asyncio.create_task(self.cmd_fair_probe(msg)))
+            elif cmd == "failpoint":
+                self.cmd_failpoint(msg)
             elif cmd == "gc":
                 self._bg.append(asyncio.create_task(self.cmd_gc(msg)))
             elif cmd == "drop_group":
@@ -315,6 +554,15 @@ def main(argv=None) -> None:
     ap.add_argument("--max-concurrent", type=int, default=4)
     ap.add_argument("--max-queued", type=int, default=512)
     ap.add_argument("--write-deadline", type=float, default=60.0)
+    ap.add_argument("--tenant-weights", default="",
+                    help="fair-share weights 'tenant=w,...' "
+                         "(PBS_PLUS_TENANT_WEIGHTS form; empty = 1x)")
+    ap.add_argument("--admission-deadline-ms", type=float, default=0.0,
+                    help="bounded admission wait at the session "
+                         "ceiling (0 = fast-fail 503)")
+    ap.add_argument("--reservation-ttl", type=float, default=0.0,
+                    help="admission reservation TTL override in "
+                         "seconds (0 = default)")
     ap.add_argument("--dist-index", default="",
                     help="distributed index shard spec "
                          "(s0=host:port,...); empty = local index")

@@ -27,6 +27,8 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import socket
+import struct
 import sys
 import time
 from dataclasses import dataclass, field
@@ -35,14 +37,17 @@ from typing import Callable, Optional
 from ..agent.agentfs import AgentFSClient
 from ..arpc import Router, Session, connect_to_server, serve
 from ..arpc.agents_manager import AgentsManager
-from ..arpc.binary_stream import send_data_from_reader
+from ..arpc.binary_stream import (_HDR as _BIN_HDR, MAGIC as _BIN_MAGIC,
+                                  VERSION as _BIN_VERSION,
+                                  send_data_from_reader)
 from ..arpc.call import RawStreamHandler
 from ..arpc.mux import MuxConnection
 from ..arpc.router import HandlerError
-from ..arpc.transport import HDR_LOOPBACK_CN, HandshakeError
+from ..arpc.transport import (_LEN as _HS_LEN, HANDSHAKE_MAGIC,
+                              HDR_LOOPBACK_CN, HandshakeError)
 from ..chunker import ChunkerParams
 from ..pxar.backupproxy import LocalStore
-from ..utils import conf, trace
+from ..utils import codec, conf, failpoints, trace
 from ..utils.log import L
 from . import checkpoint, metrics
 from .backup_job import RemoteTreeBackup
@@ -105,6 +110,35 @@ class FleetConfig:
     hostile_agents: int = 0
     hostile_echo_calls: int = 400
     hostile_echo_bytes: int = 64 << 10
+    # hostile profile spec (ISSUE 19, docs/fleet.md "Hostile clients"):
+    # "" keeps the classic flood+slow_reader pair per hostile agent;
+    # otherwise a comma list from {flood, slow_reader, reconnect_storm,
+    # length_liar, slowloris} assigned round-robin across hostile_agents
+    hostile_profiles: str = ""
+    hostile_reconnects: int = 6          # redials per reconnect_storm
+    hostile_slowloris_rounds: int = 3    # stranded reservations per loris
+    hostile_lie_bytes: int = 512         # declared-vs-actual shortfall
+    # weighted-fair shares + deadline admission (ISSUE 19): a
+    # "tenant=weight,..." spec plumbed into JobsManager exactly like
+    # PBS_PLUS_TENANT_WEIGHTS; admission_deadline_ms > 0 turns the
+    # session-ceiling fast-fail into a bounded deadline wait
+    # (PBS_PLUS_ADMISSION_DEADLINE_MS semantics); reservation_ttl_s > 0
+    # shrinks the admit-reservation TTL so a slowloris strand is reaped
+    # within the soak instead of 20s later
+    tenant_weights: str = ""
+    admission_deadline_ms: float = 0.0
+    reservation_ttl_s: float = 0.0
+    # fleet-survival mixed traffic (ISSUE 19 tentpole): each agent runs
+    # jobs_per_agent sequential backups (chained on publish — two live
+    # sessions into one snapshot group would race the publish); a seeded
+    # churn_fraction of agents drops + redials its control transport
+    # between waves (keepalive churn racing newest-wins eviction); the
+    # first restore_jobs/verify_jobs agents get a read-back restore /
+    # spot-check verify lane through the SAME execution slots
+    jobs_per_agent: int = 1
+    churn_fraction: float = 0.0
+    restore_jobs: int = 0
+    verify_jobs: int = 0
 
 
 def has_checkpoint(store: LocalStore, cn: str) -> bool:
@@ -136,8 +170,14 @@ class SyntheticFS:
     wire protocol as agent/agentfs.AgentFSServer (attr/read_dir/open/
     read_at raw-stream/close), no disk."""
 
-    def __init__(self, tree: dict[str, bytes], *, on_read=None):
+    def __init__(self, tree: dict[str, bytes], *, on_read=None,
+                 lie_bytes: int = 0):
         self.tree = dict(tree)
+        # length-liar hostile profile: > 0 makes every read_at stream
+        # DECLARE the full length and FIN lie_bytes short — the server's
+        # receive path must refuse the transfer with a typed
+        # StreamLengthError and count the violation per connection
+        self.lie_bytes = lie_bytes
         self._dirs: dict[str, list[str]] = {"": []}
         for rel in self.tree:
             parts = rel.split("/")
@@ -219,9 +259,20 @@ class SyntheticFS:
             await self._on_read(self)
         off, n = int(req.payload["off"]), int(req.payload["n"])
         data = self.tree[rel][off:off + n]
+        lie = min(self.lie_bytes, len(data)) if self.lie_bytes > 0 else 0
 
         async def pump(stream):
-            await send_data_from_reader(stream, data, len(data))
+            if lie:
+                # the lying pump: header promises len(data), the stream
+                # FINs short — a clean half-close, so the receiver sees
+                # EOF (declared > actual), not a transport error
+                await stream.write(_BIN_HDR.pack(_BIN_MAGIC, _BIN_VERSION,
+                                                 len(data)))
+                short = data[:len(data) - lie]
+                if short:
+                    await stream.write(short)
+            else:
+                await send_data_from_reader(stream, data, len(data))
         return RawStreamHandler(pump, data={"n": len(data)})
 
     async def _close(self, req, ctx):
@@ -237,10 +288,12 @@ class SimAgent:
                  tree: dict[str, bytes], *, die_after_reads: int = 0,
                  crash_gate: Callable[[], bool] | None = None,
                  connect_attempts: int = 25,
-                 write_deadline_s: float | None = None):
+                 write_deadline_s: float | None = None,
+                 lie_bytes: int = 0):
         self.cn = cn
         self.host, self.port = host, port
         self.tree = tree
+        self.lie_bytes = lie_bytes               # length-liar FS profile
         self.die_after_reads = die_after_reads   # 0 = never
         # structural chaos sync: a doomed agent crashes on the first read
         # ≥ die_after_reads for which this predicate holds (the driver
@@ -305,7 +358,8 @@ class SimAgent:
                 return {"ok": True, "already": True}
             jconn = await self._dial({HDR_LOOPBACK_CN: self.cn,
                                       HDR_BACKUP_ID: job_id})
-            fs = SyntheticFS(self.tree, on_read=self._maybe_crash)
+            fs = SyntheticFS(self.tree, on_read=self._maybe_crash,
+                             lie_bytes=self.lie_bytes)
             job_router = Router()
             fs.register(job_router)
             task = asyncio.create_task(job_router.serve_connection(jconn),
@@ -335,6 +389,20 @@ class SimAgent:
             self.crash()
             raise ConnectionResetError(
                 f"simulated agent {self.cn} crashed mid-backup")
+
+    async def churn(self) -> None:
+        """Keepalive churn: abort the control transport (no FIN — the
+        server learns of the death from its disconnect watch or from
+        newest-wins eviction when the replacement registers) and redial
+        immediately.  The agent stays usable for its next job wave."""
+        if self._serve_task is not None:
+            self._serve_task.cancel()
+        if self.conn is not None:
+            try:
+                self.conn.writer.transport.abort()
+            except Exception as e:          # already-dead transport
+                L.debug("sim churn abort: %s", e)
+        await self.start()
 
     def crash(self) -> None:
         """Simulated process death: abort every transport (no FIN, no
@@ -385,18 +453,60 @@ class HostileAgent(SimAgent):
     Runs concurrently with the legit backup round; the soak asserts
     both counters fired server-side AND every legit agent still
     published.
+
+    ISSUE 19 adds three meaner profiles, selected per agent via
+    ``profile`` (default ""/classic keeps the original pair):
+
+    3. **reconnect-storm** (``reconnect_storm``): redials the SAME CN
+       while the previous control connection is still open — every
+       register must deterministically evict the predecessor
+       (newest-wins; ``AgentsManager.evictions`` counted) and the storm
+       ends with exactly one live session, never a leak.
+    4. **stream-length liar** (``length_liar``): no connection abuse —
+       the agent's agentfs serves a LYING pump (declared length >
+       actual, clean FIN).  The driver runs its backup through a
+       separate accounting lane; the server must refuse it with a typed
+       ``StreamLengthError`` and count ``stream_length_violations``.
+    5. **slowloris handshake** (``slowloris``): sends a bare handshake
+       hello and dies before the server's ok frame (the
+       ``arpc.handshake.accept`` delay failpoint holds the window
+       open), stranding an admission reservation per round — reaped by
+       the TTL sweep (``reservations_reaped``), never leaked.
     """
 
-    async def run_attacks(self, *, echo_calls: int,
-                          echo_bytes: int) -> None:
+    def __init__(self, *args, profile: str = "", **kw):
+        super().__init__(*args, **kw)
+        self.profile = profile
+
+    async def run_attacks(self, *, echo_calls: int, echo_bytes: int,
+                          reconnects: int = 6,
+                          slowloris_rounds: int = 3) -> None:
+        kill_conns = True
         try:
-            await self._attack_flow_violation()
-            await asyncio.sleep(0.05)
-            await self._attack_slow_reader(echo_calls, echo_bytes)
+            if self.profile == "flood":
+                await self._attack_flow_violation()
+            elif self.profile == "slow_reader":
+                await self._attack_slow_reader(echo_calls, echo_bytes)
+            elif self.profile == "reconnect_storm":
+                await self._attack_reconnect_storm(reconnects)
+                kill_conns = False      # ends with one LIVE session
+            elif self.profile == "slowloris":
+                await self._attack_slowloris(slowloris_rounds)
+                kill_conns = False      # control session never abused
+            elif self.profile == "length_liar":
+                # the lying happens in the backup lane the driver
+                # submits for this agent — the control session must
+                # stay up to serve it
+                return
+            else:               # classic: the original PR 7 pair
+                await self._attack_flow_violation()
+                await asyncio.sleep(0.05)
+                await self._attack_slow_reader(echo_calls, echo_bytes)
         except (ConnectionError, OSError, asyncio.IncompleteReadError):
             pass        # the server killed us — that is the assertion
         finally:
-            self.dead = True
+            if kill_conns:
+                self.dead = True
 
     async def _attack_flow_violation(self) -> None:
         """Valid call, then a credit-bypassing flood on the same stream
@@ -436,6 +546,50 @@ class HostileAgent(SimAgent):
             if i % 32 == 31:
                 await asyncio.sleep(0)      # let the loop breathe
 
+    async def _attack_reconnect_storm(self, rounds: int) -> None:
+        """Kill/redial racing newest-wins eviction — except meaner: the
+        redial lands while the PREVIOUS connection is still open, so
+        every register() must evict its predecessor deterministically
+        (an abort-first storm would race the server's disconnect watch
+        and sometimes test plain re-registration instead)."""
+        for _ in range(rounds):
+            await self._dial({HDR_LOOPBACK_CN: self.cn})
+            # the eviction closes the old server-side conn; give the
+            # loop one breath so closes interleave with redials the way
+            # a real flapping agent's would
+            await asyncio.sleep(0.01)
+
+    async def _attack_slowloris(self, rounds: int) -> None:
+        """Hold admission reservations without ever registering: a bare
+        handshake hello, then transport death before the server's ok
+        frame.  The driver arms ``arpc.handshake.accept`` with a delay
+        so the admit→register window is deterministically open when the
+        abort lands — each round strands exactly one ceiling
+        reservation for the TTL sweep to reap.  The close must be an
+        RST (SO_LINGER 0): a plain FIN leaves the server's ok-frame
+        write succeeding into the half-closed socket, so register()
+        would still run and consume the reservation."""
+        for r in range(rounds):
+            reader, writer = await asyncio.open_connection(self.host,
+                                                           self.port)
+            try:
+                body = codec.encode({"headers": {
+                    HDR_LOOPBACK_CN: f"{self.cn}-loris-{r}"}})
+                writer.write(HANDSHAKE_MAGIC + _HS_LEN.pack(len(body))
+                             + body)
+                await writer.drain()
+                # the server reads the hello, admits (reservation
+                # appended), and parks at the armed failpoint — die
+                # inside that window
+                await asyncio.sleep(0.05)
+            finally:
+                sock = writer.transport.get_extra_info("socket")
+                if sock is not None:
+                    sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                    struct.pack("ii", 1, 0))
+                writer.transport.abort()
+            await asyncio.sleep(0.05)
+
 
 class FleetServer:
     """The server side of the simulation: real AgentsManager admission,
@@ -449,12 +603,17 @@ class FleetServer:
         max_sessions = cfg.max_sessions or (2 * cfg.n_agents + 16)
         self.agents = AgentsManager(
             is_expected=None, rate=cfg.client_rate, burst=cfg.client_burst,
-            max_sessions=max_sessions, open_rate=cfg.open_rate)
+            max_sessions=max_sessions, open_rate=cfg.open_rate,
+            admission_deadline_ms=cfg.admission_deadline_ms)
+        if cfg.reservation_ttl_s > 0:
+            self.agents.reservation_ttl_s = cfg.reservation_ttl_s
         # an injected JobsManager lets the multiproc worker route every
         # enqueue through its JobQueueService (the DB-shared bound)
         # while this class keeps owning the data plane
         self.jobs = jobs if jobs is not None else JobsManager(
-            max_concurrent=cfg.max_concurrent, max_queued=cfg.max_queued)
+            max_concurrent=cfg.max_concurrent, max_queued=cfg.max_queued,
+            tenant_weights=(conf.parse_tenant_weights(cfg.tenant_weights)
+                            if cfg.tenant_weights else None))
         self.store = LocalStore(datastore_dir,
                                 ChunkerParams(avg_size=cfg.chunk_avg),
                                 shared_instance=shared_instance or None)
@@ -632,6 +791,30 @@ class FleetReport:
     hostile_run: int = 0
     server_flow_violations: int = 0
     server_write_deadline_sheds: int = 0
+    # ISSUE 19 meaner hostiles: liar backups ride their OWN accounting
+    # lane (never report.failures — the chaos requeue keys on that),
+    # the reconnect storm's evictions and the slowloris strands are
+    # counted by AgentsManager, the lying streams by the server mux
+    hostile_liar_published: int = 0
+    hostile_liar_errors: list = field(default_factory=list)
+    server_stream_length_violations: int = 0
+    reservations_reaped: int = 0
+    evictions: int = 0
+    admission_waits: int = 0
+    # mixed-traffic lanes (restore read-back + verify spot-check) and
+    # keepalive churn through the same jobs plane as the backups
+    restore_completed: int = 0
+    restore_failed: int = 0
+    restore_entries: int = 0
+    restore_failures: dict = field(default_factory=dict)
+    verify_completed: int = 0
+    verify_failed: int = 0
+    verify_checked: int = 0
+    verify_failures: dict = field(default_factory=dict)
+    churned: int = 0
+    # per-tenant CONTENDED grant counts (JobsManager.tenant_grants) —
+    # the weighted-fair proportionality witness
+    tenant_grants: dict = field(default_factory=dict)
     # per-histogram snapshot taken at soak start: the report's
     # percentiles are bucket-diff quantiles of the PROCESS-SHARED
     # /metrics histograms (ISSUE 12 — one quantile implementation,
@@ -695,6 +878,21 @@ class FleetReport:
             "hostile_run": self.hostile_run,
             "server_flow_violations": self.server_flow_violations,
             "server_write_deadline_sheds": self.server_write_deadline_sheds,
+            "hostile_liar_published": self.hostile_liar_published,
+            "hostile_liar_errors": len(self.hostile_liar_errors),
+            "server_stream_length_violations":
+                self.server_stream_length_violations,
+            "reservations_reaped": self.reservations_reaped,
+            "evictions": self.evictions,
+            "admission_waits": self.admission_waits,
+            "restore_completed": self.restore_completed,
+            "restore_failed": self.restore_failed,
+            "restore_entries": self.restore_entries,
+            "verify_completed": self.verify_completed,
+            "verify_failed": self.verify_failed,
+            "verify_checked": self.verify_checked,
+            "churned": self.churned,
+            "tenant_grants": dict(self.tenant_grants),
         }
 
 
@@ -718,6 +916,17 @@ async def run_fleet_async(datastore_dir: str,
     if cfg.kill_fraction > 0:
         k = max(1, int(cfg.n_agents * cfg.kill_fraction))
         doomed = set(rng.sample(range(cfg.n_agents), k))
+    # keepalive churn set: seeded, sampled AFTER doomed (stable across
+    # runs) and from the non-doomed pool — a churned agent must be alive
+    # to churn, and overlapping the two chaos modes would make the
+    # churned-count assertion depend on the kill schedule
+    churn_set: set[int] = set()
+    if cfg.churn_fraction > 0:
+        pool = [i for i in range(cfg.n_agents) if i not in doomed]
+        k = max(1, int(cfg.n_agents * cfg.churn_fraction))
+        churn_set = set(rng.sample(pool, min(k, len(pool))))
+    restored: set[int] = set()
+    verified: set[int] = set()
 
     trees = {i: synthetic_tree(cfg.seed, i, cfg.files_per_agent,
                                cfg.file_size)
@@ -778,8 +987,8 @@ async def run_fleet_async(datastore_dir: str,
                 pass
     sampler_task = asyncio.create_task(sampler(), name="fleet-sampler")
 
-    # -- enqueue one backup per agent --------------------------------------
-    def submit(cn: str, idx: int, job_id: str) -> None:
+    # -- enqueue backups, wave-chained per agent ---------------------------
+    def submit(cn: str, idx: int, job_id: str, wave: int = 0) -> None:
         tenant = f"tenant-{idx % max(1, cfg.tenants)}"
         breaker = server.jobs.breaker(
             f"agent:{cn}", failure_threshold=cfg.breaker_threshold,
@@ -793,14 +1002,127 @@ async def run_fleet_async(datastore_dir: str,
             if res["resumed"]:
                 report.resumed += 1
             report.failures.pop(cn, None)
+            # post-publish chain (ISSUE 19 mixed traffic): keepalive
+            # churn, then the agent's NEXT wave — two live job sessions
+            # into one snapshot group would race the publish, so waves
+            # chain on success — and the restore/verify read-back lanes
+            # the moment this agent has a snapshot to read
+            if idx in churn_set:
+                churn_set.discard(idx)
+                await agents[cn].churn()
+                report.churned += 1
+            if wave + 1 < cfg.jobs_per_agent:
+                submit(cn, idx, f"job-{idx:04d}-w{wave + 2}", wave + 1)
+            if idx < cfg.restore_jobs and idx not in restored:
+                restored.add(idx)
+                submit_restore(cn, idx, f"restore-{idx:04d}")
+            if idx < cfg.verify_jobs and idx not in verified:
+                verified.add(idx)
+                submit_verify(cn, idx, f"verify-{idx:04d}")
 
         async def on_error(exc: BaseException):
             report.failed += 1
             report.failures[cn] = f"{type(exc).__name__}: {exc}"
 
-        server.jobs.enqueue(Job(id=f"backup:{cn}", kind="backup",
+        server.jobs.enqueue(Job(id=f"backup:{cn}:{job_id}", kind="backup",
                                 tenant=tenant, execute=execute,
                                 on_error=on_error))
+
+    # -- mixed-traffic lanes: restore read-back + verify spot-check --------
+    # (both run through the SAME jobs plane and fairness lanes as the
+    # backups — docs/fleet.md "Mixed traffic"; each compares the real
+    # datastore against the agent's synthetic tree, so a lost or torn
+    # chunk under churn/failover is a hard failure, not a silent miss)
+    def submit_restore(cn: str, idx: int, job_id: str) -> None:
+        async def execute():
+            from ..pxar.transfer import SplitReader
+            ref = report.refs[cn]
+            tree = trees[idx]
+
+            def _read_back() -> int:
+                reader = SplitReader.open_snapshot(
+                    server.store.datastore, ref)
+                n = 0
+                for entry in reader.entries():
+                    if not entry.is_file:
+                        continue
+                    rel = entry.path.lstrip("/")
+                    want = tree.get(rel)
+                    if want is None:
+                        raise RuntimeError(
+                            f"restored unknown entry {entry.path!r}")
+                    got = reader.read_file(entry)
+                    if got != want:
+                        raise RuntimeError(
+                            f"restore mismatch at {rel!r}: "
+                            f"{len(got)} != {len(want)} bytes")
+                    n += 1
+                if n != len(tree):
+                    raise RuntimeError(f"restore saw {n}/{len(tree)} files")
+                return n
+
+            n = await asyncio.get_running_loop().run_in_executor(
+                None, trace.wrap(_read_back))
+            report.restore_completed += 1
+            report.restore_entries += n
+            report.restore_failures.pop(job_id, None)
+
+        async def on_error(exc: BaseException):
+            report.restore_failed += 1
+            report.restore_failures[job_id] = f"{type(exc).__name__}: {exc}"
+
+        server.jobs.enqueue(Job(id=f"restore:{job_id}", kind="restore",
+                                tenant="restore", execute=execute,
+                                on_error=on_error))
+
+    def submit_verify(cn: str, idx: int, job_id: str) -> None:
+        async def execute():
+            import numpy as np
+
+            from ..models.verify import VerifyPipeline
+            from ..pxar.transfer import SplitReader
+            ref = report.refs[cn]
+
+            def _spot_check():
+                reader = SplitReader.open_snapshot(
+                    server.store.datastore, ref)
+                return VerifyPipeline().verify_snapshot(
+                    reader, sample_rate=1.0,
+                    rng=np.random.default_rng(cfg.seed + idx))
+
+            res = await asyncio.get_running_loop().run_in_executor(
+                None, trace.wrap(_spot_check))
+            if not res.ok:
+                raise RuntimeError(
+                    f"verify found corruption: {res.corrupt_paths}")
+            report.verify_completed += 1
+            report.verify_checked += res.checked
+            report.verify_failures.pop(job_id, None)
+
+        async def on_error(exc: BaseException):
+            report.verify_failed += 1
+            report.verify_failures[job_id] = f"{type(exc).__name__}: {exc}"
+
+        server.jobs.enqueue(Job(id=f"verify:{job_id}", kind="verify",
+                                tenant="verify", execute=execute,
+                                on_error=on_error))
+
+    # -- length-liar lane: hostile backups on their OWN accounting ---------
+    # (the server must refuse the short stream with the typed
+    # StreamLengthError and publish nothing; never report.failures —
+    # the chaos requeue keys on that dict)
+    def submit_liar(ha: HostileAgent, job_id: str) -> None:
+        async def execute():
+            try:
+                await server.backup_once(ha.cn, job_id)
+            except Exception as e:
+                report.hostile_liar_errors.append(
+                    f"{type(e).__name__}: {e}")
+                return
+            report.hostile_liar_published += 1
+
+        server.jobs.enqueue(Job(id=f"liar:{job_id}", kind="backup",
+                                tenant="hostile", execute=execute))
 
     # -- concurrent replication traffic (ISSUE 10 fleet tie-in) ------------
     mirror_dir = cfg.sync_mirror_dir or f"{datastore_dir}-mirror"
@@ -845,42 +1167,89 @@ async def run_fleet_async(datastore_dir: str,
         submit_sync(f"fleet-sync-{i:02d}")
     # hostile agents attack CONCURRENTLY with the backup round: the
     # server must count + survive the abuse while the legit fleet
-    # publishes (ISSUE 15 satellite)
+    # publishes (ISSUE 15 satellite; ISSUE 19 adds the reconnect-storm,
+    # length-liar and slowloris profiles — docs/fleet.md "Hostile
+    # clients").  Profiles round-robin over cfg.hostile_profiles; ""
+    # keeps the classic flood+slow-reader pair.
+    profiles = [p.strip() for p in cfg.hostile_profiles.split(",")
+                if p.strip()]
+    assigned = [profiles[h % len(profiles)] if profiles else ""
+                for h in range(cfg.hostile_agents)]
     hostile_tasks: list[asyncio.Task] = []
     hostiles: list[HostileAgent] = []
-    for h in range(cfg.hostile_agents):
-        ha = HostileAgent(f"hostile-{h:03d}", "127.0.0.1", port,
-                          {"f.bin": b"\0" * 64},
-                          connect_attempts=cfg.connect_attempts,
-                          write_deadline_s=0.0)   # never shed OUR writes
-        await ha.start()
-        hostiles.append(ha)
-        hostile_tasks.append(asyncio.create_task(
-            ha.run_attacks(echo_calls=cfg.hostile_echo_calls,
-                           echo_bytes=cfg.hostile_echo_bytes),
-            name=f"hostile:{ha.cn}"))
-    await server.jobs.drain(timeout=cfg.job_timeout_s)
+    loris_fp = None
+    if "slowloris" in assigned:
+        # hold the admit→register window open so every slowloris abort
+        # deterministically lands between the ceiling reservation and
+        # the ok frame (docs/fault-injection.md `arpc.handshake.accept`)
+        loris_fp = failpoints.armed("arpc.handshake.accept", "delay",
+                                    arg=0.2)
+        loris_fp.__enter__()
+    try:
+        for h, profile in enumerate(assigned):
+            ha = HostileAgent(f"hostile-{h:03d}", "127.0.0.1", port,
+                              {"f.bin": b"\0" * 64},
+                              connect_attempts=cfg.connect_attempts,
+                              write_deadline_s=0.0,  # never shed OUR writes
+                              profile=profile,
+                              lie_bytes=(cfg.hostile_lie_bytes
+                                         if profile == "length_liar"
+                                         else 0))
+            await ha.start()
+            hostiles.append(ha)
+            if profile == "length_liar":
+                submit_liar(ha, f"liar-{h:03d}")
+            hostile_tasks.append(asyncio.create_task(
+                ha.run_attacks(echo_calls=cfg.hostile_echo_calls,
+                               echo_bytes=cfg.hostile_echo_bytes,
+                               reconnects=cfg.hostile_reconnects,
+                               slowloris_rounds=cfg.hostile_slowloris_rounds),
+                name=f"hostile:{ha.cn}"))
+        if hostile_tasks:
+            await asyncio.wait_for(asyncio.gather(*hostile_tasks),
+                                   cfg.job_timeout_s)
+            report.hostile_run = len(hostiles)
+    finally:
+        if loris_fp is not None:
+            loris_fp.__exit__(None, None, None)
     if hostile_tasks:
-        await asyncio.wait_for(asyncio.gather(*hostile_tasks),
-                               cfg.job_timeout_s)
-        report.hostile_run = len(hostiles)
         # the shed fires up to one write deadline AFTER the refused
         # responses were queued — wait it out (bounded), then read the
-        # server-side counters the soak asserts on
+        # server-side counters the soak asserts on.  Expectations are
+        # profile-aware: only flooding hostiles force flow violations,
+        # only slow readers force a shed.
+        exp_flood = sum(1 for p in assigned if p in ("", "flood"))
+        exp_shed = 1 if any(p in ("", "slow_reader") for p in assigned) \
+            else 0
         deadline = time.perf_counter() + \
             max(2.0, 3.0 * cfg.mux_write_deadline_s)
         while time.perf_counter() < deadline:
             srv_stats = server.mux_stats()
-            if srv_stats.get("write_deadline_sheds", 0) >= 1 and \
-                    srv_stats.get("flow_violations", 0) >= len(hostiles):
+            if srv_stats.get("write_deadline_sheds", 0) >= exp_shed and \
+                    srv_stats.get("flow_violations", 0) >= exp_flood:
                 break
             await asyncio.sleep(0.05)
         srv_stats = server.mux_stats()
         report.server_flow_violations = srv_stats.get("flow_violations", 0)
         report.server_write_deadline_sheds = srv_stats.get(
             "write_deadline_sheds", 0)
-        for ha in hostiles:
-            await ha.stop()
+    # drain AFTER the hostile gather: liar backups need the liar's live
+    # control session, and the wave chain keeps enqueueing until every
+    # agent's last wave (plus restore/verify read-backs) published
+    await server.jobs.drain(timeout=cfg.job_timeout_s)
+    if "slowloris" in assigned:
+        # every stranded reservation must be REAPED (the ceiling slot
+        # freed by the TTL sweep), not merely expired — wait it out,
+        # bounded by a few sweep periods
+        n_strands = cfg.hostile_slowloris_rounds * \
+            sum(1 for p in assigned if p == "slowloris")
+        deadline = time.perf_counter() + \
+            3.0 * max(0.5, server.agents.reservation_ttl_s) + 5.0
+        while time.perf_counter() < deadline and \
+                server.agents.reservations_reaped < n_strands:
+            await asyncio.sleep(0.05)
+    for ha in hostiles:
+        await ha.stop()
     report.breaker_states_round1 = {
         k: cb.state for k, cb in server.jobs._breakers.items()}
     report.killed = {a.cn for a in agents.values() if a.dead}
@@ -915,7 +1284,13 @@ async def run_fleet_async(datastore_dir: str,
     report.connect_rejects = sum(a.connect_rejects
                                  for a in agents.values())
     report.admission = server.agents.admission_stats()
+    report.reservations_reaped = server.agents.reservations_reaped
+    report.evictions = server.agents.evictions
+    report.admission_waits = server.agents.admission_waits
+    report.tenant_grants = dict(server.jobs.tenant_grants)
     report.mux_server = server.mux_stats()
+    report.server_stream_length_violations = report.mux_server.get(
+        "stream_length_violations", 0)
     for a in agents.values():
         for k, v in a.mux_stats().items():
             report.mux_agents[k] = report.mux_agents.get(k, 0) + v
@@ -958,6 +1333,22 @@ class MultiProcConfig:
     seed: int = 2026
     job_timeout_s: float = 180.0
     spawn_timeout_s: float = 120.0
+    # -- ISSUE 19 combined soak (all default-off: the base two-process
+    #    choreography is unchanged unless a knob below is set) ------------
+    jobs_per_agent: int = 1            # backup waves per agent
+    restore_jobs: int = 0              # read-back restores via worker 0
+    verify_jobs: int = 0               # verify spot-checks via worker 1
+    sync_jobs: int = 0                 # replication jobs via worker 0
+    hostile_agents: int = 0            # hostile tasks vs worker 0
+    hostile_profiles: str = ""         # round-robin profile list
+    hostile_lie_bytes: int = 512
+    hostile_reconnects: int = 4
+    hostile_slowloris_rounds: int = 2
+    tenant_weights: str = ""           # operator override, both workers
+    admission_deadline_ms: float = 0.0
+    reservation_ttl_s: float = 0.0
+    fair_probe: bool = False           # deterministic DRR witness
+    deadline_probe: bool = False       # filler-dial typed-reject probe
 
 
 @dataclass
@@ -993,6 +1384,25 @@ class MultiProcReport:
     service_lock_wait: dict = field(default_factory=dict)
     queue_counts: dict = field(default_factory=dict)
     admission: dict = field(default_factory=dict)
+    # ISSUE 19 combined-soak observations
+    restore_completed: int = 0
+    restore_failed: int = 0
+    verify_completed: int = 0
+    verify_failed: int = 0
+    sync_completed: int = 0
+    sync_failed: int = 0
+    hostile_run: int = 0
+    hostile_liar_published: int = 0
+    hostile_liar_errors: list = field(default_factory=list)
+    stream_length_violations: int = 0
+    reservations_reaped: int = 0
+    evictions: int = 0
+    admission_waits: int = 0
+    tenant_grants: dict = field(default_factory=dict)   # proc → dict
+    enqueue_p99: dict = field(default_factory=dict)     # proc → seconds
+    fair_order: list = field(default_factory=list)      # fair_probe grants
+    deadline_rejects_seen: int = 0      # typed 503s the probe dials saw
+    deadline_rejects_counted: int = 0   # shared-DB admission counter
 
     def to_dict(self) -> dict:
         return {
@@ -1023,6 +1433,24 @@ class MultiProcReport:
             "service_lock_wait": dict(self.service_lock_wait),
             "queue_counts": dict(self.queue_counts),
             "admission": dict(self.admission),
+            "restore_completed": self.restore_completed,
+            "restore_failed": self.restore_failed,
+            "verify_completed": self.verify_completed,
+            "verify_failed": self.verify_failed,
+            "sync_completed": self.sync_completed,
+            "sync_failed": self.sync_failed,
+            "hostile_run": self.hostile_run,
+            "hostile_liar_published": self.hostile_liar_published,
+            "hostile_liar_errors": len(self.hostile_liar_errors),
+            "stream_length_violations": self.stream_length_violations,
+            "reservations_reaped": self.reservations_reaped,
+            "evictions": self.evictions,
+            "admission_waits": self.admission_waits,
+            "tenant_grants": dict(self.tenant_grants),
+            "enqueue_p99": dict(self.enqueue_p99),
+            "fair_order_len": len(self.fair_order),
+            "deadline_rejects_seen": self.deadline_rejects_seen,
+            "deadline_rejects_counted": self.deadline_rejects_counted,
         }
 
 
@@ -1180,7 +1608,14 @@ async def run_multiproc_fleet_async(root_dir: str,
                      "--chunk-avg", str(cfg.chunk_avg),
                      "--max-agents", str(2 * cfg.n_agents + 8),
                      "--max-concurrent", str(cfg.max_concurrent),
-                     "--max-queued", str(cfg.max_queued)],
+                     "--max-queued", str(cfg.max_queued)]
+                    + (["--tenant-weights", cfg.tenant_weights]
+                       if cfg.tenant_weights else [])
+                    + (["--admission-deadline-ms",
+                        str(cfg.admission_deadline_ms)]
+                       if cfg.admission_deadline_ms else [])
+                    + (["--reservation-ttl", str(cfg.reservation_ttl_s)]
+                       if cfg.reservation_ttl_s else []),
                     cfg.spawn_timeout_s)
             for w in workers))
 
@@ -1209,6 +1644,177 @@ async def run_multiproc_fleet_async(root_dir: str,
                 else:
                     report.failed += 1
                     report.failures[done["job_id"]] = done.get("error", "")
+
+        # -- ISSUE 19 combined soak: later waves + RESTORE/VERIFY/SYNC -----
+        # interleaved with hostiles from every profile, all through the
+        # same two job planes.  Every lane answers with a `done` event,
+        # so one tally loop consumes the whole batch per worker (the
+        # expect() drop semantics demand nothing else is in flight).
+        import hashlib
+
+        def _tree_hash(tree: dict) -> str:
+            h = hashlib.sha256()
+            for rel, data in sorted(tree.items()):
+                h.update(rel.encode() + b"\0" + data + b"\0")
+            return h.hexdigest()
+
+        mirror_dir = os.path.join(root_dir, "mirror")
+        profiles = [p.strip() for p in cfg.hostile_profiles.split(",")
+                    if p.strip()]
+        assigned = [profiles[h % len(profiles)] if profiles else ""
+                    for h in range(cfg.hostile_agents)]
+        hostiles: "list[HostileAgent]" = []
+        hostile_tasks: "list[asyncio.Task]" = []
+        extra_pending: dict[str, int] = {}      # job_id → worker idx
+        expect_hash: dict[str, str] = {}        # restore job → tree hash
+        sync_chunks_written = 0                 # mirror chunk creations
+        if "slowloris" in assigned:
+            # arm the admit→register window INSIDE worker 0 (the
+            # failpoint must fire in the process that serves the dials)
+            workers[0].send({"cmd": "failpoint",
+                             "site": "arpc.handshake.accept",
+                             "action": "delay", "arg": 0.2})
+            await workers[0].expect("failpoint", timeout=30)
+        for h, profile in enumerate(assigned):
+            ha = HostileAgent(f"hostile-{h:03d}", "127.0.0.1",
+                              workers[0].port, {"f.bin": b"\0" * 256},
+                              write_deadline_s=0.0, profile=profile,
+                              lie_bytes=(cfg.hostile_lie_bytes
+                                         if profile == "length_liar"
+                                         else 0))
+            await ha.start()
+            agents[ha.cn] = ha
+            hostiles.append(ha)
+            if profile == "length_liar":
+                jid = f"liar-{h:03d}"
+                workers[0].send({"cmd": "backup", "cn": ha.cn,
+                                 "job_id": jid, "tenant": "hostile"})
+                extra_pending[jid] = 0
+            hostile_tasks.append(asyncio.create_task(
+                ha.run_attacks(
+                    echo_calls=12, echo_bytes=1 << 20,
+                    reconnects=cfg.hostile_reconnects,
+                    slowloris_rounds=cfg.hostile_slowloris_rounds),
+                name=f"hostile:{ha.cn}"))
+        # waves 2..N: one extra backup per agent per wave — waves after
+        # the next are held back so a cn never runs two backups at once
+        for wave in range(2, cfg.jobs_per_agent + 1):
+            final_wave = wave == cfg.jobs_per_agent
+            for w_i, w in enumerate(workers):
+                for i in range(cfg.n_agents):
+                    cn = f"p{w_i}-a{i:03d}"
+                    jid = f"job-{cn}-w{wave}"
+                    w.send({"cmd": "backup", "cn": cn, "job_id": jid,
+                            "tenant": f"tenant-{i % 4}",
+                            "weight": 3 if i % 4 == 0 else 1})
+                    extra_pending[jid] = w_i
+            if not final_wave:          # barrier between same-cn waves
+                for w_i, w in enumerate(workers):
+                    mine = sum(1 for v in extra_pending.values()
+                               if v == w_i)
+                    for _ in range(mine):
+                        done = await w.expect("done",
+                                              timeout=cfg.job_timeout_s)
+                        if done["ok"]:
+                            report.published += 1
+                        else:
+                            report.failed += 1
+                            report.failures[done["job_id"]] = \
+                                done.get("error", "")
+                extra_pending.clear()
+        # mixed read traffic rides CONCURRENTLY with the final wave
+        for i in range(min(cfg.restore_jobs, cfg.n_agents)):
+            cn, jid = f"p0-a{i:03d}", f"restore-{i:03d}"
+            workers[0].send({"cmd": "restore", "cn": cn, "job_id": jid})
+            extra_pending[jid] = 0
+            expect_hash[jid] = _tree_hash(trees[cn])
+        v_w = 1 % cfg.processes
+        for i in range(min(cfg.verify_jobs, cfg.n_agents)):
+            cn, jid = f"p{v_w}-a{i:03d}", f"verify-{i:03d}"
+            workers[v_w].send({"cmd": "verify", "cn": cn, "job_id": jid,
+                               "seed": cfg.seed + i})
+            extra_pending[jid] = v_w
+        # one mirror dir PER sync job: concurrent syncs into one mirror
+        # would race tmp+rename on the same chunk files, double-counting
+        # the per-process chunks_written metric and breaking the
+        # written-once identity below — per-job mirrors keep every
+        # mirror write attributable to exactly one sync's chunk count
+        for s in range(cfg.sync_jobs):
+            jid = f"sync-{s:02d}"
+            workers[0].send({"cmd": "sync", "job_id": jid,
+                             "mirror_dir": os.path.join(mirror_dir, jid)})
+            extra_pending[jid] = 0
+        for w_i, w in enumerate(workers):
+            mine = sum(1 for v in extra_pending.values() if v == w_i)
+            for _ in range(mine):
+                done = await w.expect("done", timeout=cfg.job_timeout_s)
+                jid, ok = done["job_id"], done["ok"]
+                if jid.startswith("restore-"):
+                    if ok and done.get("tree_hash") == expect_hash[jid]:
+                        report.restore_completed += 1
+                    else:
+                        report.restore_failed += 1
+                        report.failures[jid] = done.get(
+                            "error", "restored tree hash mismatch")
+                elif jid.startswith("verify-"):
+                    if ok:
+                        report.verify_completed += 1
+                    else:
+                        report.verify_failed += 1
+                        report.failures[jid] = done.get("error", "")
+                elif jid.startswith("sync-"):
+                    if ok:
+                        report.sync_completed += 1
+                        sync_chunks_written += done.get("chunks", 0)
+                    else:
+                        report.sync_failed += 1
+                        report.failures[jid] = done.get("error", "")
+                elif jid.startswith("liar-"):
+                    if ok:
+                        report.hostile_liar_published += 1
+                    else:
+                        report.hostile_liar_errors.append(
+                            done.get("error", ""))
+                elif ok:
+                    report.published += 1
+                else:
+                    report.failed += 1
+                    report.failures[jid] = done.get("error", "")
+        if hostiles:
+            await asyncio.wait_for(asyncio.gather(*hostile_tasks), 120)
+            report.hostile_run = len(hostiles)
+            if "slowloris" in assigned:
+                workers[0].send({"cmd": "failpoint",
+                                 "site": "arpc.handshake.accept",
+                                 "disarm": True})
+                await workers[0].expect("failpoint", timeout=30)
+                # every stranded reservation must be REAPED (ceiling
+                # slot freed by worker 0's TTL sweep) before we move on
+                n_strands = cfg.hostile_slowloris_rounds * sum(
+                    1 for p in assigned if p == "slowloris")
+                ttl = cfg.reservation_ttl_s if cfg.reservation_ttl_s > 0 \
+                    else 20.0
+                deadline = time.monotonic() + 3 * ttl + 5
+                while time.monotonic() < deadline:
+                    workers[0].send({"cmd": "metrics"})
+                    m = await workers[0].expect("metrics", timeout=30)
+                    if m["admission_extra"]["reservations_reaped"] >= \
+                            n_strands:
+                        break
+                    await asyncio.sleep(0.2)
+            for ha in hostiles:
+                await ha.stop()
+                agents.pop(ha.cn, None)
+        # weighted-fair witness: deterministic contended-grant order
+        # measured inside a worker (plug → backlog → release)
+        if cfg.fair_probe:
+            fp_w = workers[1 % cfg.processes]
+            fp_w.send({"cmd": "fair_probe",
+                       "tenants": {"fp-heavy": 3, "fp-mid": 2,
+                                   "fp-light": 1},
+                       "jobs_per_tenant": 12})
+            fp = await fp_w.expect("fair_probe", timeout=120)
+            report.fair_order = list(fp["order"])
 
         # -- GC cycle with both processes racing the lease -----------------
         def gc_all():
@@ -1278,6 +1884,18 @@ async def run_multiproc_fleet_async(root_dir: str,
             report.chunks_written_total += m["store"]["chunks_written"]
             report.cross_process_hits += m["store"]["cross_process_hits"]
             report.index_hits_total += m["dedup_index"]["hits"]
+            # ISSUE 19 counters live in the worker that saw the abuse —
+            # collect them here too, while BOTH processes are alive (a
+            # SIGKILLed leader takes its counters with it)
+            ext = m.get("admission_extra", {})
+            report.reservations_reaped += ext.get("reservations_reaped", 0)
+            report.evictions += ext.get("evictions", 0)
+            report.admission_waits += ext.get("admission_waits", 0)
+            report.stream_length_violations += m.get("mux", {}).get(
+                "stream_length_violations", 0)
+            report.tenant_grants[w.name] = m.get("tenant_grants", {})
+            report.enqueue_p99[w.name] = m.get(
+                "enqueue_to_publish", {}).get("p99", 0.0)
 
         # -- leader-kill failover ------------------------------------------
         doomed2: set = set()
@@ -1344,6 +1962,38 @@ async def run_multiproc_fleet_async(root_dir: str,
             report.live_missing = len(live_list) - sum(
                 ds_view.chunks.on_disk_many(live_list))
 
+        # -- deadline-admission probe against the survivor -----------------
+        # fill the session ceiling with raw dials, then keep dialing
+        # until one waits out the bounded admission deadline and gets
+        # the TYPED 503 — proving deadline queueing (not fast-fail)
+        # still runs on the post-failover survivor, and that the reject
+        # lands in the shared admission counters
+        if cfg.deadline_probe and cfg.admission_deadline_ms > 0:
+            from ..arpc.transport import (HDR_LOOPBACK_CN, HandshakeError,
+                                          connect_to_server)
+            surv = workers[1] if cfg.kill_leader and cfg.processes > 1 \
+                else workers[0]
+            fillers = []
+            try:
+                for f in range(4 * cfg.n_agents + 40):
+                    try:
+                        c = await connect_to_server(
+                            "127.0.0.1", surv.port, None,
+                            headers={HDR_LOOPBACK_CN: f"filler-{f:03d}"},
+                            timeout=cfg.admission_deadline_ms / 1000 + 15)
+                    except HandshakeError as e:
+                        if e.code == 503 and "deadline" in e.reason:
+                            report.deadline_rejects_seen += 1
+                        break
+                    fillers.append(c)
+            finally:
+                for c in fillers:
+                    await c.close()
+            surv.send({"cmd": "metrics"})
+            m = await surv.expect("metrics", timeout=30)
+            report.deadline_rejects_counted = m["admission"].get(
+                "admission_deadline", 0)
+
         # -- lease counters + lock-wait ladder from the survivors ----------
         live_workers = [w for w in workers
                         if w.proc is not None and w.proc.returncode is None]
@@ -1361,10 +2011,13 @@ async def run_multiproc_fleet_async(root_dir: str,
         # the written-once identity over the whole run: every chunk file
         # was CREATED exactly once (the link claim never overwrites), so
         # the fleet's summed claim counters — captured before any kill —
-        # must equal distinct-ever == still-on-disk + swept
+        # must equal distinct-ever == still-on-disk + swept, plus the
+        # mirror chunk files the sync lane created (each sync owns its
+        # own mirror dir, so its transferred count IS its creations)
         report.written_once = (
             report.chunks_written_total ==
-            report.distinct_chunks_after + report.chunks_removed_total)
+            report.distinct_chunks_after + report.chunks_removed_total
+            + sync_chunks_written)
     finally:
         for a in agents.values():
             try:

@@ -7,11 +7,17 @@ PreExec runs BEFORE acquiring the execution slot (mount while queued);
 StartupMu serializes client startups.
 
 Fleet-scale additions (docs/fleet.md "Fairness"): execution slots are
-granted round-robin ACROSS tenants (strict ``Job.priority`` classes
-first, RR within a class), so one noisy tenant enqueuing hundreds of
-jobs cannot starve another tenant's single job — with a plain FIFO
-semaphore the victim waits behind the entire noisy backlog; under RR it
-waits at most one slot-grant cycle.  The queue itself is bounded
+granted WEIGHTED round-robin ACROSS tenants (strict ``Job.priority``
+classes first, deficit-weighted RR within a class), so one noisy tenant
+enqueuing hundreds of jobs cannot starve another tenant's single job —
+with a plain FIFO semaphore the victim waits behind the entire noisy
+backlog; under RR it waits at most one slot-grant cycle.  Per-tenant
+weights (``PBS_PLUS_TENANT_WEIGHTS`` or ``Job.weight``, DB-plumbed like
+priority) shape the shares: each tenant's credit replenishes by its
+weight once per grant cycle and every grant costs one credit, so a
+weight-3 tenant lands ~3x the grants of a weight-1 tenant within one
+cycle while a zero-credit tenant is merely skipped, never starved.
+The queue itself is bounded
 (``max_queued``, conf ``PBS_PLUS_MAX_QUEUED_JOBS``): enqueues past the
 bound fast-fail with the typed ``QueueFullError`` instead of accepting
 unbounded work the server cannot start.
@@ -50,6 +56,9 @@ class Job:
     tenant: str = ""                          # fairness lane (target CN);
                                               # "" = shared default lane
     priority: int = 0                         # strict class: lower first
+    weight: int = 1                           # fair-share weight within a
+                                              # class (≥1; a JobsManager
+                                              # tenant_weights entry wins)
     pre_exec: Optional[AsyncFn] = None        # runs before the exec slot
     execute: Optional[AsyncFn] = None
     on_success: Optional[AsyncFn] = None
@@ -64,7 +73,8 @@ class JobsManager:
     def __init__(self, *, max_concurrent: int | None = None,
                  max_queued: int | None = None,
                  max_breakers: int = DEFAULT_MAX_BREAKERS,
-                 breaker_idle_evict_s: float = DEFAULT_BREAKER_IDLE_EVICT_S):
+                 breaker_idle_evict_s: float = DEFAULT_BREAKER_IDLE_EVICT_S,
+                 tenant_weights: "dict[str, int] | None" = None):
         self.max_concurrent = max_concurrent or conf.max_concurrent_clients()
         self.max_queued = (conf.env().max_queued_jobs if max_queued is None
                            else max_queued)
@@ -74,6 +84,18 @@ class JobsManager:
         # it has an entry in _waiting)
         self._waiting: dict[str, deque] = {}
         self._rr: deque[str] = deque()
+        # deficit-weighted fair shares: tenant → remaining grant credit
+        # this cycle (replenished by weight when the winning class runs
+        # dry; dropped with the backlog so idle tenants never bank a
+        # burst), and the per-tenant CONTENDED grant counter the ±10%
+        # proportionality gate reads (fast-path grants are uncontended
+        # and carry no fairness signal)
+        self._tenant_weights = (dict(tenant_weights)
+                                if tenant_weights is not None
+                                else conf.parse_tenant_weights(
+                                    conf.env().tenant_weights))
+        self._credit: dict[str, float] = {}
+        self.tenant_grants: dict[str, int] = {}
         self._queued = 0                      # enqueued, no exec slot yet
         self._tenant_running: dict[str, int] = {}
         self._active: dict[str, asyncio.Task] = {}
@@ -216,11 +238,24 @@ class JobsManager:
         while self._slots_free > 0 and self._grant_next():
             self._slots_free -= 1
 
+    def _weight_of(self, tenant: str, head: Job) -> int:
+        """Effective fair-share weight: an operator-pinned tenant weight
+        (PBS_PLUS_TENANT_WEIGHTS) wins over the job-carried weight (the
+        DB-plumbed row value), floor 1 so no tenant can be weighted out
+        of existence."""
+        w = self._tenant_weights.get(tenant, head.weight)
+        return max(1, int(w))
+
     def _grant_next(self) -> bool:
         """Grant one slot: strict priority across the waiting tenants'
-        HEAD jobs, round-robin within the winning class.  Returns False
-        when no live waiter exists."""
-        best: tuple[int, str] | None = None
+        HEAD jobs, deficit-weighted round-robin within the winning class.
+        Each grant costs one credit; when every tenant of the winning
+        class is out of credit the cycle ends and every one of them
+        replenishes by its weight — so within one cycle a weight-3
+        tenant lands 3 grants for a weight-1 tenant's 1, and a tenant
+        out of credit is merely skipped until the boundary, never
+        starved.  Returns False when no live waiter exists."""
+        best: int | None = None
         for t in list(self._rr):
             dq = self._waiting.get(t)
             while dq and dq[0][0].done():       # cancelled leftovers
@@ -228,13 +263,26 @@ class JobsManager:
             if not dq:
                 del self._waiting[t]
                 self._rr.remove(t)
+                self._credit.pop(t, None)       # backlog gone: no banking
                 continue
             p = dq[0][1].priority
-            if best is None or p < best[0]:
-                best = (p, t)
+            if best is None or p < best:
+                best = p
         if best is None:
             return False
-        t = best[1]
+        # candidates in ring order, winning priority class only
+        ring = [t for t in self._rr
+                if self._waiting[t][0][1].priority == best]
+        t = next((c for c in ring if self._credit.get(c, 0.0) >= 1.0), None)
+        if t is None:
+            # cycle boundary: all candidates exhausted — replenish each
+            # by its weight (credits here are always 0: a tenant with
+            # credit ≥1 would have been picked above)
+            for c in ring:
+                self._credit[c] = float(
+                    self._weight_of(c, self._waiting[c][0][1]))
+            t = ring[0]
+        self._credit[t] -= 1.0
         dq = self._waiting[t]
         fut, _job = dq.popleft()
         self._rr.remove(t)
@@ -242,6 +290,8 @@ class JobsManager:
             self._rr.append(t)                  # rotate: back of the ring
         else:
             del self._waiting[t]
+            self._credit.pop(t, None)           # leave the cycle clean
+        self.tenant_grants[t] = self.tenant_grants.get(t, 0) + 1
         fut.set_result(None)
         return True
 

@@ -45,6 +45,7 @@ class JobQueueService:
                  checkpoint_interval: Callable[[], str] = lambda: "",
                  max_concurrent: "int | None" = None,
                  max_queued: "int | None" = None,
+                 tenant_weights: "dict[str, int] | None" = None,
                  owner: str = "", reap_all_on_boot: bool = False):
         self.db = db
         self.config = config
@@ -54,7 +55,8 @@ class JobQueueService:
         self._checkpoint_interval = checkpoint_interval
         self.owner = owner or default_owner()
         self.jobs = JobsManager(max_concurrent=max_concurrent,
-                                max_queued=max_queued)
+                                max_queued=max_queued,
+                                tenant_weights=tenant_weights)
         # completion hook the composition root wires to the scheduler
         # (late-bound: the scheduler is constructed after this service)
         self.on_backup_complete: "Callable[[str], None] | None" = None
@@ -104,7 +106,8 @@ class JobQueueService:
         # via _wrap_lifecycle.
         verdict = self.db.queue_admit(job.id, job.kind, job.tenant,
                                       self.owner,
-                                      max_queued=self.jobs.max_queued)
+                                      max_queued=self.jobs.max_queued,
+                                      weight=job.weight)
         if verdict == "active":
             if not self.jobs.is_active(job.id):
                 # live row, not ours: the run is active in a SIBLING
@@ -124,7 +127,8 @@ class JobQueueService:
             ok = self.jobs.enqueue(job)
             if ok:
                 self.db.queue_admit(job.id, job.kind, job.tenant,
-                                    self.owner, max_queued=0)
+                                    self.owner, max_queued=0,
+                                    weight=job.weight)
             return ok
         if verdict == "full":
             self.jobs.stats["rejected_full"] += 1

@@ -78,8 +78,10 @@ ENV_VARS = {
     "PBS_PLUS_AGENT_BURST": "per-client token bucket burst",
     "PBS_PLUS_AGENT_OPEN_RATE": "global session-open rate (0 = off)",
     "PBS_PLUS_AGENT_MAX_SESSIONS": "hard ceiling on registered sessions",
+    "PBS_PLUS_ADMISSION_DEADLINE_MS": "admission wait deadline (0 = fast-fail)",
     "PBS_PLUS_MUX_WRITE_DEADLINE": "mux slow-reader shed deadline (s)",
     "PBS_PLUS_MAX_QUEUED_JOBS": "jobs-queue bound (QueueFullError past it)",
+    "PBS_PLUS_TENANT_WEIGHTS": "fair-share weights 'tenant=w,...' ('' = 1x)",
     "PBS_PLUS_SYNC_BATCH": "digests per sync membership-negotiation batch",
     "PBS_PLUS_FAILPOINTS": "arm failpoints at import (site=action@trig;…)",
     "PBS_PLUS_TRACE_RING": "trace ring capacity (closed spans retained)",
@@ -178,6 +180,12 @@ class Env:
     agent_burst: int = CLIENT_RATE_LIMIT_BURST
     agent_open_rate: float = 0.0
     agent_max_sessions: int = 4096
+    # deadline admission (arpc/agents_manager.py, docs/fleet.md
+    # "Admission"): >0 turns the session-ceiling fast-fail into a
+    # bounded wait — an arriving handshake queues up to this many
+    # milliseconds for capacity before the typed AdmissionDeadlineError;
+    # 0 (default) keeps the pure fast-fail 503
+    admission_deadline_ms: float = 0.0
     # mux slow-reader shed (arpc/mux.py): a frame write blocked on a
     # full transport for longer than this sheds the CONNECTION instead
     # of buffering without bound; 0 disables the deadline
@@ -185,6 +193,11 @@ class Env:
     # jobs queue bound (server/jobs.py): enqueues past this many
     # waiting jobs fast-fail with QueueFullError; 0 = unbounded
     max_queued_jobs: int = 1024
+    # weighted-fair tenant shares (server/jobs.py, docs/fleet.md
+    # "Fairness"): "tenant=weight,tenant2=weight" — a listed tenant's
+    # slot-grant share within its priority class is proportional to its
+    # weight; unlisted tenants default to the job-carried weight (1)
+    tenant_weights: str = ""
     # datastore replication (pxar/syncwire.py, docs/sync.md): digests
     # per membership-negotiation batch — one vectorized destination
     # probe_batch (and at most one chunk transfer round) per batch
@@ -255,9 +268,12 @@ def env() -> Env:
         agent_open_rate=_float_env(e, "PBS_PLUS_AGENT_OPEN_RATE", "0"),
         agent_max_sessions=_int_env(e, "PBS_PLUS_AGENT_MAX_SESSIONS",
                                     "4096"),
+        admission_deadline_ms=_float_env(
+            e, "PBS_PLUS_ADMISSION_DEADLINE_MS", "0"),
         mux_write_deadline_s=_float_env(e, "PBS_PLUS_MUX_WRITE_DEADLINE",
                                         "60"),
         max_queued_jobs=_int_env(e, "PBS_PLUS_MAX_QUEUED_JOBS", "1024"),
+        tenant_weights=e.get("PBS_PLUS_TENANT_WEIGHTS", ""),
         sync_batch=_int_env(e, "PBS_PLUS_SYNC_BATCH", "1024"),
         dist_index_shards=e.get("PBS_PLUS_DIST_INDEX_SHARDS", ""),
         dist_index_token=e.get("PBS_PLUS_DIST_INDEX_TOKEN", ""),
@@ -265,6 +281,27 @@ def env() -> Env:
                                         "30"),
         dist_index_map=e.get("PBS_PLUS_DIST_INDEX_MAP", ""),
     )
+
+
+def parse_tenant_weights(spec: str) -> dict[str, int]:
+    """Parse the PBS_PLUS_TENANT_WEIGHTS spec ("tenant=weight,...") into
+    a tenant → weight map.  Malformed entries are dropped, weights are
+    floored at 1 — a bad spec degrades to equal shares, never to a
+    starved tenant."""
+    out: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        tenant, _, raw = part.partition("=")
+        tenant = tenant.strip()
+        try:
+            w = int(raw.strip())
+        except ValueError:
+            continue
+        if tenant:
+            out[tenant] = max(1, w)
+    return out
 
 
 def _system_ram_gib() -> int:

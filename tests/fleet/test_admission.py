@@ -2,9 +2,14 @@
 previously-untested AgentsManager failure paths — duplicate-session
 eviction under RACING reconnects (newest wins), WaitStreamPipe
 (``wait_session``) timing out cleanly when the agent child session never
-appears — plus the registry-hygiene invariants this PR added: idle
-per-client token buckets are pruned, typed ``AdmissionRejected``
-verdicts are counted by kind.
+appears — the registry-hygiene invariants: idle per-client token
+buckets are pruned, typed ``AdmissionRejected`` verdicts are counted by
+kind — plus the deadline-admission battery (docs/fleet.md "Deadline
+admission"): bounded waits at the ceiling admit when capacity frees,
+expire into the typed ``AdmissionDeadlineError`` (kind
+``admission_deadline``, distinguishable from ``admission_queue_full``),
+and the reservation-TTL sweeper reaps slowloris strands without fresh
+traffic.
 
 Everything runs over plain-TCP loopback (``tls=None`` + the
 ``X-PBS-Plus-Loopback-CN`` identity header) so the battery needs no
@@ -17,8 +22,9 @@ import time
 import pytest
 
 from pbs_plus_tpu.arpc import AdmissionRejected, connect_to_server, serve
-from pbs_plus_tpu.arpc.agents_manager import (_BUCKET_CAP, AgentsManager,
-                                              _TokenBucket)
+from pbs_plus_tpu.arpc.agents_manager import (_BUCKET_CAP,
+                                              AdmissionDeadlineError,
+                                              AgentsManager, _TokenBucket)
 from pbs_plus_tpu.arpc.transport import HDR_LOOPBACK_CN, HandshakeError
 
 
@@ -219,6 +225,148 @@ def test_idle_client_buckets_are_pruned():
             am._buckets[f"bulk-{i}"] = b
         await am.admit({"cn": "trigger"}, {})
         assert len(am._buckets) <= _BUCKET_CAP
+
+    asyncio.run(main())
+
+
+# ------------------------------------------ deadline admission (ISSUE 19)
+
+
+def test_deadline_wait_admits_when_capacity_frees():
+    """With an admission deadline set, an admit at a full ceiling queues
+    instead of fast-failing — and is admitted the moment a session
+    unregisters within the deadline (FIFO wake, not the next sweep)."""
+    async def main():
+        am = AgentsManager(is_expected=None, rate=0, max_sessions=1,
+                           admission_deadline_ms=5000)
+
+        class _Conn:
+            closed = False
+        await am.admit({"cn": "first"}, {})
+        sess = await am.register({"cn": "first"}, {}, _Conn())
+        waiter = asyncio.create_task(am.admit({"cn": "second"}, {}))
+        await asyncio.sleep(0.05)
+        assert not waiter.done()             # queued, not rejected
+        assert am.admission_waits == 1       # wait counted, NOT a reject
+        await am.unregister(sess)            # freed slot → FIFO wake
+        await asyncio.wait_for(waiter, 2)
+        stats = am.admission_stats()
+        assert stats["admitted"] == 2
+        assert "admission_deadline" not in stats
+
+    asyncio.run(main())
+
+
+def test_deadline_expiry_raises_typed_kind():
+    """Deadline expiry is its own typed verdict: AdmissionDeadlineError
+    (an AdmissionRejected flavor) with kind "admission_deadline" and
+    code 503, counted apart from session_limit — and the wait really
+    spans the configured bound instead of failing fast."""
+    async def main():
+        am = AgentsManager(is_expected=None, rate=0, max_sessions=1,
+                           admission_deadline_ms=150)
+
+        class _Conn:
+            closed = False
+        await am.admit({"cn": "holder"}, {})
+        await am.register({"cn": "holder"}, {}, _Conn())
+        t0 = time.monotonic()
+        with pytest.raises(AdmissionDeadlineError) as ei:
+            await am.admit({"cn": "late"}, {})
+        elapsed = time.monotonic() - t0
+        assert isinstance(ei.value, AdmissionRejected)
+        assert (ei.value.code, ei.value.kind) == (503, "admission_deadline")
+        assert "deadline" in ei.value.reason
+        assert 0.1 <= elapsed < 5.0
+        stats = am.admission_stats()
+        assert stats.get("admission_deadline") == 1
+        assert "session_limit" not in stats
+        assert not am._admit_waiters         # no leaked waiter future
+
+    asyncio.run(main())
+
+
+def test_deadline_queue_full_is_distinct_kind():
+    """The waiter queue is itself bounded: past admit_queue_cap the
+    reject is kind "admission_queue_full" — a fast-fail distinguishable
+    from a deadline expiry, so operators can tell 'waited and lost' from
+    'never got to wait'."""
+    async def main():
+        am = AgentsManager(is_expected=None, rate=0, max_sessions=1,
+                           admission_deadline_ms=5000, admit_queue_cap=2)
+
+        class _Conn:
+            closed = False
+        await am.admit({"cn": "holder"}, {})
+        await am.register({"cn": "holder"}, {}, _Conn())
+        waiters = [asyncio.create_task(am.admit({"cn": f"w-{i}"}, {}))
+                   for i in range(2)]
+        await asyncio.sleep(0.05)            # both queued
+        t0 = time.monotonic()
+        with pytest.raises(AdmissionRejected) as ei:
+            await am.admit({"cn": "overflow"}, {})
+        assert ei.value.kind == "admission_queue_full"
+        assert not isinstance(ei.value, AdmissionDeadlineError)
+        assert time.monotonic() - t0 < 1.0   # fast-fail, no wait
+        assert am.admission_stats()["admission_queue_full"] == 1
+        for w in waiters:
+            w.cancel()
+        await asyncio.gather(*waiters, return_exceptions=True)
+
+    asyncio.run(main())
+
+
+def test_reservation_ttl_sweep_frees_slowloris_capacity():
+    """A slowloris handshake (admit, never register) pins a ceiling slot
+    only for reservation_ttl_s: the sweeper reaps the stale reservation
+    WITHOUT any fresh admit traffic, counts it in reservations_reaped,
+    and hands the freed capacity to a queued deadline waiter."""
+    async def main():
+        am = AgentsManager(is_expected=None, rate=0, max_sessions=1,
+                           admission_deadline_ms=10_000)
+        am.reservation_ttl_s = 0.15
+        await am.admit({"cn": "loris"}, {})  # admitted, never registers
+        assert len(am._admit_reservations) == 1
+        waiter = asyncio.create_task(am.admit({"cn": "honest"}, {}))
+        await asyncio.sleep(0.05)
+        assert not waiter.done()             # strand still pins the slot
+        await asyncio.wait_for(waiter, 5)    # sweeper reaped → woken
+        assert am.reservations_reaped >= 1
+        assert am.admission_stats()["admitted"] == 2
+        # let the honest reservation expire too so the self-terminating
+        # sweeper exits before the loop closes
+        am.reservation_ttl_s = 0.01
+        for _ in range(200):
+            if not am._admit_reservations and (
+                    am._sweeper is None or am._sweeper.done()):
+                break
+            await asyncio.sleep(0.02)
+        assert not am._admit_reservations
+
+    asyncio.run(main())
+
+
+def test_deadline_reject_wire_code_and_reason():
+    """Over the wire a deadline expiry is the same 503 handshake
+    rejection frame, with "deadline" in the reason — the contract the
+    fleet soak's deadline probe keys on."""
+    async def main():
+        am = AgentsManager(is_expected=None, rate=1000, burst=1000,
+                           max_sessions=1, admission_deadline_ms=100)
+        srv, port = await _start(am)
+        c0 = await connect_to_server("127.0.0.1", port, None,
+                                     headers={HDR_LOOPBACK_CN: "h-0"},
+                                     keepalive_s=0)
+        await asyncio.sleep(0.1)             # let it register
+        with pytest.raises(HandshakeError) as ei:
+            await connect_to_server("127.0.0.1", port, None,
+                                    headers={HDR_LOOPBACK_CN: "h-wait"},
+                                    keepalive_s=0)
+        assert ei.value.code == 503
+        assert "deadline" in ei.value.reason
+        await c0.close()
+        srv.close()
+        await srv.wait_closed()
 
     asyncio.run(main())
 

@@ -1,11 +1,14 @@
 """JobsManager fairness + bounded-queue + breaker-hygiene battery
-(docs/fleet.md "Fairness"): strict priority classes over round-robin
-tenants, typed QueueFullError past the configured bound, and the
-breaker-registry eviction rules this PR added.  (The noisy-tenant
-starvation bound lives in test_fleet_chaos.py.)
+(docs/fleet.md "Fairness"): strict priority classes over
+deficit-weighted round-robin tenants — including the ±10 %
+proportionality property over randomized tenant/weight mixes — typed
+QueueFullError past the configured bound, and the breaker-registry
+eviction rules.  (The noisy-tenant starvation bound lives in
+test_fleet_chaos.py.)
 """
 
 import asyncio
+import random
 import time
 
 import pytest
@@ -14,12 +17,13 @@ from pbs_plus_tpu.server.jobs import Job, JobsManager, QueueFullError
 from pbs_plus_tpu.utils.resilience import CircuitBreaker
 
 
-def _job(jm, name, tenant, done, *, priority=0, hold=None):
+def _job(jm, name, tenant, done, *, priority=0, weight=1, hold=None):
     async def run():
         if hold is not None:
             await hold.wait()
         done.append(name)
-    return Job(id=name, tenant=tenant, priority=priority, execute=run)
+    return Job(id=name, tenant=tenant, priority=priority, weight=weight,
+               execute=run)
 
 
 def test_round_robin_across_tenants():
@@ -101,6 +105,139 @@ def test_tenant_running_gauge_tracks_slots():
         gate.set()
         await jm.drain(timeout=30)
         assert jm.tenant_active() == {} and jm.running_count == 0
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------- weighted shares
+
+
+def _backlogged_prefix(order, pending):
+    """Longest prefix of the grant order during which EVERY tenant still
+    had queued work — the only window where proportional shares are
+    defined (after a tenant drains, the others rightly absorb its
+    share)."""
+    left = dict(pending)
+    prefix = []
+    for t in order:
+        prefix.append(t)
+        left[t] -= 1
+        if left[t] == 0:
+            break
+    return prefix
+
+
+def test_weighted_shares_three_to_one():
+    """docs/fleet.md "Fairness": while both tenants stay backlogged, a
+    weight-3 tenant lands ~3x the contended grants of a weight-1 tenant
+    (±10 %), and tenant_grants records exactly the contended grants."""
+    async def main():
+        jm = JobsManager(max_concurrent=1, max_queued=0)
+        done: list[str] = []
+        gate = asyncio.Event()
+        jm.enqueue(_job(jm, "warm", "seed", done, hold=gate))
+        await asyncio.sleep(0)
+        for i in range(40):
+            jm.enqueue(_job(jm, f"heavy-{i}", "heavy", done, weight=3))
+            jm.enqueue(_job(jm, f"light-{i}", "light", done, weight=1))
+        gate.set()
+        await jm.drain(timeout=30)
+        order = [n.split("-")[0] for n in done if n != "warm"]
+        prefix = _backlogged_prefix(order, {"heavy": 40, "light": 40})
+        heavy, light = prefix.count("heavy"), prefix.count("light")
+        assert heavy + light == len(prefix)
+        assert abs(heavy - 3 * light) <= max(1, round(0.1 * len(prefix))), \
+            (heavy, light)
+        # every backlogged grant was contended → counted per tenant; the
+        # warm job took the uncontended fast path → carries no signal
+        assert jm.tenant_grants["heavy"] == 40
+        assert jm.tenant_grants["light"] == 40
+        assert "seed" not in jm.tenant_grants
+
+    asyncio.run(main())
+
+
+def test_weighted_shares_randomized_mixes():
+    """Property over randomized tenant counts and weights: in every mix
+    the all-backlogged prefix splits grants proportionally to the
+    EFFECTIVE weights within ±10 % (plus one-grant quantization) —
+    whether the weight rides on the jobs (DB-plumbed Job.weight) or on
+    the operator map (PBS_PLUS_TENANT_WEIGHTS)."""
+    async def main():
+        rng = random.Random(0xF19)
+        for trial in range(4):
+            n_tenants = rng.randint(2, 4)
+            weights = {f"t{j}": rng.randint(1, 4)
+                       for j in range(n_tenants)}
+            use_operator = trial % 2 == 1
+            k = 10 * max(weights.values())   # ≥10 full DRR cycles in
+            jm = JobsManager(                # the backlogged window
+                max_concurrent=1, max_queued=0,
+                tenant_weights=weights if use_operator else None)
+            done: list[str] = []
+            gate = asyncio.Event()
+            jm.enqueue(_job(jm, "warm", "seed", done, hold=gate))
+            await asyncio.sleep(0)
+            batch = [(t, i) for t in weights for i in range(k)]
+            rng.shuffle(batch)
+            for t, i in batch:
+                w = 1 if use_operator else weights[t]
+                jm.enqueue(_job(jm, f"{t}-{i}", t, done, weight=w))
+            gate.set()
+            await jm.drain(timeout=60)
+            order = [n.split("-")[0] for n in done if n != "warm"]
+            prefix = _backlogged_prefix(order, {t: k for t in weights})
+            total_w = sum(weights.values())
+            for t, w in weights.items():
+                expected = len(prefix) * w / total_w
+                got = prefix.count(t)
+                assert abs(got - expected) <= 0.1 * expected + 1, \
+                    (trial, weights, use_operator, t, got, expected)
+
+    asyncio.run(main())
+
+
+def test_priority_class_preempts_weighted_shares():
+    """Strict priority still beats weight: a priority-0 job from a
+    weight-1 tenant is granted ahead of a weight-9 priority-1 backlog,
+    however deep the heavy tenant's credit."""
+    async def main():
+        jm = JobsManager(max_concurrent=1, max_queued=0)
+        done: list[str] = []
+        gate = asyncio.Event()
+        jm.enqueue(_job(jm, "warm", "bulk", done, hold=gate))
+        await asyncio.sleep(0)
+        for i in range(6):
+            jm.enqueue(_job(jm, f"bulk-{i}", "bulk", done,
+                            priority=1, weight=9))
+        jm.enqueue(_job(jm, "urgent", "ops", done, priority=0, weight=1))
+        gate.set()
+        await jm.drain(timeout=30)
+        assert done[1] == "urgent", done      # first grant after warm
+
+    asyncio.run(main())
+
+
+def test_operator_weights_override_job_carried_weight():
+    """An operator tenant_weights pin wins over Job.weight: jobs that
+    CLAIM weight 5 are flattened back to parity, and the floor keeps a
+    zero/negative weight from erasing a tenant."""
+    async def main():
+        jm = JobsManager(max_concurrent=1, max_queued=0,
+                         tenant_weights={"greedy": 1, "meek": 1})
+        assert jm._weight_of("x", Job(id="j", weight=-3)) == 1  # floor
+        done: list[str] = []
+        gate = asyncio.Event()
+        jm.enqueue(_job(jm, "warm", "seed", done, hold=gate))
+        await asyncio.sleep(0)
+        for i in range(20):
+            jm.enqueue(_job(jm, f"greedy-{i}", "greedy", done, weight=5))
+            jm.enqueue(_job(jm, f"meek-{i}", "meek", done, weight=1))
+        gate.set()
+        await jm.drain(timeout=30)
+        order = [n.split("-")[0] for n in done if n != "warm"]
+        prefix = _backlogged_prefix(order, {"greedy": 20, "meek": 20})
+        assert abs(prefix.count("greedy") - prefix.count("meek")) <= 1
 
     asyncio.run(main())
 

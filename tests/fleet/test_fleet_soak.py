@@ -1,12 +1,15 @@
-"""Fleet-scale soak (ISSUE 7 tentpole; docs/fleet.md): hundreds of
-simulated agents speak real aRPC over plain-TCP loopback through
-MuxConnection + AgentsManager, each running a small synthetic backup
-through the real jobs plane (fair dequeue, breakers, bounded queue) into
-a real datastore.
+"""Fleet-scale soak (ISSUE 7 tentpole; docs/fleet.md): hundreds to two
+thousand simulated agents speak real aRPC over plain-TCP loopback
+through MuxConnection + AgentsManager, each running a small synthetic
+backup through the real jobs plane (fair dequeue, weighted shares,
+breakers, bounded queue) into a real datastore — plus the ISSUE 19
+mixed-traffic profile: multiple backup waves per agent, keepalive
+churn, restore/verify/sync lanes through the same execution slots, and
+all five hostile profiles attacking concurrently.
 
 The default pytest loop runs N=100 (seconds on a 1-core host); the
 N=500 acceptance profile is ``slow``-marked and also reachable via
-``PBS_PLUS_FLEET=1``:
+``PBS_PLUS_FLEET=1``; the N=2000 survival profile needs BOTH:
 
     PBS_PLUS_FLEET=1 python -m pytest tests/fleet/ -q -m slow
 """
@@ -156,6 +159,103 @@ def test_fleet_hostile_slow_reader_profile(tmp_path):
     # write deadline (the kernel may coalesce the two floods' timing,
     # so ≥1 is the structural floor)
     assert d["server_write_deadline_sheds"] >= 1
+
+
+def _mixed_cfg(n_agents: int, **kw) -> FleetConfig:
+    """The ISSUE 19 survival composition: multi-wave backups with
+    churn, restore/verify/sync lanes, weighted tenants, and all five
+    hostile profiles in one run."""
+    base = dict(
+        n_agents=n_agents, tenants=8, max_concurrent=8,
+        max_queued=4 * n_agents,
+        jobs_per_agent=2, churn_fraction=0.1,
+        restore_jobs=max(4, n_agents // 10),
+        verify_jobs=max(4, n_agents // 10),
+        sync_jobs=4,
+        hostile_agents=5,
+        hostile_profiles=("flood,slow_reader,reconnect_storm,"
+                          "length_liar,slowloris"),
+        # a 20s reservation TTL would stall the slowloris strand wait;
+        # a 60s write deadline would stall the slow-reader shed
+        reservation_ttl_s=1.0,
+        mux_write_deadline_s=2.0,
+        tenant_weights="tenant-0=3,tenant-1=2",
+    )
+    base.update(kw)
+    return FleetConfig(**base)
+
+
+def _mixed_assertions(cfg: FleetConfig, rep, d: dict) -> None:
+    # every wave of every legit agent published; nothing failed
+    assert d["published"] == cfg.n_agents * cfg.jobs_per_agent, \
+        rep.failures
+    assert not rep.failures
+    # mixed-traffic lanes all completed through the same slots
+    assert d["restore_completed"] == cfg.restore_jobs, \
+        rep.restore_failures
+    assert d["restore_failed"] == 0
+    assert d["verify_completed"] == cfg.verify_jobs, rep.verify_failures
+    assert d["verify_failed"] == 0
+    # sync_jobs concurrent rounds plus the final catch-up pass
+    assert d["sync_completed"] >= cfg.sync_jobs, rep.sync_failures
+    assert d["sync_failed"] == 0
+    # keepalive churn really dropped and redialed control transports
+    assert d["churned"] >= 1
+    # all five hostile profiles ran and each left its server-side mark:
+    # flood → RX-credit reset; slow_reader → write-deadline shed;
+    # length_liar → typed StreamLengthError counted per-conn and the
+    # liar's backup failing in ITS lane (never report.failures);
+    # reconnect_storm → newest-wins evictions; slowloris → stranded
+    # reservations reaped by the TTL sweeper
+    assert d["hostile_run"] == cfg.hostile_agents
+    assert d["server_flow_violations"] >= 1
+    assert d["server_write_deadline_sheds"] >= 1
+    assert d["server_stream_length_violations"] >= 1
+    assert d["hostile_liar_errors"] >= 1
+    assert d["hostile_liar_published"] == 0
+    assert d["evictions"] >= 1
+    assert d["reservations_reaped"] >= cfg.hostile_slowloris_rounds
+    # weighted shares: the pinned tenants took part in contended grants
+    # and NO tenant starved (every backup lane landed grants); the ±10%
+    # proportionality property itself is test_fairness.py's job — a
+    # live soak's backlogs come and go, so only starvation-freedom is a
+    # stable assertion here
+    for t in range(cfg.tenants):
+        assert rep.tenant_grants.get(f"tenant-{t}", 0) > 0, \
+            rep.tenant_grants
+    # latency still measured and ordered under abuse
+    assert 0 < d["enqueue_to_publish_p50_s"] <= d["enqueue_to_publish_p99_s"]
+    # bounds held through the whole mixed run
+    assert not d["bound_violated"]
+    assert d["queued_max"] <= cfg.max_queued
+
+
+def test_fleet_soak_mixed_traffic_hostiles(tmp_path):
+    """ISSUE 19: the N=100 survival soak — two backup waves per agent
+    with keepalive churn, restore + verify + sync lanes concurrent with
+    the backups, weighted tenants, and all five hostile profiles
+    (flood, slow_reader, reconnect_storm, length_liar, slowloris)
+    attacking the same listener.  Every legit job publishes, every
+    attack is observed server-side, every bound holds."""
+    cfg = _mixed_cfg(100)
+    rep = run_fleet(str(tmp_path / "ds"), cfg)
+    _mixed_assertions(cfg, rep, rep.to_dict())
+
+
+@pytest.mark.slow
+def test_fleet_survival_n2000(tmp_path):
+    """ISSUE 19 tentpole profile: N=2000 agents, two waves each (4000
+    backups), churn, mixed traffic, and the full hostile composition —
+    the scaled survival acceptance, opt-in via PBS_PLUS_FLEET=1 (see
+    tools/verify_lint.sh)."""
+    if not FULL:
+        pytest.skip("set PBS_PLUS_FLEET=1 for the N=2000 profile")
+    cfg = _mixed_cfg(2000, tenants=16, max_concurrent=16,
+                     connect_concurrency=64, hostile_agents=10,
+                     restore_jobs=40, verify_jobs=40, sync_jobs=8,
+                     churn_fraction=0.05, job_timeout_s=900.0)
+    rep = run_fleet(str(tmp_path / "ds"), cfg)
+    _mixed_assertions(cfg, rep, rep.to_dict())
 
 
 def test_fleet_open_rate_causes_typed_rejects(tmp_path):

@@ -1,10 +1,21 @@
-"""Two-process shared-datastore soak (ISSUE 15 acceptance): two REAL
-server subprocesses (server/fleetproc.py) over one datastore directory
-and one SQLite database, agents dialing each over loopback aRPC.
+"""Two-process shared-datastore soak (ISSUE 15 acceptance, grown into
+the ISSUE 19 combined survival soak): two REAL server subprocesses
+(server/fleetproc.py) over one datastore directory and one SQLite
+database, agents dialing each over loopback aRPC.
 
 Asserted end to end:
 - every job enqueued in either process publishes through the ONE
-  shared bounded queue;
+  shared bounded queue — across TWO backup waves per agent, with
+  RESTORE (hash-verified read-back), VERIFY and SYNC lanes riding
+  concurrently with the final wave;
+- hostiles from all five profiles (flood, slow_reader,
+  reconnect_storm, length_liar, slowloris) attack worker 0 during the
+  waves: the lying stream is a typed failure in its OWN lane, the
+  storm's evictions and the slowloris strands are counted, and the
+  TTL sweep frees every stranded reservation;
+- weighted-fair shares hold ±10% in the deterministic in-worker fair
+  probe (plug → backlog → release), and p99 enqueue-to-publish stays
+  measured and bounded on both workers;
 - every shared chunk is written exactly once across both processes
   (the os.link claim; dedup accounting summed across both /metrics);
 - GC fires exactly once per cycle under the leader lease (winner
@@ -12,7 +23,10 @@ Asserted end to end:
 - SIGKILLing the leader mid-sweep (a delay failpoint holds the sweep
   open with the lease held) fails over within ~one lease TTL: the
   survivor STEALS the expired lease, the sweep completes, zero
-  double-unlinks, zero resurrected digests, zero lost live chunks.
+  double-unlinks, zero resurrected digests, zero lost live chunks;
+- the post-failover survivor still runs DEADLINE admission: a filler
+  storm waits out the bounded deadline into the typed 503, and the
+  reject lands in the shared admission counters.
 """
 
 import os
@@ -24,18 +38,88 @@ from pbs_plus_tpu.server.fleetsim import (MultiProcConfig,
 
 FULL = bool(os.environ.get("PBS_PLUS_FLEET"))
 
+_PROFILES = "flood,slow_reader,reconnect_storm,length_liar,slowloris"
+
+
+def _fair_shares(order: list, jobs_per_tenant: int,
+                 weights: dict) -> None:
+    """±10% proportionality over the all-backlogged prefix of the fair
+    probe's contended grant order (once a tenant drains, the others
+    rightly absorb its share, so only the prefix is gated)."""
+    left = {t: jobs_per_tenant for t in weights}
+    prefix: list = []
+    for t in order:
+        prefix.append(t)
+        left[t] -= 1
+        if left[t] == 0:
+            break
+    total_w = sum(weights.values())
+    for t, w in weights.items():
+        expected = len(prefix) * w / total_w
+        got = prefix.count(t)
+        assert abs(got - expected) <= 0.1 * expected + 1, \
+            (t, got, expected, order)
+
 
 def _soak(tmp_path, n_agents: int) -> dict:
-    cfg = MultiProcConfig(n_agents=n_agents, gc_ttl_s=2.0,
-                          kill_slow_sweep_s=8.0, kill_leader=True)
+    cfg = MultiProcConfig(
+        n_agents=n_agents, gc_ttl_s=2.0,
+        kill_slow_sweep_s=8.0, kill_leader=True,
+        # ISSUE 19 combined-soak composition
+        jobs_per_agent=2,
+        restore_jobs=min(4, n_agents), verify_jobs=min(4, n_agents),
+        sync_jobs=2,
+        hostile_agents=5, hostile_profiles=_PROFILES,
+        tenant_weights="tenant-0=3",
+        admission_deadline_ms=500.0,
+        reservation_ttl_s=1.0,
+        fair_probe=True, deadline_probe=True)
     rep = run_multiproc_fleet(str(tmp_path), cfg)
     d = rep.to_dict()
 
-    # every job published through the shared queue, none failed
-    assert d["published"] == cfg.processes * n_agents, rep.failures
+    # every wave of every job published through the shared queue
+    assert d["published"] == \
+        cfg.processes * n_agents * cfg.jobs_per_agent, rep.failures
     assert d["failed"] == 0
     assert d["queue_counts"].get("queued", 0) == 0
     assert d["queue_counts"].get("running", 0) == 0
+
+    # mixed lanes all completed concurrently with the final wave; each
+    # restore's rebuilt tree hashed identical to the agent's source
+    assert d["restore_completed"] == cfg.restore_jobs, rep.failures
+    assert d["restore_failed"] == 0
+    assert d["verify_completed"] == cfg.verify_jobs, rep.failures
+    assert d["verify_failed"] == 0
+    assert d["sync_completed"] == cfg.sync_jobs, rep.failures
+    assert d["sync_failed"] == 0
+
+    # all five hostile profiles ran against worker 0 and left their
+    # marks: the liar's backup failed TYPED in its own lane (never the
+    # legit failure map), its lying stream was counted by the mux, the
+    # storm's redials evicted, the slowloris strands were reaped
+    assert d["hostile_run"] == cfg.hostile_agents
+    assert d["hostile_liar_published"] == 0
+    assert d["hostile_liar_errors"] >= 1
+    assert "StreamLengthError" in " ".join(rep.hostile_liar_errors)
+    assert d["stream_length_violations"] >= 1
+    assert d["evictions"] >= 1
+    assert d["reservations_reaped"] >= cfg.hostile_slowloris_rounds
+    for jid in rep.failures:
+        assert not jid.startswith("liar-")   # liar never leaks over
+
+    # weighted-fair shares ±10% in the deterministic contended window
+    assert rep.fair_order, d
+    _fair_shares(rep.fair_order, 12,
+                 {"fp-heavy": 3, "fp-mid": 2, "fp-light": 1})
+    # zero starvation: every probe tenant landed grants, and the soak
+    # tenants' contended grants were recorded per worker
+    assert set(rep.fair_order) == {"fp-heavy", "fp-mid", "fp-light"}
+    assert sum(sum(g.values()) for g in d["tenant_grants"].values()) > 0
+
+    # p99 enqueue-to-publish measured and bounded on both workers
+    # (collected pre-kill, so the dead leader's histogram counts too)
+    for proc, p99 in d["enqueue_p99"].items():
+        assert 0 < p99 <= 60.0, (proc, p99)
 
     # written exactly once fleet-wide: Σ per-process chunks_written ==
     # distinct chunk files ever created (now on disk + swept), and the
@@ -63,6 +147,12 @@ def _soak(tmp_path, n_agents: int) -> dict:
     assert d["doomed_on_disk"] == 0
     assert d["doomed_resurrected"] == 0
     assert d["live_missing"] == 0
+
+    # deadline admission still runs on the post-failover survivor: the
+    # filler storm's last dial WAITED and got the typed 503, and the
+    # verdict landed in the shared admission counters
+    assert d["deadline_rejects_seen"] >= 1, d
+    assert d["deadline_rejects_counted"] >= 1, d
 
     # the per-service lock ladder measured on the survivor: both the
     # prune lock and the jobqueue startup serialization were exercised

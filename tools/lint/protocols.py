@@ -135,7 +135,7 @@ BOUNDARIES = (
      "modules": ("pbs_plus_tpu/server/fleetproc.py",
                  "pbs_plus_tpu/server/services/prune_service.py"),
      "taxonomy": ("GCLeaseHeldError", "PruneDeferredError",
-                  "QueueFullError")},
+                  "QueueFullError", "FleetLaneError")},
     {"name": "web",
      "modules": ("pbs_plus_tpu/server/web.py",),
      "taxonomy": ("ValidationError", "QueueFullError")},
@@ -152,4 +152,7 @@ TYPED_ERRORS = (
     "pbs_plus_tpu/server/services/prune_service.py::PruneDeferredError",
     "pbs_plus_tpu/server/jobs.py::QueueFullError",
     "pbs_plus_tpu/utils/validate.py::ValidationError",
+    "pbs_plus_tpu/arpc/binary_stream.py::StreamLengthError",
+    "pbs_plus_tpu/arpc/agents_manager.py::AdmissionDeadlineError",
+    "pbs_plus_tpu/server/fleetproc.py::FleetLaneError",
 )

@@ -62,4 +62,13 @@ else
     echo "== ruff not installed; skipped (pbslint is the gate of record) =="
 fi
 
+# scaled fleet acceptance, opt-in: the N=2000 survival soak, the N=500
+# chaos composition and the full two-process combined soak all ride the
+# slow marker and the PBS_PLUS_FLEET gate (docs/fleet.md "Scaled
+# acceptance profiles") — minutes of wall clock, so never implicit
+if [ -n "${PBS_PLUS_FLEET:-}" ]; then
+    echo "== fleet survival profiles (PBS_PLUS_FLEET, -m slow) =="
+    JAX_PLATFORMS=cpu python -m pytest tests/fleet/ -q -m slow
+fi
+
 echo "verify_lint: OK"
