@@ -1439,6 +1439,251 @@ def _fleet_bench(n_agents: int | None = None) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _mountserve_bench(*, n_snapshots: int | None = None,
+                      files_per_snapshot: int = 2,
+                      file_size: int = 192 << 10,
+                      chunk_avg: int = 16 << 10,
+                      cache_kib: int = 256,
+                      zipf_trace_len: int = 1200,
+                      zipf_s: float = 1.1,
+                      seed: int = 7) -> dict:
+    """Mount-serve read-plane benchmark (ISSUE 20; docs/data-plane.md
+    "Read path"): the sharded scan-resistant cache + adaptive readahead
+    under the serving workload shape — a Zipf-hot working set of mount
+    reads with full sequential restore scans barreling through the same
+    cache, concurrent with backup ingest.
+
+    The host is 1-core, so every gate is an ALGORITHMIC ratio from the
+    cache counters and the shared /metrics histograms (no wall-clock
+    thresholds):
+    - ``zipf_hit_ratio`` vs ``lru_hit_ratio``: the same chunk trace
+      replayed through the sharded segmented-LRU cache and through an
+      in-bench plain-LRU reference — scan resistance must win strictly.
+    - ``hot_hit_ratio_before``/``under_scan``: a promoted hot set
+      probed while a full sequential scan runs concurrently through
+      the same cache; degradation bounded.
+    - ``seq_amplification``: store bytes loaded / distinct chunk bytes
+      for one sequential restore with adaptive readahead on (~1.0 —
+      readahead never reads past the index, single-flight dedups).
+    - ``readahead_precision``: prefetch_used / prefetch_issued for the
+      sequential scan.
+    - ``ingest_published``/``readserve_completed``: a small fleetsim
+      mix (tenant="readserve" readers vs backup ingest through the
+      same admission/fairness lanes) — zero starvation both ways.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+    from pbs_plus_tpu.chunker import ChunkerParams
+    from pbs_plus_tpu.pxar import chunkcache
+    from pbs_plus_tpu.pxar.backupproxy import LocalStore
+    from pbs_plus_tpu.pxar.format import KIND_DIR, KIND_FILE, Entry
+    from pbs_plus_tpu.server import metrics
+    from pbs_plus_tpu.server.fleetsim import (FleetConfig, run_fleet,
+                                              zipf_rank)
+
+    n_snapshots = n_snapshots or int(
+        os.environ.get("PBS_PLUS_BENCH_MOUNTSERVE_N", "6"))
+    params = ChunkerParams(avg_size=chunk_avg)
+    rng = np.random.default_rng(seed)
+    import random as _random
+    prng = _random.Random(seed)
+    fetch_base = metrics.HISTOGRAMS[
+        "pbs_plus_chunk_cache_fetch_seconds"].snapshot()
+    tmp = tempfile.mkdtemp(prefix="pbs-mountserve-bench-")
+    try:
+        import io
+        store = LocalStore(os.path.join(tmp, "ds"), params)
+        refs = []
+        for si in range(n_snapshots):
+            sess = store.start_session(backup_type="host",
+                                       backup_id=f"ms{si:02d}")
+            sess.writer.write_entry(Entry(path="", kind=KIND_DIR))
+            for fi in range(files_per_snapshot):
+                blob = rng.integers(0, 256, file_size,
+                                    dtype=np.uint8).tobytes()
+                sess.writer.write_entry_reader(
+                    Entry(path=f"f{fi}.bin", kind=KIND_FILE,
+                          size=len(blob)), io.BytesIO(blob))
+            sess.finish()
+            refs.append(sess.ref)
+
+        chunks = store.datastore.chunks
+        readers = [store.open_snapshot(r, cache=chunkcache.ChunkCache(0))
+                   for r in refs]
+        # every distinct payload chunk, snapshot-ordered (the sequential
+        # scan); sizes for the amplification denominator
+        all_digests = []
+        seen = set()
+        for rd in readers:
+            idx = rd.payload_index
+            for ci in range(len(idx)):
+                d = idx.digest(ci)
+                if d not in seen:
+                    seen.add(d)
+                    all_digests.append(d)
+        sizes = {d: len(chunks.get(d)) for d in all_digests}
+
+        # -- (1) Zipf + periodic scans: sharded SLRU vs plain-LRU replay
+        # hot ranks re-referenced Zipf-style, a full one-pass scan
+        # injected every ~third of the trace (the restore storms)
+        trace_ = []
+        scan_every = max(1, zipf_trace_len // 3)
+        for t in range(zipf_trace_len):
+            trace_.append(all_digests[
+                zipf_rank(prng, len(all_digests), zipf_s)])
+            if t and t % scan_every == 0:
+                trace_.extend(all_digests)      # sequential scan burst
+
+        budget = cache_kib << 10
+        cache = chunkcache.ChunkCache(budget, shards=4,
+                                      readahead_chunks=0)
+        zstats = {"hits": 0, "misses": 0}
+        for d in trace_:
+            cache.get(chunks, d, zstats)
+        zipf_hit_ratio = zstats["hits"] / len(trace_)
+
+        lru: dict = {}
+        lru_size = 0
+        lru_hits = 0
+        for d in trace_:
+            if d in lru:
+                lru_hits += 1
+                lru[d] = lru.pop(d)
+            elif sizes[d] <= budget:
+                lru[d] = sizes[d]
+                lru_size += sizes[d]
+                while lru_size > budget:
+                    lru_size -= lru.pop(next(iter(lru)))
+        lru_hit_ratio = lru_hits / len(trace_)
+
+        # -- (2) hot-set hit ratio under a CONCURRENT sequential scan --
+        # hot set sized to fit each segment's protected region with
+        # digest-shard skew (the property under test is scan eviction,
+        # not capacity thrash); the scan set still dwarfs the budget
+        hot = sorted({all_digests[zipf_rank(prng, len(all_digests),
+                                            zipf_s)]
+                      for _ in range(200)},
+                     key=all_digests.index)[:max(4, len(all_digests) // 16)]
+        cache2 = chunkcache.ChunkCache(2 * budget, shards=4,
+                                       readahead_chunks=0)
+        for _ in range(2):                      # admit, then promote
+            for d in hot:
+                cache2.get(chunks, d)
+        before = {"hits": 0, "misses": 0}
+        for d in hot:
+            cache2.get(chunks, d, before)
+        hot_before = before["hits"] / max(1, sum(before.values()))
+
+        scans_done = threading.Event()
+
+        def _scan():
+            try:
+                for _ in range(2):
+                    for d in all_digests:       # one-pass cold scans
+                        cache2.get(chunks, d)
+            finally:
+                scans_done.set()
+
+        scanner = threading.Thread(target=_scan)
+        scanner.start()
+        under = {"hits": 0, "misses": 0}
+        while not scans_done.is_set():
+            for d in hot:
+                cache2.get(chunks, d, under)
+        scanner.join()
+        for d in hot:                           # and after it passed
+            cache2.get(chunks, d, under)
+        hot_under_scan = under["hits"] / max(1, sum(under.values()))
+
+        # -- (3) sequential restore: amplification + readahead precision
+        class _ByteCountingStore:
+            def __init__(self, inner):
+                self.inner = inner
+                self.bytes_read = 0
+                self._lock = threading.Lock()
+
+            def get(self, digest):
+                data = self.inner.get(digest)
+                with self._lock:
+                    self.bytes_read += len(data)
+                return data
+
+        counting = _ByteCountingStore(chunks)
+        seq_cache = chunkcache.ChunkCache(256 << 20, readahead_chunks=4,
+                                          readahead_max=32)
+        logical = 0
+        window = 32 << 10
+        for ref in refs:
+            rd = store.open_snapshot(ref, cache=seq_cache)
+            rd.store = counting
+            for e in rd.entries():
+                if not e.is_file:
+                    continue
+                # the paced mount-reader shape: window-sized pump with
+                # the prefetch pool allowed to stay ahead (on a 1-core
+                # host an unpaced read races its own readahead and the
+                # precision measurement collapses into the race)
+                fobj, _n = rd.file_reader(e)
+                while True:
+                    piece = fobj.read(window)
+                    if not piece:
+                        break
+                    logical += len(piece)
+                    seq_cache.drain()
+        seq_cache.drain()
+        distinct_bytes = sum(sizes.values())
+        seq_snap = seq_cache.snapshot()
+        seq_amplification = counting.bytes_read / max(1, distinct_bytes)
+        precision = (seq_snap["prefetch_used"]
+                     / max(1, seq_snap["prefetch_issued"]))
+
+        # -- (4) read+ingest mix through the real fairness lanes -------
+        fleet_cfg = FleetConfig(
+            n_agents=4, tenants=2, max_concurrent=4, max_queued=64,
+            file_size=32 << 10, chunk_avg=8 << 10,
+            readserve_readers=8, readserve_reads=4, seed=seed)
+        rep = run_fleet(os.path.join(tmp, "fleet-ds"), fleet_cfg)
+        fleet = rep.to_dict()
+
+        fetch_hist = metrics.HISTOGRAMS[
+            "pbs_plus_chunk_cache_fetch_seconds"]
+        return {
+            "n_snapshots": n_snapshots,
+            "payload_chunks": len(all_digests),
+            "cache_budget_kib": cache_kib,
+            "trace_len": len(trace_),
+            "zipf_hit_ratio": round(zipf_hit_ratio, 4),
+            "lru_hit_ratio": round(lru_hit_ratio, 4),
+            "scan_resistance_gain": round(
+                zipf_hit_ratio - lru_hit_ratio, 4),
+            "probation_admits": cache.snapshot()["probation_admits"],
+            "probation_promotions":
+                cache.snapshot()["probation_promotions"],
+            "hot_hit_ratio_before": round(hot_before, 4),
+            "hot_hit_ratio_under_scan": round(hot_under_scan, 4),
+            "hot_set_chunks": len(hot),
+            "seq_amplification": round(seq_amplification, 4),
+            "seq_logical_mib": round(logical / (1 << 20), 2),
+            "readahead_precision": round(precision, 4),
+            "readahead_window_max": seq_snap["readahead_window"],
+            "fetch_p50_ms": round(1e3 * fetch_hist.quantile(
+                0.50, since=fetch_base), 3),
+            "fetch_p99_ms": round(1e3 * fetch_hist.quantile(
+                0.99, since=fetch_base), 3),
+            "ingest_published": fleet["published"],
+            "ingest_failed": fleet["failed"],
+            "readserve_completed": fleet["readserve_completed"],
+            "readserve_failed": fleet["readserve_failed"],
+            "readserve_cache_hits":
+                fleet["readserve_cache"].get("hits", 0),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 from pbs_plus_tpu.utils.jaxdev import probe_relay  # shared tunnel probe
 
 
@@ -1765,6 +2010,13 @@ def main() -> None:
         read = None
     if read is not None:
         result["detail"]["read"] = read
+    try:
+        mountserve = _mountserve_bench()
+    except Exception as e:
+        sys.stderr.write(f"[bench] mountserve bench unavailable: {e}\n")
+        mountserve = None
+    if mountserve is not None:
+        result["detail"]["mountserve"] = mountserve
     try:
         fleet = _fleet_bench()
     except Exception as e:

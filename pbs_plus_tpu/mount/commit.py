@@ -180,9 +180,15 @@ class CommitEngine:
                 raise RuntimeError(f"journal integrity: {problems[:5]}")
 
             prog.emit("prepare")
+            # the previous-snapshot reader re-reads chunks the mounted
+            # view already served — share the process cache instead of
+            # letting the session open a private 256 MiB one (the FUSE
+            # plane's reads all go through chunkcache.shared_cache())
+            from ..pxar import chunkcache
             session = self.store.start_session(
                 backup_type=self.backup_type, backup_id=self.backup_id,
                 previous=self.previous,
+                previous_cache=chunkcache.shared_cache(),
                 namespace=(self.previous.namespace or None)
                 if self.previous else None)
             prev_entries: dict[str, Entry] = {}

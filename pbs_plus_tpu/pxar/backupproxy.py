@@ -55,13 +55,19 @@ class BackupSession:
                  previous: SnapshotRef | None,
                  chunker_factory: ChunkerFactory,
                  pipeline_workers: int | None = None,
-                 previous_reader: SplitReader | None = None):
+                 previous_reader: SplitReader | None = None,
+                 previous_cache=None):
         self.store = store
         self.ref = ref
         self.previous_ref = previous
         self._prev_reader: SplitReader | None = previous_reader
         if previous is not None and previous_reader is None:
-            self._prev_reader = SplitReader.open_snapshot(store.datastore, previous)
+            # previous_cache lets long-lived callers (the FUSE commit
+            # plane) share the process chunk cache instead of paying a
+            # private 256 MiB one per session; None keeps the isolated
+            # default
+            self._prev_reader = SplitReader.open_snapshot(
+                store.datastore, previous, cache=previous_cache)
         self.writer = DedupWriter(
             store.datastore.chunks,
             previous=self._prev_reader,
@@ -239,7 +245,8 @@ class LocalStore:
                       auto_previous: bool = True,
                       namespace: str | None = None,
                       pipeline_workers: int | None = None,
-                      previous_reader=None) -> BackupSession:
+                      previous_reader=None,
+                      previous_cache=None) -> BackupSession:
         """Open a session.  ``previous`` enables ref-dedup against that
         snapshot; by default the latest snapshot of the same group (same
         ``namespace``) is used.  ``previous_reader`` (a SplitReader)
@@ -286,7 +293,8 @@ class LocalStore:
                                       backup_time=format_backup_time(t))
         return BackupSession(self, ref, previous, self._chunker_factory,
                              pipeline_workers=pipeline_workers,
-                             previous_reader=previous_reader)
+                             previous_reader=previous_reader,
+                             previous_cache=previous_cache)
 
     def open_snapshot(self, ref: SnapshotRef, **kw) -> SplitReader:
         return SplitReader.open_snapshot(self.datastore, ref, **kw)

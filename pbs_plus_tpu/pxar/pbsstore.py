@@ -712,7 +712,14 @@ class PBSStore:
                       backup_time: float | None = None,
                       previous=None, auto_previous: bool = True,
                       namespace: str | None = None,
-                      pipeline_workers: int | None = None) -> PBSBackupSession:
+                      pipeline_workers: int | None = None,
+                      previous_cache=None) -> PBSBackupSession:
+        # previous_cache is LocalStore's shared-chunk-cache knob for the
+        # previous-snapshot reader; PBS sessions resolve "previous" as a
+        # server-side digest preload with no client reader, so the knob
+        # is accepted (uniform caller surface, mount/commit.py) and
+        # unused here
+        del previous_cache
         parse_backup_type(backup_type)
         validate.snapshot_component(backup_id)
         ns = self.cfg.namespace if namespace is None else namespace

@@ -911,16 +911,25 @@ class SplitReader:
         end = min(offset + size, index.total_size)
         if offset >= end:
             return b""
-        parts: list[bytes] = []
+        # collect the wave's chunk list first, then fetch as one
+        # streamed batch: get_stream resolves delta chains through a
+        # wave-local memo, so a base shared by several chunks in this
+        # read decompresses once — while each chunk's bytes are sliced
+        # and dropped immediately (O(chunk) resident, not O(range))
+        wave: list[tuple[int, int, int, bytes]] = []
         first_ci = last_ci = -1
         for ci in index.chunks_overlapping(offset, end):
             cs, ce = index.chunk_bounds(ci)
-            data = self.fetch_chunk(index.digest(ci))
-            lo, hi = max(cs, offset), min(ce, end)
-            parts.append(data[lo - cs:hi - cs])
+            wave.append((ci, cs, ce, index.digest(ci)))
             if first_ci < 0:
                 first_ci = ci
             last_ci = ci
+        parts: list[bytes] = []
+        fetched = self._cache.get_stream(
+            self.store, (w[3] for w in wave), self._stats)
+        for (_ci, cs, ce, digest), data in zip(wave, fetched):
+            lo, hi = max(cs, offset), min(ce, end)
+            parts.append(data[lo - cs:hi - cs])
         if first_ci >= 0:
             ra = self._ra.get(id(index))
             if ra is not None:

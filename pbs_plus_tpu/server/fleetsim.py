@@ -139,6 +139,37 @@ class FleetConfig:
     churn_fraction: float = 0.0
     restore_jobs: int = 0
     verify_jobs: int = 0
+    # mount-serve read plane (ISSUE 20, docs/fleet.md "Read serving"):
+    # readserve_readers reader jobs fan out across the agents' publish
+    # events (an agent's publish spawns its share, so reads always hit
+    # live snapshots and contend with the ingest still in flight).
+    # Each reader performs readserve_reads clamped-range random-access
+    # reads through ``file_reader``'s pump — snapshot picked by a
+    # Zipf(readserve_zipf) rank over the published set, range verified
+    # bit-for-bit against the synthetic tree — all in ONE
+    # tenant="readserve" fairness lane over ONE sharded scan-resistant
+    # chunk cache shared by every reader in the soak.  delta_tier=True
+    # runs the whole soak over a similarity-delta datastore so the read
+    # plane exercises delta-chain resolution, not just blob reads.
+    readserve_readers: int = 0
+    readserve_reads: int = 8
+    readserve_zipf: float = 1.2
+    delta_tier: bool = False
+
+
+def zipf_rank(rng, n: int, s: float) -> int:
+    """Sample a rank in [0, n) with P(k) ∝ 1/(k+1)^s — the readserve
+    lane's access mix (rank 0 is the hot snapshot).  Inverse-CDF over
+    the finite support; O(n) per draw is fine at fleet sizes."""
+    if n <= 1:
+        return 0
+    weights = [(k + 1) ** -s for k in range(n)]
+    x = rng.random() * sum(weights)
+    for k, w in enumerate(weights):
+        x -= w
+        if x <= 0:
+            return k
+    return n - 1
 
 
 def has_checkpoint(store: LocalStore, cn: str) -> bool:
@@ -616,7 +647,8 @@ class FleetServer:
                             if cfg.tenant_weights else None))
         self.store = LocalStore(datastore_dir,
                                 ChunkerParams(avg_size=cfg.chunk_avg),
-                                shared_instance=shared_instance or None)
+                                shared_instance=shared_instance or None,
+                                delta_tier=True if cfg.delta_tier else None)
         self.router = Router()
 
         async def ping(req, ctx):
@@ -812,6 +844,15 @@ class FleetReport:
     verify_checked: int = 0
     verify_failures: dict = field(default_factory=dict)
     churned: int = 0
+    # mount-serve read lane (ISSUE 20): concurrent Zipf random-access
+    # readers through the shared sharded chunk cache; cache counters
+    # come straight from ChunkCache.snapshot() at soak end
+    readserve_completed: int = 0
+    readserve_failed: int = 0
+    readserve_reads: int = 0
+    readserve_bytes: int = 0
+    readserve_failures: dict = field(default_factory=dict)
+    readserve_cache: dict = field(default_factory=dict)
     # per-tenant CONTENDED grant counts (JobsManager.tenant_grants) —
     # the weighted-fair proportionality witness
     tenant_grants: dict = field(default_factory=dict)
@@ -892,6 +933,11 @@ class FleetReport:
             "verify_failed": self.verify_failed,
             "verify_checked": self.verify_checked,
             "churned": self.churned,
+            "readserve_completed": self.readserve_completed,
+            "readserve_failed": self.readserve_failed,
+            "readserve_reads": self.readserve_reads,
+            "readserve_bytes": self.readserve_bytes,
+            "readserve_cache": dict(self.readserve_cache),
             "tenant_grants": dict(self.tenant_grants),
         }
 
@@ -927,6 +973,14 @@ async def run_fleet_async(datastore_dir: str,
         churn_set = set(rng.sample(pool, min(k, len(pool))))
     restored: set[int] = set()
     verified: set[int] = set()
+    readserved: set[int] = set()
+    # ONE sharded scan-resistant cache for the whole readserve lane:
+    # every reader job's SplitReader shares it, like hundreds of mount
+    # sessions over one server-wide cache (pxar/chunkcache.py)
+    readserve_cache = None
+    if cfg.readserve_readers > 0:
+        from ..pxar import chunkcache
+        readserve_cache = chunkcache.ChunkCache(64 << 20)
 
     trees = {i: synthetic_tree(cfg.seed, i, cfg.files_per_agent,
                                cfg.file_size)
@@ -1019,6 +1073,17 @@ async def run_fleet_async(datastore_dir: str,
             if idx < cfg.verify_jobs and idx not in verified:
                 verified.add(idx)
                 submit_verify(cn, idx, f"verify-{idx:04d}")
+            # readserve fan-out rides the publish events: each agent's
+            # FIRST publish spawns its share of the reader fleet, so
+            # reads always target live snapshots and contend with the
+            # ingest still in flight through the same slots
+            if cfg.readserve_readers > 0 and idx not in readserved:
+                readserved.add(idx)
+                base_n, extra = divmod(cfg.readserve_readers,
+                                       cfg.n_agents)
+                for j in range(base_n + (1 if idx < extra else 0)):
+                    submit_readserve(idx * 4096 + j,
+                                     f"readserve-{idx:04d}-{j:03d}")
 
         async def on_error(exc: BaseException):
             report.failed += 1
@@ -1105,6 +1170,78 @@ async def run_fleet_async(datastore_dir: str,
 
         server.jobs.enqueue(Job(id=f"verify:{job_id}", kind="verify",
                                 tenant="verify", execute=execute,
+                                on_error=on_error))
+
+    # -- mount-serve read lane (ISSUE 20): Zipf random-access readers ------
+    # (hundreds of concurrent readers over ONE sharded scan-resistant
+    # chunk cache, through file_reader's clamped-range pump — the read
+    # half of the mixed workload, in its own "readserve" fairness lane;
+    # every byte is verified against the agent's synthetic tree, so a
+    # stale cache segment or a torn delta-chain read is a hard failure)
+    def submit_readserve(rid: int, job_id: str) -> None:
+        async def execute():
+            from ..pxar.transfer import SplitReader
+            rrng = random.Random(cfg.seed * 1_000_003 + rid)
+            # rank over the snapshots published SO FAR, hottest first —
+            # later readers see (and spread over) a larger set
+            cns = sorted(report.refs)
+            if not cns:
+                raise RuntimeError("readserve scheduled before any publish")
+
+            def _serve() -> tuple[int, int]:
+                readers: dict[str, tuple] = {}
+                n_reads = n_bytes = 0
+                for _ in range(cfg.readserve_reads):
+                    cn = cns[zipf_rank(rrng, len(cns),
+                                       cfg.readserve_zipf)]
+                    cached = readers.get(cn)
+                    if cached is None:
+                        reader = SplitReader.open_snapshot(
+                            server.store.datastore, report.refs[cn],
+                            cache=readserve_cache)
+                        files = [e for e in reader.entries()
+                                 if e.is_file and e.size > 0]
+                        if not files:
+                            raise RuntimeError(
+                                f"readserve: {cn} has no files")
+                        cached = (reader, files)
+                        readers[cn] = cached
+                    reader, files = cached
+                    entry = files[rrng.randrange(len(files))]
+                    off = rrng.randrange(entry.size)
+                    size = rrng.randint(1, entry.size - off)
+                    fobj, n = reader.file_reader(entry, off, size)
+                    got = bytearray()
+                    while True:
+                        piece = fobj.read(4096)   # window-sized pump
+                        if not piece:
+                            break
+                        got += piece
+                    want = trees[int(cn.split("-")[1])][
+                        entry.path.lstrip("/")][off:off + size]
+                    if bytes(got) != want:
+                        raise RuntimeError(
+                            f"readserve mismatch {cn}:{entry.path!r}"
+                            f"[{off}:{off + size}] "
+                            f"({len(got)} vs {len(want)} bytes)")
+                    n_reads += 1
+                    n_bytes += n
+                return n_reads, n_bytes
+
+            n_reads, n_bytes = await asyncio.get_running_loop() \
+                .run_in_executor(None, trace.wrap(_serve))
+            report.readserve_completed += 1
+            report.readserve_reads += n_reads
+            report.readserve_bytes += n_bytes
+            report.readserve_failures.pop(job_id, None)
+
+        async def on_error(exc: BaseException):
+            report.readserve_failed += 1
+            report.readserve_failures[job_id] = \
+                f"{type(exc).__name__}: {exc}"
+
+        server.jobs.enqueue(Job(id=f"readserve:{job_id}", kind="read",
+                                tenant="readserve", execute=execute,
                                 on_error=on_error))
 
     # -- length-liar lane: hostile backups on their OWN accounting ---------
@@ -1281,6 +1418,9 @@ async def run_fleet_async(datastore_dir: str,
     stop_sampling.set()
     await sampler_task
 
+    if readserve_cache is not None:
+        readserve_cache.drain()
+        report.readserve_cache = readserve_cache.snapshot()
     report.connect_rejects = sum(a.connect_rejects
                                  for a in agents.values())
     report.admission = server.agents.admission_stats()

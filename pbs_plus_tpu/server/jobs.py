@@ -405,7 +405,21 @@ class JobsManager:
         return self._startup_mu
 
     async def drain(self, timeout: float = 60.0) -> None:
-        tasks = list(self._active.values())
-        if tasks:
+        """Wait until the jobs plane is quiescent.  Re-snapshots until
+        no job is active: a draining job may chain NEW jobs from its
+        execute (backup waves, read-back lanes) — a single snapshot
+        would return with those still running, and a caller tearing
+        down its event loop would cancel them mid-flight."""
+        deadline = time.perf_counter() + timeout
+        while True:
+            tasks = list(self._active.values())
+            if not tasks:
+                return
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                raise asyncio.TimeoutError(
+                    f"jobs plane not quiescent after {timeout}s "
+                    f"({len(tasks)} active)")
             await asyncio.wait_for(
-                asyncio.gather(*tasks, return_exceptions=True), timeout)
+                asyncio.gather(*tasks, return_exceptions=True),
+                remaining)

@@ -684,6 +684,18 @@ class MetricsRegistry:
         gauge("pbs_plus_chunk_cache_singleflight_shared_total",
               "Concurrent reads coalesced onto another caller's load",
               [({}, float(cc["singleflight_shared"]))])
+        gauge("pbs_plus_chunk_cache_probation_admits_total",
+              "First-touch chunks admitted to a segment's probationary "
+              "region", [({}, float(cc["probation_admits"]))])
+        gauge("pbs_plus_chunk_cache_probation_promotions_total",
+              "Probationary chunks promoted to protected on "
+              "re-reference", [({}, float(cc["probation_promotions"]))])
+        gauge("pbs_plus_chunk_cache_base_warms_total",
+              "Delta bases warmed alongside a prefetched delta chunk",
+              [({}, float(cc["base_warms"]))])
+        gauge("pbs_plus_chunk_cache_readahead_window",
+              "Adaptive readahead window last used by a reader stream "
+              "(chunks)", [({}, float(cc["readahead_window"]))])
         gauge("pbs_plus_chunk_cache_resident_bytes",
               "Decompressed bytes resident in the shared chunk cache",
               [({}, float(cc["resident_bytes"]))])

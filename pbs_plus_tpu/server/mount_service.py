@@ -135,6 +135,11 @@ class MountService:
         except OSError:
             return 0
         for mid in entries:
+            if mid in self.mounts:
+                # a live mount owned by THIS service (cleanup may run
+                # after startup, e.g. an operator re-sweep) — reaping it
+                # would yank a healthy FUSE daemon's state dir
+                continue
             mdir = os.path.join(self.base, mid)
             mp = os.path.join(mdir, "mnt")
             if is_mounted(mp):

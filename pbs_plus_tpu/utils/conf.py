@@ -63,7 +63,9 @@ ENV_VARS = {
     "PBS_PLUS_SIDECAR_TIMEOUT": "dedup sidecar per-RPC deadline (s)",
     "PBS_PLUS_CHECKPOINT_INTERVAL": "durable checkpoint cadence <N>c/<M>s",
     "PBS_PLUS_CHUNK_CACHE_MB": "shared read-path chunk cache budget (MiB)",
-    "PBS_PLUS_CHUNK_READAHEAD": "chunks prefetched ahead of a scan",
+    "PBS_PLUS_CHUNK_READAHEAD": "base chunks prefetched ahead of a scan",
+    "PBS_PLUS_CHUNK_READAHEAD_MAX": "adaptive readahead window ceiling",
+    "PBS_PLUS_CHUNK_PREFETCH_THREADS": "shared chunk prefetch pool size",
     "PBS_PLUS_DEDUP_INDEX_MB": "dedup-index cuckoo filter budget (MiB)",
     "PBS_PLUS_DEDUP_RESIDENT_MB": "exact-confirm memtable budget (MiB)",
     "PBS_PLUS_STORE_SHARDS": "chunk store logical shard count",
@@ -130,6 +132,12 @@ class Env:
     # scan prefetches (0 disables readahead)
     chunk_cache_mb: int = 256
     chunk_readahead: int = 4
+    # adaptive readahead: the window doubles from chunk_readahead up to
+    # this ceiling on confirmed sequential scans, and halves back on a
+    # misprediction; the prefetch pool is process-global and shared by
+    # every open reader
+    chunk_readahead_max: int = 32
+    chunk_prefetch_threads: int = 2
     # dedup index (pxar/chunkindex.py, docs/data-plane.md "Dedup
     # index"): initial byte budget of the memory-resident cuckoo-filter
     # membership front (MiB; the filter still grows under load-factor
@@ -246,6 +254,10 @@ def env() -> Env:
         checkpoint_interval=e.get("PBS_PLUS_CHECKPOINT_INTERVAL", ""),
         chunk_cache_mb=_int_env(e, "PBS_PLUS_CHUNK_CACHE_MB", "256"),
         chunk_readahead=_int_env(e, "PBS_PLUS_CHUNK_READAHEAD", "4"),
+        chunk_readahead_max=_int_env(e, "PBS_PLUS_CHUNK_READAHEAD_MAX",
+                                     "32"),
+        chunk_prefetch_threads=_int_env(e, "PBS_PLUS_CHUNK_PREFETCH_THREADS",
+                                        "2"),
         dedup_index_mb=_int_env(e, "PBS_PLUS_DEDUP_INDEX_MB", "64"),
         dedup_resident_mb=_int_env(e, "PBS_PLUS_DEDUP_RESIDENT_MB",
                                    "256"),

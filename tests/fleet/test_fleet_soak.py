@@ -180,6 +180,11 @@ def _mixed_cfg(n_agents: int, **kw) -> FleetConfig:
         reservation_ttl_s=1.0,
         mux_write_deadline_s=2.0,
         tenant_weights="tenant-0=3,tenant-1=2",
+        # the mount-serve read lane (ISSUE 20) rides EVERY mixed run:
+        # Zipf random-access readers through one shared sharded cache,
+        # concurrent with the ingest still in flight
+        readserve_readers=max(4, n_agents // 10),
+        readserve_reads=6,
     )
     base.update(kw)
     return FleetConfig(**base)
@@ -201,6 +206,17 @@ def _mixed_assertions(cfg: FleetConfig, rep, d: dict) -> None:
     assert d["sync_failed"] == 0
     # keepalive churn really dropped and redialed control transports
     assert d["churned"] >= 1
+    # the mount-serve read lane completed every reader job with every
+    # ranged read verified bit-for-bit, ingest published concurrently
+    # (zero starvation both ways), and the shared sharded cache really
+    # absorbed the Zipf mix (hits + probation promotions observed)
+    assert d["readserve_completed"] == cfg.readserve_readers, \
+        rep.readserve_failures
+    assert d["readserve_failed"] == 0
+    assert d["readserve_reads"] == \
+        cfg.readserve_readers * cfg.readserve_reads
+    assert d["readserve_bytes"] > 0
+    assert d["readserve_cache"].get("hits", 0) > 0
     # all five hostile profiles ran and each left its server-side mark:
     # flood → RX-credit reset; slow_reader → write-deadline shed;
     # length_liar → typed StreamLengthError counted per-conn and the
@@ -256,6 +272,37 @@ def test_fleet_survival_n2000(tmp_path):
                      churn_fraction=0.05, job_timeout_s=900.0)
     rep = run_fleet(str(tmp_path / "ds"), cfg)
     _mixed_assertions(cfg, rep, rep.to_dict())
+
+
+@pytest.mark.slow
+def test_fleet_readserve_n_high(tmp_path):
+    """ISSUE 20 scaled read-plane acceptance: hundreds of concurrent
+    Zipf readers random-access two waves of published snapshots over a
+    DELTA-TIER datastore through ONE sharded scan-resistant chunk
+    cache, concurrent with the ingest — every ranged read verified
+    bit-for-bit, zero starvation either way.  Opt-in via
+    PBS_PLUS_FLEET=1 (tools/verify_lint.sh readserve leg)."""
+    if not FULL:
+        pytest.skip("set PBS_PLUS_FLEET=1 for the readserve profile")
+    cfg = FleetConfig(n_agents=100, tenants=8, max_concurrent=16,
+                      max_queued=4000, jobs_per_agent=2,
+                      readserve_readers=300, readserve_reads=10,
+                      delta_tier=True, job_timeout_s=900.0)
+    rep = run_fleet(str(tmp_path / "ds"), cfg)
+    d = rep.to_dict()
+    # ingest published every wave despite 300 concurrent reader jobs
+    assert d["published"] == 200, rep.failures
+    assert not rep.failures
+    # every reader completed with every byte verified
+    assert d["readserve_completed"] == 300, rep.readserve_failures
+    assert d["readserve_failed"] == 0
+    assert d["readserve_reads"] == 3000
+    # the shared cache absorbed the Zipf mix: the working set got
+    # promoted out of probation and re-served from protected
+    cc = d["readserve_cache"]
+    assert cc["hits"] > 0
+    assert cc["probation_promotions"] > 0
+    assert cc["shards"] >= 2     # the 64 MiB lane cache really sharded
 
 
 def test_fleet_open_rate_causes_typed_rejects(tmp_path):

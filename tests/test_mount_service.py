@@ -1,0 +1,91 @@
+"""MountService stale-mount reaping (server/mount_service.py).
+
+``cleanup_stale_mounts`` is the crashed-server bootstrap sweep
+(reference cleanupStaleMounts): every leftover mount state dir under
+the service base is reaped — detaching the kernel mount first when one
+is still attached — while anything the RUNNING service owns stays
+untouched.  The kernel-mount half is driven through monkeypatched
+``is_mounted``/``lazy_unmount`` seams (a real FUSE mount needs
+/dev/fuse, which CI containers don't guarantee); the state-dir
+filesystem effects are real.
+"""
+
+import os
+import types
+
+from pbs_plus_tpu.server import mount_service
+from pbs_plus_tpu.server.mount_service import ActiveMount, MountService
+
+
+def _svc(tmp_path) -> MountService:
+    server = types.SimpleNamespace(config=types.SimpleNamespace(
+        state_dir=str(tmp_path / "state"),
+        datastore_dir=str(tmp_path / "ds"),
+        chunk_avg=4096))
+    return MountService(server, base_dir=str(tmp_path / "mounts"))
+
+
+def _leftover(svc: MountService, mid: str) -> str:
+    """A crashed server's droppings: state dir + mountpoint + socket."""
+    mdir = os.path.join(svc.base, mid)
+    os.makedirs(os.path.join(mdir, "mnt"))
+    with open(os.path.join(mdir, "ctl.sock"), "w"):
+        pass
+    return mdir
+
+
+def test_cleanup_reaps_unmounted_leftover_dir(tmp_path):
+    """A leftover whose kernel mount is already gone (the common crash
+    shape: the FUSE daemon died with the server) is rmtree'd; the
+    return value counts only DETACHED mounts, so it stays 0."""
+    svc = _svc(tmp_path)
+    mdir = _leftover(svc, "deadbee1")
+    assert svc.cleanup_stale_mounts() == 0
+    assert not os.path.exists(mdir)
+
+
+def test_cleanup_detaches_stale_kernel_mount(tmp_path, monkeypatch):
+    """A leftover with the kernel mount still attached is lazy-detached
+    and then reaped, and the detach is counted."""
+    svc = _svc(tmp_path)
+    mdir = _leftover(svc, "deadbee2")
+    mp = os.path.join(mdir, "mnt")
+    detached = []
+    monkeypatch.setattr(mount_service, "is_mounted", lambda p: p == mp)
+    monkeypatch.setattr(mount_service, "lazy_unmount",
+                        lambda p: detached.append(p) or True)
+    assert svc.cleanup_stale_mounts() == 1
+    assert detached == [mp]
+    assert not os.path.exists(mdir)
+
+
+def test_cleanup_leaves_undetachable_mount_state(tmp_path, monkeypatch):
+    """If the lazy detach fails (busy mount, no fusermount) the state
+    dir must survive — rmtree under a live mountpoint would destroy the
+    daemon's socket and state out from under it."""
+    svc = _svc(tmp_path)
+    mdir = _leftover(svc, "deadbee3")
+    monkeypatch.setattr(mount_service, "is_mounted", lambda p: True)
+    monkeypatch.setattr(mount_service, "lazy_unmount", lambda p: False)
+    assert svc.cleanup_stale_mounts() == 0
+    assert os.path.exists(mdir)
+
+
+def test_cleanup_skips_live_mounts_of_this_service(tmp_path, monkeypatch):
+    """A healthy mount registered with the RUNNING service is never
+    touched — no detach attempt, state dir intact — while a crashed
+    leftover beside it is still reaped."""
+    svc = _svc(tmp_path)
+    live_dir = _leftover(svc, "a11ce001")
+    stale_dir = _leftover(svc, "deadbee4")
+    mp = os.path.join(live_dir, "mnt")
+    svc.mounts["a11ce001"] = ActiveMount(
+        "a11ce001", "vm/100/2026-01-01T00:00:00Z", mp,
+        os.path.join(live_dir, "ctl.sock"))
+    probed = []
+    monkeypatch.setattr(mount_service, "is_mounted",
+                        lambda p: probed.append(p) or False)
+    assert svc.cleanup_stale_mounts() == 0
+    assert os.path.exists(live_dir)          # healthy mount untouched
+    assert not os.path.exists(stale_dir)     # leftover reaped
+    assert mp not in probed                  # never even probed

@@ -69,6 +69,14 @@ fi
 if [ -n "${PBS_PLUS_FLEET:-}" ]; then
     echo "== fleet survival profiles (PBS_PLUS_FLEET, -m slow) =="
     JAX_PLATFORMS=cpu python -m pytest tests/fleet/ -q -m slow
+    # the mount-serve read plane, alone and loud (ISSUE 20): hundreds
+    # of Zipf readers over a delta-tier store through one sharded
+    # scan-resistant cache — a read-path regression fails HERE with
+    # only readserve output, not buried in the full fleet run
+    echo "== fleet readserve profile (PBS_PLUS_FLEET, -m slow) =="
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/fleet/test_fleet_soak.py::test_fleet_readserve_n_high \
+        -q -m slow
 fi
 
 echo "verify_lint: OK"
